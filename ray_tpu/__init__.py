@@ -1,0 +1,66 @@
+"""ray_tpu: a TPU-native distributed task & actor framework.
+
+Brand-new implementation of the capabilities of early Ray (tasks, actors, an
+immutable object store, resource-aware scheduling, lineage fault tolerance, and
+the library layer) designed around jax/XLA/pallas/pjit. The scheduler's
+placement decision is a jit-compiled batch kernel (ray_tpu.scheduler);
+collectives run natively over ICI/DCN via jax meshes (ray_tpu.parallel).
+
+Public surface mirrors the reference's ``python/ray/__init__.py:75-100``.
+"""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    timeline,
+    wait,
+)
+from .exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .object_ref import ObjectRef  # noqa: F401
+from .remote_function import remote  # noqa: F401
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "timeline",
+    "ObjectRef",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+    "WorkerCrashedError",
+]

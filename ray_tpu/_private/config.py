@@ -1,0 +1,87 @@
+"""Typed runtime configuration registry.
+
+Equivalent role to the reference's ``RAY_CONFIG(type, name, default)`` macro
+registry (reference: ``src/ray/common/ray_config_def.h``): a single process-wide
+table of typed knobs, overridable at ``init()`` time via a ``_system_config``
+dict or via ``RAY_TPU_<NAME>`` environment variables.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return cast(raw)
+
+
+@dataclass
+class Config:
+    # --- heartbeats / failure detection (reference: ray_config_def.h:38,46) ---
+    heartbeat_interval_ms: int = 100
+    num_heartbeats_timeout: int = 30
+    # --- scheduling ---
+    scheduler_backend: str = "jax"  # "jax" | "scalar"
+    scheduler_tick_ms: int = 10
+    scheduler_spread_threshold: float = 0.5
+    max_tasks_per_tick: int = 65536
+    # --- objects ---
+    max_direct_call_object_size: int = 100 * 1024  # inline threshold, ref ray_config_def.h:117
+    object_store_memory: int = 2 * 1024**3
+    object_transfer_chunk_bytes: int = 1024 * 1024  # ref ray_config_def.h:242
+    free_objects_batch_size: int = 100
+    # --- tasks / actors ---
+    max_retries_default: int = 4  # ref doc/source/fault-tolerance.rst:12
+    actor_max_restarts_default: int = 0
+    max_pending_lease_requests: int = 10
+    worker_lease_timeout_ms: int = 500
+    # --- workers ---
+    num_workers_soft_limit: int = 0  # 0 => num_cpus
+    worker_register_timeout_s: int = 30
+    maximum_startup_concurrency: int = 8
+    # --- lineage / reconstruction ---
+    max_lineage_size: int = 100  # ref ray_config_def.h:157
+    task_lease_timeout_ms: int = 1000
+    # --- logging / debug ---
+    debug_dump_period_ms: int = 10000
+    event_log_enabled: bool = True
+    # --- raw overrides applied last ---
+    _overrides: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for f in fields(self):
+            if f.name.startswith("_"):
+                continue
+            cast = type(getattr(self, f.name))
+            setattr(self, f.name, _env(f.name, getattr(self, f.name), cast))
+
+    def update(self, overrides: Optional[Dict[str, Any]] = None) -> "Config":
+        for key, value in (overrides or {}).items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown config key: {key}")
+            setattr(self, key, value)
+            self._overrides[key] = value
+        return self
+
+
+_global_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def reset_config(overrides: Optional[Dict[str, Any]] = None) -> Config:
+    global _global_config
+    _global_config = Config().update(overrides)
+    return _global_config

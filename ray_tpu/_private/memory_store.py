@@ -1,0 +1,145 @@
+"""In-process object store.
+
+Plays the role of the reference's ``CoreWorkerMemoryStore`` (reference:
+``src/ray/core_worker/store_provider/memory_store/``) for the local runtime:
+immutable objects keyed by ObjectID, blocking gets with timeout, async
+listeners used by the dependency manager, LRU-ish accounting against a byte
+budget. In the cluster backend the same interface fronts the shared-memory
+arena (ray_tpu/cluster), so callers never care which plane an object is on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..exceptions import GetTimeoutError, ObjectStoreFullError
+from .ids import ObjectID
+
+
+class StoredObject:
+    """One immutable stored value.
+
+    ``value`` is the in-process deserialized object (stored once; callers must
+    not mutate — same contract as plasma's immutable buffers). ``error`` holds
+    a TaskError/ActorError to re-raise at get().
+    """
+
+    __slots__ = ("value", "error", "nbytes", "created_at")
+
+    def __init__(self, value: Any = None, error: Optional[BaseException] = None,
+                 nbytes: int = 0):
+        self.value = value
+        self.error = error
+        self.nbytes = nbytes
+        self.created_at = time.monotonic()
+
+
+class MemoryStore:
+    def __init__(self, max_bytes: int = 0):
+        self._objects: Dict[ObjectID, StoredObject] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._listeners: Dict[ObjectID, List[Callable[[ObjectID], None]]] = {}
+        self._max_bytes = max_bytes
+        self._used_bytes = 0
+
+    # -- write ----------------------------------------------------------------
+    def put(self, object_id: ObjectID, obj: StoredObject) -> None:
+        with self._lock:
+            existing = self._objects.get(object_id)
+            if existing is not None:
+                return  # objects are immutable; double-put is a no-op
+            if self._max_bytes and self._used_bytes + obj.nbytes > self._max_bytes:
+                raise ObjectStoreFullError(
+                    f"object store over budget: {self._used_bytes + obj.nbytes} "
+                    f"> {self._max_bytes} bytes"
+                )
+            self._objects[object_id] = obj
+            self._used_bytes += obj.nbytes
+            listeners = self._listeners.pop(object_id, [])
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
+    def delete(self, object_ids: Sequence[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                obj = self._objects.pop(oid, None)
+                if obj is not None:
+                    self._used_bytes -= obj.nbytes
+
+    # -- read -----------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[StoredObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def get(self, object_ids: Sequence[ObjectID],
+            timeout: Optional[float] = None) -> List[StoredObject]:
+        """Blocking batched get; raises GetTimeoutError on expiry."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [oid for oid in object_ids if oid not in self._objects]
+                if not missing:
+                    return [self._objects[oid] for oid in object_ids]
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get timed out; {len(missing)} of {len(object_ids)} "
+                            f"objects not ready (first missing: {missing[0]})"
+                        )
+                self._cv.wait(timeout=remaining)
+
+    def wait(self, object_ids: Sequence[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
+        """ray.wait semantics: block until num_returns ready or timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [oid for oid in object_ids if oid in self._objects]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    # preserve input order in both lists
+                    ready_list = [o for o in object_ids if o in ready_set]
+                    rest = [o for o in object_ids if o not in ready_set]
+                    return ready_list, rest
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        ready_set = set(ready)
+                        return (
+                            [o for o in object_ids if o in ready_set],
+                            [o for o in object_ids if o not in ready_set],
+                        )
+                self._cv.wait(timeout=remaining)
+
+    # -- async notification (dependency manager hook) -------------------------
+    def on_available(self, object_id: ObjectID,
+                     callback: Callable[[ObjectID], None]) -> None:
+        """Invoke callback when object_id becomes available (maybe immediately)."""
+        with self._lock:
+            if object_id in self._objects:
+                fire = True
+            else:
+                self._listeners.setdefault(object_id, []).append(callback)
+                fire = False
+        if fire:
+            callback(object_id)
+
+    # -- stats ----------------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "used_bytes": self._used_bytes,
+                "max_bytes": self._max_bytes,
+            }

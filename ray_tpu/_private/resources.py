@@ -1,0 +1,165 @@
+"""Resource accounting: fixed-point vectors + named custom resources.
+
+Modeled on the reference's *new* vectorized scheduler data model (reference:
+``src/ray/common/scheduling/cluster_resource_scheduler.h:28-217`` — predefined
+slots with TPU already first-class, fixed-point arithmetic so fractional
+resources compare exactly) rather than the legacy string-keyed ``ResourceSet``.
+
+All quantities are stored as int64 "kilo-units" (1.0 == 1000), which makes
+demand<=available comparisons exact for fractional requests like 0.5 CPU, and
+makes the whole cluster state embeddable as an int32/int64 device tensor for the
+batch placement kernel (see ray_tpu/scheduler/kernel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+import numpy as np
+
+KILO = 1000  # fixed-point scale: 1.0 resource unit == 1000
+
+# Predefined dense slots. Order matters: it is the kernel's resource axis.
+CPU, MEM, TPU, TPU_MEM = 0, 1, 2, 3
+PREDEFINED = ("CPU", "memory", "TPU", "tpu_memory")
+NUM_PREDEFINED = len(PREDEFINED)
+_PREDEFINED_INDEX = {name: i for i, name in enumerate(PREDEFINED)}
+# Aliases accepted in user-facing resource dicts.
+_ALIASES = {"GPU": "TPU", "num_cpus": "CPU", "num_tpus": "TPU", "object_store_memory": "memory"}
+
+
+def to_fixed(value: float) -> int:
+    return int(round(value * KILO))
+
+
+def from_fixed(value: int) -> float:
+    return value / KILO
+
+
+class ResourceSet:
+    """An immutable demand/capacity vector: dense predefined slots + custom map.
+
+    Equivalent of the reference's ``TaskRequest``/``NodeResources`` pair
+    (``cluster_resource_scheduler.h:137,185``) collapsed into one type.
+    """
+
+    __slots__ = ("predefined", "custom", "_key")
+
+    def __init__(
+        self,
+        predefined: Optional[np.ndarray] = None,
+        custom: Optional[Mapping[str, int]] = None,
+    ):
+        if predefined is None:
+            predefined = np.zeros(NUM_PREDEFINED, dtype=np.int64)
+        self.predefined = np.asarray(predefined, dtype=np.int64)
+        assert self.predefined.shape == (NUM_PREDEFINED,)
+        self.custom: Dict[str, int] = {k: v for k, v in (custom or {}).items() if v != 0}
+        self._key: Optional[Tuple] = None
+
+    @classmethod
+    def from_dict(cls, resources: Optional[Mapping[str, float]]) -> "ResourceSet":
+        predefined = np.zeros(NUM_PREDEFINED, dtype=np.int64)
+        custom: Dict[str, int] = {}
+        for name, qty in (resources or {}).items():
+            name = _ALIASES.get(name, name)
+            fixed = to_fixed(qty)
+            idx = _PREDEFINED_INDEX.get(name)
+            if idx is not None:
+                predefined[idx] += fixed
+            else:
+                custom[name] = custom.get(name, 0) + fixed
+        return cls(predefined, custom)
+
+    def to_dict(self) -> Dict[str, float]:
+        out = {
+            PREDEFINED[i]: from_fixed(int(v))
+            for i, v in enumerate(self.predefined)
+            if v != 0
+        }
+        out.update({k: from_fixed(v) for k, v in self.custom.items()})
+        return out
+
+    def is_empty(self) -> bool:
+        return not self.custom and not self.predefined.any()
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        """Feasibility test: self (demand) fits in other (available).
+
+        Exactly the reference's ``ResourceSet::IsSubset`` used in the placement
+        loop (``scheduling_policy.cc:75``), in fixed-point.
+        """
+        if (self.predefined > other.predefined).any():
+            return False
+        return all(other.custom.get(k, 0) >= v for k, v in self.custom.items())
+
+    def add(self, other: "ResourceSet") -> "ResourceSet":
+        custom = dict(self.custom)
+        for k, v in other.custom.items():
+            custom[k] = custom.get(k, 0) + v
+        return ResourceSet(self.predefined + other.predefined, custom)
+
+    def subtract(self, other: "ResourceSet") -> "ResourceSet":
+        custom = dict(self.custom)
+        for k, v in other.custom.items():
+            custom[k] = custom.get(k, 0) - v
+        return ResourceSet(self.predefined - other.predefined, custom)
+
+    def key(self) -> Tuple:
+        """Hashable interning key (basis of SchedulingClass, ref task_spec.h:190)."""
+        if self._key is None:
+            self._key = (tuple(self.predefined.tolist()), tuple(sorted(self.custom.items())))
+        return self._key
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+class NodeResources:
+    """Mutable per-node accounting: total and available ResourceSets.
+
+    Mirrors the reference's ``SchedulingResources`` (total/available/load,
+    ``common/task/scheduling_resources.h``); load is tracked by the scheduler.
+    """
+
+    __slots__ = ("total", "available")
+
+    def __init__(self, total: ResourceSet):
+        self.total = total
+        self.available = total
+
+    def acquire(self, demand: ResourceSet) -> bool:
+        if not demand.is_subset_of(self.available):
+            return False
+        self.available = self.available.subtract(demand)
+        return True
+
+    def release(self, demand: ResourceSet) -> None:
+        self.available = self.available.add(demand)
+        # Clamp: a release should never exceed total (defensive vs. double release).
+        np.minimum(self.available.predefined, self.total.predefined,
+                   out=self.available.predefined)
+
+    def __repr__(self):
+        return f"NodeResources(total={self.total}, available={self.available})"
+
+
+def dense_matrix(sets: Iterable[ResourceSet], custom_names: Tuple[str, ...] = ()) -> np.ndarray:
+    """Pack ResourceSets into an [N, R] int64 matrix for the placement kernel.
+
+    Columns are the predefined slots followed by ``custom_names`` in order.
+    """
+    sets = list(sets)
+    ncols = NUM_PREDEFINED + len(custom_names)
+    out = np.zeros((len(sets), ncols), dtype=np.int64)
+    for i, rs in enumerate(sets):
+        out[i, :NUM_PREDEFINED] = rs.predefined
+        for j, name in enumerate(custom_names):
+            out[i, NUM_PREDEFINED + j] = rs.custom.get(name, 0)
+    return out

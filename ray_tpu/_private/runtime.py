@@ -1,0 +1,690 @@
+"""Local (single-process) runtime: core worker + node scheduler in one.
+
+This is the stage-2 runtime from the build plan: the semantics of the
+reference's core_worker (task submission, dependency resolution, object
+put/get/wait — reference: ``src/ray/core_worker/core_worker.h:262``) fused with
+a single node's scheduler (resource admission + dispatch — reference:
+``src/ray/raylet/node_manager.cc:993`` DispatchTasks) into one in-process
+engine. The cluster backend (ray_tpu/cluster) reuses the same submission/actor
+machinery but routes placement through the batch placement kernel and objects
+through the shared-memory arena.
+
+Execution model:
+  - normal tasks run on a growable thread pool; admission is controlled by the
+    node's ResourceSet accounting, not pool size (jax/XLA work releases the GIL
+    so threads give real parallelism for the TPU path);
+  - a task that blocks in ``get()`` releases its resources and re-acquires
+    (oversubscribing if needed) on unblock — the reference's
+    HandleDirectCallTaskBlocked/Unblocked protocol (node_manager.h:385-392),
+    without which nested task graphs deadlock;
+  - actors are dispatch threads with ordered inbound queues (the reference's
+    direct actor transport, direct_actor_transport.h:298), optional
+    max_concurrency via an inner pool, optional asyncio event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import time
+import traceback
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskCancelledError,
+    TaskError,
+)
+from ..object_ref import ObjectRef
+from .config import Config
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from .memory_store import MemoryStore, StoredObject
+from .resources import NodeResources, ResourceSet
+from .serialization import get_context as get_serialization_context
+from .task_spec import TaskSpec, TaskType
+
+_LOCAL = threading.local()
+
+
+class WorkerContext:
+    """Per-thread execution context (reference: core_worker/context.h)."""
+
+    def __init__(self, job_id: JobID, task_id: TaskID):
+        self.job_id = job_id
+        self.current_task_id = task_id
+        self.task_counter = itertools.count(1)
+        self.put_counter = itertools.count(1)
+        self.acquired: Optional[ResourceSet] = None  # held by the running task
+
+
+def current_context() -> Optional[WorkerContext]:
+    return getattr(_LOCAL, "ctx", None)
+
+
+def ensure_context(runtime) -> WorkerContext:
+    """Context for this thread, creating a driver-scoped one if absent.
+
+    User-spawned threads (e.g. a ThreadPoolExecutor in driver code) have no
+    inherited context; they submit as children of the driver task.
+    """
+    ctx = getattr(_LOCAL, "ctx", None)
+    if ctx is None:
+        # Scope the thread under a unique pseudo-task so two threads never
+        # derive colliding task/object IDs (counters alone are per-context).
+        scope = TaskID.for_normal_task(
+            runtime.job_id, runtime.driver_task_id, next(runtime._thread_scope_counter)
+        )
+        ctx = WorkerContext(runtime.job_id, scope)
+        _LOCAL.ctx = ctx
+    return ctx
+
+
+class _EventLog:
+    """Cheap append-only profile log; feeds timeline() chrome-trace export."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self.events: deque = deque(maxlen=1_000_000)
+
+    def record(self, kind: str, name: str, start: float, end: float, **extra):
+        if self.enabled:
+            self.events.append((kind, name, start, end, extra))
+
+
+class PendingTask:
+    __slots__ = ("spec", "fn", "remaining_deps", "retries_left", "cancelled", "future")
+
+    def __init__(self, spec: TaskSpec, fn: Callable, retries_left: int):
+        self.spec = spec
+        self.fn = fn
+        self.remaining_deps = 0
+        self.retries_left = retries_left
+        self.cancelled = False
+        self.future: Optional[Future] = None
+
+
+class LocalActor:
+    """One live actor: instance + ordered dispatch thread.
+
+    Reference semantics: per-caller sequence ordering and bounded concurrency
+    (``direct_actor_transport.h:264,298``); asyncio actors run methods on an
+    event loop instead of blocking the dispatch thread (core_worker/fiber.h).
+    """
+
+    def __init__(self, actor_id: ActorID, name: Optional[str], runtime: "LocalRuntime",
+                 max_concurrency: int, is_asyncio: bool,
+                 lifetime_resources: ResourceSet):
+        self.actor_id = actor_id
+        self.name = name
+        self.runtime = runtime
+        self.instance: Any = None
+        self.dead = False
+        self.resources_released = False
+        self.class_info: Optional[Tuple[str, str, tuple]] = None  # name, module, methods
+        self.creation_error: Optional[BaseException] = None
+        self.created = threading.Event()
+        self.lifetime_resources = lifetime_resources
+        self.max_concurrency = max_concurrency
+        self.is_asyncio = is_asyncio
+        self.queue: "deque[Tuple[int, TaskSpec]]" = deque()
+        self.next_seq = 0
+        self.pending_out_of_order: Dict[int, TaskSpec] = {}
+        self.cv = threading.Condition()
+        self.num_executing = 0
+        self.inner_pool: Optional[ThreadPoolExecutor] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"actor-{actor_id.hex()[:8]}", daemon=True
+        )
+
+    def start(self, creation_spec: TaskSpec, cls: type, args, kwargs):
+        self._creation = (creation_spec, cls, args, kwargs)
+        self.thread.start()
+
+    def submit(self, seq_no: int, spec: TaskSpec):
+        with self.cv:
+            if self.dead:
+                self._fail_spec(spec, ActorDiedError(self.actor_id))
+                return
+            if seq_no == self.next_seq:
+                self.queue.append((seq_no, spec))
+                self.next_seq += 1
+                # drain any buffered out-of-order successors
+                while self.next_seq in self.pending_out_of_order:
+                    self.queue.append(
+                        (self.next_seq, self.pending_out_of_order.pop(self.next_seq))
+                    )
+                    self.next_seq += 1
+            else:
+                self.pending_out_of_order[seq_no] = spec
+            self.cv.notify_all()
+        self._wake_loop()
+
+    def kill(self, no_restart: bool = True):
+        with self.cv:
+            self.dead = True
+            pending = [spec for _, spec in self.queue]
+            pending.extend(self.pending_out_of_order.values())
+            self.queue.clear()
+            self.pending_out_of_order.clear()
+            self.cv.notify_all()
+        for spec in pending:
+            self._fail_spec(spec, ActorDiedError(self.actor_id))
+        self._wake_loop()
+
+    def _fail_spec(self, spec: TaskSpec, error: BaseException):
+        for oid in spec.return_ids():
+            self.runtime.store.put(oid, StoredObject(error=error))
+
+    # -- dispatch loop --------------------------------------------------------
+    def _run(self):
+        creation_spec, cls, args, kwargs = self._creation
+        _LOCAL.ctx = WorkerContext(creation_spec.job_id, creation_spec.task_id)
+        t0 = time.monotonic()
+        try:
+            resolved_args, resolved_kwargs = self.runtime._resolve_args(args, kwargs)
+            self.instance = cls(*resolved_args, **resolved_kwargs)
+            self.runtime.store.put(
+                creation_spec.return_ids()[0], StoredObject(value=self.actor_id)
+            )
+        except BaseException as e:  # noqa: BLE001 - creation failure is data
+            self.creation_error = e
+            err = TaskError(f"{cls.__name__}.__init__", e)
+            self.runtime.store.put(creation_spec.return_ids()[0], StoredObject(error=err))
+            with self.cv:
+                self.dead = True
+            self.created.set()
+            # Release lifetime resources reserved in create_actor, else a
+            # failed constructor permanently leaks them.
+            self.runtime._release_actor_resources(self)
+            return
+        finally:
+            self.runtime.events.record(
+                "actor_creation", cls.__name__, t0, time.monotonic(),
+                actor_id=self.actor_id.hex(),
+            )
+        self.created.set()
+
+        if self.is_asyncio:
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop.create_task(self._async_dispatch())
+            self.loop.run_forever()
+            return
+        if self.max_concurrency > 1:
+            self.inner_pool = ThreadPoolExecutor(
+                max_workers=self.max_concurrency,
+                thread_name_prefix=f"actor-{self.actor_id.hex()[:8]}-c",
+            )
+        while True:
+            with self.cv:
+                while not self.queue and not self.dead:
+                    self.cv.wait()
+                if self.dead and not self.queue:
+                    break
+                _, spec = self.queue.popleft()
+            if self.inner_pool is not None:
+                self.inner_pool.submit(self._execute_method, spec)
+            else:
+                self._execute_method(spec)
+        if self.inner_pool is not None:
+            self.inner_pool.shutdown(wait=False)
+
+    async def _async_dispatch(self):
+        # Woken by submit()/kill() via call_soon_threadsafe on this event —
+        # no idle polling.
+        self._wake = asyncio.Event()
+        while True:
+            spec = None
+            with self.cv:
+                if self.queue:
+                    _, spec = self.queue.popleft()
+                elif self.dead:
+                    break
+            if spec is None:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            asyncio.get_event_loop().create_task(self._execute_method_async(spec))
+        self.loop.stop()
+
+    def _wake_loop(self):
+        if self.loop is not None and hasattr(self, "_wake"):
+            self.loop.call_soon_threadsafe(self._wake.set)
+
+    def _execute_method(self, spec: TaskSpec):
+        _LOCAL.ctx = WorkerContext(spec.job_id, spec.task_id)
+        self.runtime._execute_callable(
+            spec, lambda a, k: getattr(self.instance, spec.function.qualname)(*a, **k)
+        )
+
+    async def _execute_method_async(self, spec: TaskSpec):
+        method = getattr(self.instance, spec.function.qualname)
+        t0 = time.monotonic()
+        try:
+            args, kwargs = self.runtime._resolve_args_from_spec(spec)
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            self.runtime._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            self.runtime._store_error(spec, TaskError(spec.function.repr_name, e))
+        finally:
+            self.runtime.events.record(
+                "actor_task", spec.function.repr_name, t0, time.monotonic(),
+                actor_id=self.actor_id.hex(),
+            )
+
+
+class LocalRuntime:
+    """The single-node engine behind ``ray_tpu.init()`` (default mode)."""
+
+    def __init__(self, resources: ResourceSet, config: Config,
+                 job_id: Optional[JobID] = None):
+        self.config = config
+        self.node_id = NodeID.from_random()
+        self.job_id = job_id or JobID.from_int(1)
+        self.driver_task_id = TaskID.for_driver_task(self.job_id)
+        self.store = MemoryStore(max_bytes=config.object_store_memory)
+        self.node = NodeResources(resources)
+        self.events = _EventLog(config.event_log_enabled)
+        self.serialization = get_serialization_context()
+
+        self._lock = threading.Lock()
+        self._resource_cv = threading.Condition(self._lock)
+        self._ready: deque = deque()  # PendingTask, deps resolved, awaiting resources
+        self._pending: Dict[TaskID, PendingTask] = {}
+        self._actors: Dict[ActorID, LocalActor] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._actor_seq: Dict[ActorID, itertools.count] = {}
+        self._pool = ThreadPoolExecutor(max_workers=4096, thread_name_prefix="task")
+        # Counter namespace for user-thread contexts; starts high so it never
+        # collides with the driver thread's own task counters.
+        self._thread_scope_counter = itertools.count(1 << 31)
+        self._shutdown = False
+        self.stats = {"tasks_submitted": 0, "tasks_finished": 0, "tasks_failed": 0}
+
+        _LOCAL.ctx = WorkerContext(self.job_id, self.driver_task_id)
+
+    # ------------------------------------------------------------------ tasks
+    def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        pending = PendingTask(spec, fn, retries_left=spec.max_retries)
+        deps = spec.dependencies()
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("runtime is shut down")
+            self.stats["tasks_submitted"] += 1
+            self._pending[spec.task_id] = pending
+            pending.remaining_deps = len(deps)
+        if deps:
+            for oid in deps:
+                self.store.on_available(oid, lambda _oid, p=pending: self._dep_ready(p))
+        else:
+            self._enqueue_ready(pending)
+        return refs
+
+    def _dep_ready(self, pending: PendingTask):
+        with self._lock:
+            pending.remaining_deps -= 1
+            if pending.remaining_deps > 0 or pending.cancelled:
+                return
+        self._enqueue_ready(pending)
+
+    def _enqueue_ready(self, pending: PendingTask):
+        with self._lock:
+            self._ready.append(pending)
+        self._dispatch()
+
+    def _dispatch(self):
+        """Admit as many ready tasks as resources allow (ref DispatchTasks)."""
+        to_run: List[PendingTask] = []
+        with self._lock:
+            scanned = 0
+            # Scan (bounded) for feasible tasks; avoids head-of-line blocking by
+            # one large task, like the reference's per-class round robin.
+            while self._ready and scanned < 128:
+                n = len(self._ready)
+                admitted = False
+                for _ in range(n):
+                    p = self._ready.popleft()
+                    if p.cancelled:
+                        continue
+                    if self.node.acquire(p.spec.resources):
+                        to_run.append(p)
+                        admitted = True
+                    else:
+                        self._ready.append(p)
+                        scanned += 1
+                if not admitted:
+                    break
+        for p in to_run:
+            p.future = self._pool.submit(self._run_task, p)
+
+    def _run_task(self, pending: PendingTask):
+        spec = pending.spec
+        ctx = WorkerContext(spec.job_id, spec.task_id)
+        ctx.acquired = spec.resources
+        _LOCAL.ctx = ctx
+        try:
+            if pending.cancelled:
+                self._store_error(spec, TaskCancelledError(spec.task_id))
+                return
+            self._execute_callable(
+                spec, lambda a, k: pending.fn(*a, **k), pending=pending
+            )
+        finally:
+            acquired = ctx.acquired
+            ctx.acquired = None
+            with self._lock:
+                if acquired is not None:
+                    self.node.release(acquired)
+                self._pending.pop(spec.task_id, None)
+                self._resource_cv.notify_all()
+            self._dispatch()
+
+    def _execute_callable(self, spec: TaskSpec, call: Callable,
+                          pending: Optional[PendingTask] = None):
+        t0 = time.monotonic()
+        try:
+            args, kwargs = self._resolve_args_from_spec(spec)
+            result = call(args, kwargs)
+            self._store_returns(spec, result)
+            self.stats["tasks_finished"] += 1
+        except BaseException as e:  # noqa: BLE001 - task errors are data
+            # Retry semantics match the reference (task_manager.cc): only
+            # *system* failures (worker crash / node death) consume
+            # max_retries; application exceptions are stored immediately.
+            # In this in-process runtime tasks cannot crash a worker, so the
+            # retry path is exercised by the cluster backend.
+            from ..exceptions import WorkerCrashedError
+
+            if (isinstance(e, WorkerCrashedError) and pending is not None
+                    and pending.retries_left > 0):
+                pending.retries_left -= 1
+                self._enqueue_ready(pending)
+                return
+            self.stats["tasks_failed"] += 1
+            if isinstance(e, (TaskError, ActorDiedError)):
+                err = e  # propagate the original failure through chains
+            else:
+                err = TaskError(spec.function.repr_name, e)
+            self._store_error(spec, err)
+        finally:
+            self.events.record(
+                "task", spec.function.repr_name, t0, time.monotonic(),
+                task_id=spec.task_id.hex(),
+            )
+
+    # -------------------------------------------------------------- arguments
+    def _resolve_args_from_spec(self, spec: TaskSpec) -> Tuple[list, dict]:
+        args = []
+        for kind, val in spec.args:
+            if kind == "ref":
+                obj = self.store.get([val])[0]
+                if obj.error is not None:
+                    raise obj.error
+                args.append(obj.value)
+            else:
+                args.append(val)
+        kwargs = spec.metadata.get("kwargs", {})
+        resolved_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectRef):
+                obj = self.store.get([v.id])[0]
+                if obj.error is not None:
+                    raise obj.error
+                resolved_kwargs[k] = obj.value
+            else:
+                resolved_kwargs[k] = v
+        return args, resolved_kwargs
+
+    def _resolve_args(self, args, kwargs) -> Tuple[list, dict]:
+        out = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                obj = self.store.get([a.id])[0]
+                if obj.error is not None:
+                    raise obj.error
+                out.append(obj.value)
+            else:
+                out.append(a)
+        out_k = {}
+        for k, v in (kwargs or {}).items():
+            if isinstance(v, ObjectRef):
+                obj = self.store.get([v.id])[0]
+                if obj.error is not None:
+                    raise obj.error
+                out_k[k] = obj.value
+            else:
+                out_k[k] = v
+        return out, out_k
+
+    # ---------------------------------------------------------------- returns
+    def _store_returns(self, spec: TaskSpec, result: Any):
+        oids = spec.return_ids()
+        if len(oids) == 1:
+            self.store.put(oids[0], StoredObject(value=result, nbytes=_sizeof(result)))
+            return
+        if not isinstance(result, tuple) or len(result) != len(oids):
+            raise ValueError(
+                f"task {spec.function.repr_name} declared num_returns="
+                f"{len(oids)} but returned {type(result).__name__}"
+            )
+        for oid, value in zip(oids, result):
+            self.store.put(oid, StoredObject(value=value, nbytes=_sizeof(value)))
+
+    def _store_error(self, spec: TaskSpec, error: BaseException):
+        for oid in spec.return_ids():
+            self.store.put(oid, StoredObject(error=error))
+
+    # ----------------------------------------------------------------- actors
+    def _release_actor_resources(self, actor: "LocalActor"):
+        """Release an actor's lifetime resources exactly once."""
+        with self._lock:
+            if actor.resources_released or actor.lifetime_resources.is_empty():
+                actor.resources_released = True
+                return
+            actor.resources_released = True
+            self.node.release(actor.lifetime_resources)
+            self._resource_cv.notify_all()
+        self._dispatch()
+
+    def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
+        actor = LocalActor(
+            spec.actor_id, spec.name, self,
+            max_concurrency=spec.max_concurrency,
+            is_asyncio=spec.is_asyncio,
+            lifetime_resources=spec.resources,
+        )
+        actor.class_info = (
+            cls.__name__,
+            cls.__module__,
+            tuple(n for n in dir(cls) if not n.startswith("_")),
+        )
+        with self._lock:
+            if spec.name:
+                if spec.name in self._named_actors:
+                    raise ValueError(f"actor name {spec.name!r} already taken")
+                self._named_actors[spec.name] = spec.actor_id
+            self._actors[spec.actor_id] = actor
+            self._actor_seq[spec.actor_id] = itertools.count()
+        # Reserve lifetime resources (may block-free fail: queue until free).
+        if not spec.resources.is_empty():
+            with self._resource_cv:
+                while not self.node.acquire(spec.resources):
+                    self._resource_cv.wait(timeout=1.0)
+        actor.start(spec, cls, args, kwargs)
+        return spec.actor_id
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        with self._lock:
+            actor = self._actors.get(spec.actor_id)
+            seq = self._actor_seq.get(spec.actor_id)
+        if actor is None:
+            for oid in spec.return_ids():
+                self.store.put(oid, StoredObject(error=ActorDiedError(spec.actor_id)))
+            return refs
+        actor.submit(next(seq), spec)
+        return refs
+
+    def get_actor(self, name: str) -> ActorID:
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+        if actor_id is None:
+            raise ValueError(f"no actor named {name!r}")
+        return actor_id
+
+    def actor_handle_alive(self, actor_id: ActorID) -> bool:
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        return actor is not None and not actor.dead
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        actor.kill(no_restart)
+        self._release_actor_resources(actor)  # idempotent on repeated kill()
+        with self._lock:
+            if actor.name:
+                self._named_actors.pop(actor.name, None)
+
+    def actor_class_info(self, actor_id: ActorID):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        if actor is None:
+            raise ValueError(f"unknown actor {actor_id}")
+        return actor.class_info
+
+    # ---------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        ctx = ensure_context(self)
+        oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
+        self.store.put(oid, StoredObject(value=value, nbytes=_sizeof(value)))
+        return ObjectRef(oid)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        oids = [r.id for r in refs]
+        objs = self._blocking_get(oids, timeout)
+        out = []
+        for obj in objs:
+            if obj.error is not None:
+                raise obj.error
+            out.append(obj.value)
+        return out
+
+    def _blocking_get(self, oids: Sequence[ObjectID], timeout: Optional[float]):
+        """Get that releases the calling task's resources while blocked.
+
+        Reference protocol: HandleDirectCallTaskBlocked/Unblocked
+        (node_manager.h:385-392). On unblock we oversubscribe rather than wait,
+        exactly as the reference re-acquires CPU for unblocked workers.
+        """
+        if all(self.store.contains(oid) for oid in oids):
+            return self.store.get(oids, timeout=0.01)
+        ctx = current_context()
+        released = None
+        if ctx is not None and ctx.acquired is not None and not ctx.acquired.is_empty():
+            released = ctx.acquired
+            with self._lock:
+                self.node.release(released)
+                self._resource_cv.notify_all()
+            self._dispatch()
+        try:
+            return self.store.get(oids, timeout=timeout)
+        finally:
+            if released is not None:
+                with self._lock:
+                    # Oversubscribe: force re-acquire without waiting.
+                    self.node.available = self.node.available.subtract(released)
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        oids = [r.id for r in refs]
+        by_id = {r.id: r for r in refs}
+        ready, rest = self.store.wait(oids, num_returns, timeout)
+        return [by_id[o] for o in ready], [by_id[o] for o in rest]
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def on_ready(_oid):
+            obj = self.store.get_if_exists(ref.id)
+            if obj.error is not None:
+                fut.set_exception(obj.error)
+            else:
+                fut.set_result(obj.value)
+
+        self.store.on_available(ref.id, on_ready)
+        return fut
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        task_id = ref.id.task_id()
+        with self._lock:
+            pending = self._pending.get(task_id)
+            if pending is not None:
+                pending.cancelled = True
+        if pending is not None and pending.future is None:
+            self._store_error(pending.spec, TaskCancelledError(task_id))
+
+    # ------------------------------------------------------------------ state
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.node.total.to_dict()
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            return self.node.available.to_dict()
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return [{
+            "NodeID": self.node_id.hex(),
+            "Alive": True,
+            "Resources": self.node.total.to_dict(),
+        }]
+
+    def actors(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                aid.hex(): {
+                    "ActorID": aid.hex(),
+                    "State": "DEAD" if a.dead else "ALIVE",
+                    "Name": a.name,
+                }
+                for aid, a in self._actors.items()
+            }
+
+    def next_task_id(self) -> TaskID:
+        ctx = ensure_context(self)
+        return TaskID.for_normal_task(
+            ctx.job_id, ctx.current_task_id, next(ctx.task_counter)
+        )
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            actors = list(self._actors.values())
+        for actor in actors:
+            actor.kill()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _sizeof(value: Any) -> int:
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value.nbytes
+        if hasattr(value, "nbytes"):
+            return int(value.nbytes)
+        if isinstance(value, (bytes, bytearray)):
+            return len(value)
+    except Exception:  # pragma: no cover
+        pass
+    return 64  # nominal accounting for small python objects

@@ -1,0 +1,27 @@
+"""Process-global worker state (reference: python/ray/worker.py Worker :83)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Worker:
+    def __init__(self):
+        self.core = None          # LocalRuntime or cluster CoreWorker
+        self.mode: Optional[str] = None  # "local" | "driver" | "worker"
+        self.connected = False
+
+    def check_connected(self):
+        if not self.connected:
+            raise RuntimeError(
+                "ray_tpu.init() must be called before using the API"
+            )
+
+
+_worker = Worker()
+_lock = threading.Lock()
+
+
+def global_worker() -> Worker:
+    return _worker

@@ -1,0 +1,102 @@
+"""Exception hierarchy for ray_tpu.
+
+Mirrors the user-visible error surface of the reference (reference:
+``python/ray/exceptions.py`` and ``src/ray/common/status.h``): task errors wrap
+the remote traceback, actor errors mark a dead/restarting actor, object loss and
+worker crashes are distinct so retry/recovery layers can react differently.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception; re-raised at ``get()`` on the caller.
+
+    Holds the remote traceback text so the driver sees where the failure
+    happened (reference behavior: RayTaskError in python/ray/exceptions.py).
+    """
+
+    def __init__(self, function_name: str, cause: BaseException,
+                 remote_traceback: Optional[str] = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = remote_traceback or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(
+            f"task {function_name} failed: {type(cause).__name__}: {cause}\n"
+            f"remote traceback:\n{self.remote_traceback}"
+        )
+
+
+class ActorError(RayTpuError):
+    """An actor task cannot complete because the actor died."""
+
+    def __init__(self, actor_id=None, message="The actor died unexpectedly"):
+        self.actor_id = actor_id
+        super().__init__(f"{message} (actor_id={actor_id})")
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed."""
+
+    def __init__(self, object_id, message="Object lost"):
+        self.object_id = object_id
+        super().__init__(f"{message}: {object_id}")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing a task died mid-execution."""
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(timeout=...)`` expired before the object was ready."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task was cancelled (task_id={task_id})")
+
+
+class RuntimeEnvError(RayTpuError):
+    pass
+
+
+__all__ = [
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "ObjectLostError",
+    "ObjectStoreFullError",
+    "WorkerCrashedError",
+    "NodeDiedError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+    "RuntimeEnvError",
+]

@@ -1,0 +1,126 @@
+"""@remote functions.
+
+Reference: ``python/ray/remote_function.py`` — a decorated function becomes a
+RemoteFunction whose ``.remote(*args)`` builds a TaskSpec and submits it;
+``.options(...)`` overrides resources/returns per-call site.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+from ._private.ids import ObjectID
+from ._private.resources import ResourceSet
+from ._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
+from ._private.worker import global_worker
+from .object_ref import ObjectRef
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, *, num_returns: int = 1,
+                 num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_retries: Optional[int] = None, name: Optional[str] = None):
+        self._function = fn
+        self._name = name or getattr(fn, "__qualname__", repr(fn))
+        self._module = getattr(fn, "__module__", "__main__")
+        self._num_returns = num_returns
+        res = dict(resources or {})
+        res.setdefault("CPU", 1 if num_cpus is None else num_cpus)
+        if num_tpus:
+            res["TPU"] = num_tpus
+        self._resources = ResourceSet.from_dict(res)
+        self._max_retries = max_retries
+        self._descriptor = FunctionDescriptor(self._module, self._name)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name} cannot be called directly; "
+            f"use {self._name}.remote()."
+        )
+
+    def options(self, *, num_returns: Optional[int] = None,
+                num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+                resources: Optional[Dict[str, float]] = None,
+                max_retries: Optional[int] = None, name: Optional[str] = None):
+        """Per-call-site overrides; returns a submit-only wrapper."""
+        parent = self
+
+        class _Options:
+            def remote(self, *args, **kwargs):
+                return parent._remote(
+                    args, kwargs,
+                    num_returns=num_returns, num_cpus=num_cpus, num_tpus=num_tpus,
+                    resources=resources, max_retries=max_retries, name=name,
+                )
+
+        return _Options()
+
+    def remote(self, *args, **kwargs) -> Any:
+        return self._remote(args, kwargs)
+
+    def _remote(self, args, kwargs, *, num_returns=None, num_cpus=None,
+                num_tpus=None, resources=None, max_retries=None, name=None):
+        worker = global_worker()
+        worker.check_connected()
+        core = worker.core
+        from ._private.config import get_config
+
+        if num_cpus is not None or num_tpus is not None or resources is not None:
+            res = dict(resources or {})
+            res.setdefault("CPU", 1 if num_cpus is None else num_cpus)
+            if num_tpus:
+                res["TPU"] = num_tpus
+            resource_set = ResourceSet.from_dict(res)
+        else:
+            resource_set = self._resources
+
+        task_id = core.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=core.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function=self._descriptor,
+            args=[_pack_arg(a) for a in args],
+            num_returns=num_returns if num_returns is not None else self._num_returns,
+            resources=resource_set,
+            max_retries=(
+                max_retries if max_retries is not None
+                else (self._max_retries if self._max_retries is not None
+                      else get_config().max_retries_default)
+            ),
+            name=name or self._name,
+            metadata={"kwargs": kwargs} if kwargs else {},
+        )
+        refs = core.submit_task(self._function, spec)
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def _pack_arg(arg):
+    if isinstance(arg, ObjectRef):
+        return ("ref", arg.id)
+    return ("value", arg)
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_returns=...)`` decorator.
+
+    Dispatches to RemoteFunction for functions and ActorClass for classes
+    (reference: python/ray/worker.py:1799 make_decorator).
+    """
+    from .actor import ActorClass
+
+    def make(target):
+        if isinstance(target, type):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword arguments only")
+    return make

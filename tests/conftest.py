@@ -1,0 +1,37 @@
+"""Shared pytest fixtures.
+
+Tests run on a virtual 8-device CPU platform so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the multi-chip
+path; the bench runs on the real chip). These env vars must be set before jax
+initializes its backends, hence the top-of-conftest placement.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_ray():
+    """An initialized local-mode runtime, shut down afterwards."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provide 8 virtual CPU devices"
+    return devices

@@ -1,0 +1,393 @@
+"""Core API behavior tests.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` and
+``test_actor.py``: task submission, dependencies, errors, wait, nested tasks,
+actors (state, ordering, concurrency, asyncio, kill), named actors.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _runtime():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestTasks:
+    def test_simple_task(self):
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(1)) == 2
+
+    def test_fanout(self):
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        refs = [sq.remote(i) for i in range(100)]
+        assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+    def test_dependency_chain(self):
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        ref = ray_tpu.put(0)
+        for _ in range(50):
+            ref = inc.remote(ref)
+        assert ray_tpu.get(ref) == 50
+
+    def test_multiple_returns(self):
+        @ray_tpu.remote(num_returns=3)
+        def three():
+            return 1, 2, 3
+
+        a, b, c = three.remote()
+        assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+    def test_kwargs(self):
+        @ray_tpu.remote
+        def f(a, b=10):
+            return a + b
+
+        assert ray_tpu.get(f.remote(1)) == 11
+        assert ray_tpu.get(f.remote(1, b=2)) == 3
+
+    def test_ref_kwarg(self):
+        @ray_tpu.remote
+        def f(a, b=0):
+            return a + b
+
+        r = ray_tpu.put(5)
+        assert ray_tpu.get(f.remote(1, b=r)) == 6
+
+    def test_task_error_propagates(self):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(ray_tpu.TaskError, match="kaboom"):
+            ray_tpu.get(boom.remote())
+
+    def test_error_propagates_through_chain(self):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("root cause")
+
+        @ray_tpu.remote
+        def passthrough(x):
+            return x
+
+        with pytest.raises(ray_tpu.TaskError, match="root cause"):
+            ray_tpu.get(passthrough.remote(passthrough.remote(boom.remote())))
+
+    def test_nested_tasks(self):
+        @ray_tpu.remote
+        def leaf(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def parent(x):
+            return sum(ray_tpu.get([leaf.remote(i) for i in range(x)]))
+
+        assert ray_tpu.get(parent.remote(5)) == 20
+
+    def test_deeply_nested_does_not_deadlock(self):
+        # More nesting levels than CPU slots: requires blocked-task resource
+        # release (reference: HandleDirectCallTaskBlocked).
+        @ray_tpu.remote
+        def rec(n):
+            if n == 0:
+                return 0
+            return ray_tpu.get(rec.remote(n - 1)) + 1
+
+        assert ray_tpu.get(rec.remote(20)) == 20
+
+    def test_options_override(self):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.options(num_cpus=2).remote()) == 1
+
+    def test_numpy_roundtrip(self):
+        @ray_tpu.remote
+        def double(a):
+            return a * 2
+
+        arr = np.arange(1000, dtype=np.float32)
+        out = ray_tpu.get(double.remote(arr))
+        np.testing.assert_array_equal(out, arr * 2)
+
+    def test_direct_call_raises(self):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with pytest.raises(TypeError):
+            f()
+
+
+class TestPutGetWait:
+    def test_put_get(self):
+        ref = ray_tpu.put({"a": [1, 2, 3]})
+        assert ray_tpu.get(ref) == {"a": [1, 2, 3]}
+
+    def test_put_objectref_rejected(self):
+        with pytest.raises(TypeError):
+            ray_tpu.put(ray_tpu.put(1))
+
+    def test_get_timeout(self):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(5)
+
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            ray_tpu.get(slow.remote(), timeout=0.1)
+
+    def test_wait_basic(self):
+        @ray_tpu.remote
+        def fast():
+            return 1
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(2)
+            return 2
+
+        f, s = fast.remote(), slow.remote()
+        ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=1.0)
+        assert ready == [f] and not_ready == [s]
+
+    def test_wait_timeout_returns_partial(self):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(5)
+
+        refs = [slow.remote() for _ in range(3)]
+        ready, not_ready = ray_tpu.wait(refs, num_returns=3, timeout=0.1)
+        assert ready == [] and len(not_ready) == 3
+
+    def test_wait_duplicate_rejected(self):
+        r = ray_tpu.put(1)
+        with pytest.raises(ValueError):
+            ray_tpu.wait([r, r])
+
+    def test_await_objectref(self):
+        import asyncio
+
+        @ray_tpu.remote
+        def f():
+            return 41
+
+        async def main():
+            return await f.remote() + 1
+
+        assert asyncio.run(main()) == 42
+
+
+class TestActors:
+    def test_counter(self):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        results = ray_tpu.get([c.inc.remote() for _ in range(10)])
+        assert results == list(range(1, 11))  # ordered execution
+
+    def test_constructor_args(self):
+        @ray_tpu.remote
+        class Adder:
+            def __init__(self, base):
+                self.base = base
+
+            def add(self, x):
+                return self.base + x
+
+        a = Adder.remote(100)
+        assert ray_tpu.get(a.add.remote(1)) == 101
+
+    def test_constructor_ref_args(self):
+        @ray_tpu.remote
+        class Holder:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        h = Holder.remote(ray_tpu.put(7))
+        assert ray_tpu.get(h.get.remote()) == 7
+
+    def test_actor_error(self):
+        @ray_tpu.remote
+        class A:
+            def boom(self):
+                raise RuntimeError("actor oops")
+
+        a = A.remote()
+        with pytest.raises(ray_tpu.TaskError, match="actor oops"):
+            ray_tpu.get(a.boom.remote())
+
+    def test_creation_error_propagates(self):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise ValueError("cannot build")
+
+            def m(self):
+                return 1
+
+        b = Broken.remote()
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(b.m.remote(), timeout=5)
+
+    def test_kill(self):
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_tpu.kill(a)
+        with pytest.raises(ray_tpu.ActorError):
+            ray_tpu.get(a.ping.remote(), timeout=5)
+
+    def test_named_actor(self):
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.data = {}
+
+            def set(self, k, v):
+                self.data[k] = v
+
+            def get(self, k):
+                return self.data.get(k)
+
+        Registry.options(name="registry").remote()
+        h = ray_tpu.get_actor("registry")
+        ray_tpu.get(h.set.remote("x", 1))
+        assert ray_tpu.get(h.get.remote("x")) == 1
+
+    def test_handle_passing(self):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote
+        def bump(counter):
+            return ray_tpu.get(counter.inc.remote())
+
+        c = Counter.remote()
+        ray_tpu.get([bump.remote(c) for _ in range(5)])
+        assert ray_tpu.get(c.inc.remote()) == 6
+
+    def test_max_concurrency(self):
+        @ray_tpu.remote(max_concurrency=4)
+        class Slow:
+            def work(self):
+                time.sleep(0.3)
+                return 1
+
+        s = Slow.remote()
+        t0 = time.monotonic()
+        ray_tpu.get([s.work.remote() for _ in range(4)])
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0  # concurrent, not 1.2s serial
+
+    def test_asyncio_actor(self):
+        import asyncio
+
+        @ray_tpu.remote
+        class AsyncWorker:
+            async def work(self, i):
+                await asyncio.sleep(0.2)
+                return i
+
+        w = AsyncWorker.remote()
+        t0 = time.monotonic()
+        out = ray_tpu.get([w.work.remote(i) for i in range(5)])
+        elapsed = time.monotonic() - t0
+        assert sorted(out) == list(range(5))
+        assert elapsed < 0.9  # overlapped on the event loop
+
+
+class TestClusterState:
+    def test_resources(self):
+        total = ray_tpu.cluster_resources()
+        assert total["CPU"] == 8.0
+        avail = ray_tpu.available_resources()
+        assert avail["CPU"] <= total["CPU"]
+
+    def test_nodes(self):
+        ns = ray_tpu.nodes()
+        assert len(ns) == 1 and ns[0]["Alive"]
+
+    def test_timeline(self):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get([f.remote() for _ in range(3)])
+        events = ray_tpu.timeline()
+        assert any(e["cat"] == "task" for e in events)
+
+    def test_resource_limit_respected(self):
+        # 8 CPUs, tasks take 2 each => at most 4 concurrent.
+        import threading
+
+        peak = [0]
+        live = [0]
+        lock = threading.Lock()
+
+        @ray_tpu.remote(num_cpus=2)
+        def busy():
+            with lock:
+                live[0] += 1
+                peak[0] = max(peak[0], live[0])
+            time.sleep(0.2)
+            with lock:
+                live[0] -= 1
+            return 1
+
+        ray_tpu.get([busy.remote() for _ in range(8)])
+        assert peak[0] <= 4
+
+    def test_cancel_pending(self):
+        @ray_tpu.remote(num_cpus=8)
+        def hog():
+            time.sleep(1.0)
+            return 1
+
+        @ray_tpu.remote(num_cpus=8)
+        def victim():
+            return 2
+
+        h = hog.remote()
+        v = victim.remote()  # queued behind hog
+        ray_tpu.cancel(v)
+        with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.GetTimeoutError)):
+            ray_tpu.get(v, timeout=3)
+        assert ray_tpu.get(h) == 1

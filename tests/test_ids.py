@@ -1,0 +1,201 @@
+"""Substrate tests: IDs, resources, task specs, serialization.
+
+Modeled on the shape of the reference's pure-unit C++ tests (reference:
+``src/ray/common/common_tests`` and ``scheduling/scheduling_test.cc``).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    UniqueID,
+)
+from ray_tpu._private.resources import (
+    NUM_PREDEFINED,
+    NodeResources,
+    ResourceSet,
+    dense_matrix,
+)
+from ray_tpu._private.task_spec import (
+    FunctionDescriptor,
+    TaskSpec,
+    TaskType,
+    scheduling_class_of,
+)
+
+
+class TestIDs:
+    def test_sizes_and_roundtrip(self):
+        for cls in (UniqueID, NodeID, JobID, ActorID, TaskID, ObjectID):
+            rid = cls.from_random()
+            assert len(rid.binary()) == cls.SIZE
+            assert cls.from_hex(rid.hex()) == rid
+            assert pickle.loads(pickle.dumps(rid)) == rid
+            assert cls.nil().is_nil() and not rid.is_nil()
+
+    def test_task_id_lineage(self):
+        job = JobID.from_int(1)
+        driver = TaskID.for_driver_task(job)
+        t1 = TaskID.for_normal_task(job, driver, 1)
+        t2 = TaskID.for_normal_task(job, driver, 2)
+        assert t1 != t2
+        assert t1 == TaskID.for_normal_task(job, driver, 1)  # deterministic
+        assert t1.job_id() == job
+
+    def test_object_id_derivation(self):
+        job = JobID.from_int(7)
+        task = TaskID.for_normal_task(job, TaskID.for_driver_task(job), 1)
+        ret1 = ObjectID.for_task_return(task, 1)
+        ret2 = ObjectID.for_task_return(task, 2)
+        put1 = ObjectID.for_put(task, 1)
+        assert ret1.task_id() == task and ret2.task_id() == task
+        assert ret1.index() == 1 and ret2.index() == 2 and put1.index() == -1
+        assert ret1.is_return() and put1.is_put()
+        assert len({ret1, ret2, put1}) == 3
+
+    def test_actor_id(self):
+        job = JobID.from_int(3)
+        driver = TaskID.for_driver_task(job)
+        a = ActorID.of(job, driver, 5)
+        assert a.job_id() == job
+        creation = TaskID.for_actor_creation_task(a)
+        assert creation.job_id() == job
+
+
+class TestResources:
+    def test_from_dict_aliases(self):
+        rs = ResourceSet.from_dict({"CPU": 2, "GPU": 1, "memory": 0.5, "accel": 3})
+        d = rs.to_dict()
+        assert d["CPU"] == 2.0
+        assert d["TPU"] == 1.0  # GPU aliases to TPU slot
+        assert d["memory"] == 0.5
+        assert d["accel"] == 3.0
+
+    def test_subset_fractional_exact(self):
+        avail = ResourceSet.from_dict({"CPU": 1})
+        half = ResourceSet.from_dict({"CPU": 0.5})
+        assert half.is_subset_of(avail)
+        rem = avail.subtract(half)
+        assert half.is_subset_of(rem)
+        rem2 = rem.subtract(half)
+        assert not half.is_subset_of(rem2)
+        assert rem2.is_empty()
+
+    def test_custom_resources(self):
+        avail = ResourceSet.from_dict({"CPU": 4, "slot": 2})
+        demand = ResourceSet.from_dict({"slot": 1})
+        assert demand.is_subset_of(avail)
+        assert not ResourceSet.from_dict({"slot": 3}).is_subset_of(avail)
+        assert not ResourceSet.from_dict({"other": 1}).is_subset_of(avail)
+
+    def test_node_resources_acquire_release(self):
+        node = NodeResources(ResourceSet.from_dict({"CPU": 2}))
+        one = ResourceSet.from_dict({"CPU": 1})
+        assert node.acquire(one) and node.acquire(one)
+        assert not node.acquire(one)
+        node.release(one)
+        assert node.acquire(one)
+
+    def test_dense_matrix(self):
+        sets = [
+            ResourceSet.from_dict({"CPU": 1}),
+            ResourceSet.from_dict({"CPU": 2, "slot": 1}),
+        ]
+        mat = dense_matrix(sets, custom_names=("slot",))
+        assert mat.shape == (2, NUM_PREDEFINED + 1)
+        assert mat[0, 0] == 1000 and mat[1, 0] == 2000 and mat[1, -1] == 1000
+
+
+class TestTaskSpec:
+    def _spec(self, resources=None, fn="mod.f"):
+        job = JobID.from_int(1)
+        task = TaskID.for_normal_task(job, TaskID.for_driver_task(job), 1)
+        return TaskSpec(
+            task_id=task,
+            job_id=job,
+            task_type=TaskType.NORMAL_TASK,
+            function=FunctionDescriptor("mod", fn),
+            args=[("value", 1), ("ref", ObjectID.for_task_return(task, 1))],
+            num_returns=2,
+            resources=resources or ResourceSet.from_dict({"CPU": 1}),
+        )
+
+    def test_scheduling_class_interning(self):
+        a = self._spec()
+        b = self._spec()
+        c = self._spec(resources=ResourceSet.from_dict({"CPU": 2}))
+        d = self._spec(fn="mod.g")
+        assert a.scheduling_class == b.scheduling_class
+        assert a.scheduling_class != c.scheduling_class
+        assert a.scheduling_class != d.scheduling_class
+        sc = scheduling_class_of(ResourceSet.from_dict({"CPU": 1}), "mod.f.mod.f")
+
+    def test_returns_and_deps(self):
+        spec = self._spec()
+        rets = spec.return_ids()
+        assert len(rets) == 2 and rets[0].index() == 1 and rets[1].index() == 2
+        assert len(spec.dependencies()) == 1
+
+
+class TestSerialization:
+    def test_roundtrip_python(self):
+        from ray_tpu._private.serialization import get_context
+
+        ctx = get_context()
+        for value in [1, "x", [1, 2, {"a": (3, None)}], {"k": b"bytes"}]:
+            out = ctx.deserialize(ctx.serialize(value))
+            assert out == value
+
+    def test_numpy_zero_copy(self):
+        from ray_tpu._private.serialization import get_context
+
+        ctx = get_context()
+        arr = np.arange(1 << 16, dtype=np.float32)
+        ser = ctx.serialize({"w": arr})
+        assert len(ser.buffers) >= 1  # out-of-band, not in the pickle stream
+        out = ctx.deserialize(ser)
+        np.testing.assert_array_equal(out["w"], arr)
+
+    def test_jax_array_roundtrip(self):
+        import jax.numpy as jnp
+
+        from ray_tpu._private.serialization import get_context
+
+        ctx = get_context()
+        arr = jnp.arange(128, dtype=jnp.float32) * 2
+        ser = ctx.serialize([arr, {"nested": arr * 0 + 1}])
+        flat = ser.to_bytes()
+        restored = ctx.deserialize(type(ser).from_bytes(flat))
+        np.testing.assert_array_equal(np.asarray(restored[0]), np.asarray(arr))
+        assert float(restored[1]["nested"][3]) == 1.0
+
+    def test_closure(self):
+        from ray_tpu._private.serialization import get_context
+
+        ctx = get_context()
+        y = 10
+        f = lambda x: x + y  # noqa: E731
+        g = ctx.deserialize(ctx.serialize(f))
+        assert g(5) == 15
+
+    def test_custom_serializer(self):
+        from ray_tpu._private.serialization import SerializationContext
+
+        class Weird:
+            def __init__(self, v):
+                self.v = v
+
+            def __reduce__(self):
+                raise TypeError("not picklable")
+
+        ctx = SerializationContext()
+        ctx.register_custom_serializer(Weird, lambda w: w.v, lambda v: Weird(v))
+        out = ctx.deserialize(ctx.serialize([Weird(42)]))
+        assert out[0].v == 42
