@@ -1,0 +1,72 @@
+"""North-star benchmark: batch placement kernel throughput.
+
+Workload (BASELINE.json): schedule a 100k-task random DAG onto 256 simulated
+nodes. The reference's closest published number is ~6,600 cluster-wide
+scheduled tasks/s (101-node stress test, stage 1 of
+``ci/regression_test/stress_tests/test_many_tasks.py``; see BASELINE.md).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from ray_tpu.scheduler import random_dag, schedule_dag, uniform_cluster
+
+BASELINE_TASKS_PER_SEC = 6600.0  # BASELINE.md stage 1 (~6.6k cluster-wide)
+
+
+def main():
+    num_tasks = 100_000
+    num_nodes = 256
+    chunk = 8192
+
+    # Classic uniform random DAG (parents drawn from all predecessors);
+    # critical-path depth ~60 at this size. The windowed variant
+    # (parent_window=1024, depth ~374) is a harder secondary config — see
+    # tests/test_scheduler.py.
+    demand_np, parents_np = random_dag(
+        num_tasks, max_parents=3, parent_window=num_tasks, seed=0
+    )
+    avail_np = uniform_cluster(num_nodes, cpu=16.0)
+
+    demand = jax.device_put(np.asarray(demand_np))
+    parents = jax.device_put(np.asarray(parents_np))
+    avail = jax.device_put(np.asarray(avail_np))
+    key = jax.random.PRNGKey(0)
+
+    # Warmup/compile.
+    placement, rounds = schedule_dag(demand, parents, avail, key, chunk=chunk)
+    placement.block_until_ready()
+    n_placed = int((np.asarray(placement) >= 0).sum())
+    if n_placed != num_tasks:
+        print(f"WARNING: only {n_placed}/{num_tasks} tasks placed", file=sys.stderr)
+
+    reps = 5
+    times = []
+    for i in range(reps):
+        k = jax.random.PRNGKey(i)
+        t0 = time.perf_counter()
+        placement, rounds = schedule_dag(demand, parents, avail, k, chunk=chunk)
+        # Host transfer as the completion barrier (block_until_ready alone is
+        # not reliable on the axon platform).
+        np.asarray(placement)
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    tasks_per_sec = num_tasks / best
+    print(json.dumps({
+        "metric": "scheduled_tasks_per_sec_100k_dag_256_nodes",
+        "value": round(tasks_per_sec, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(tasks_per_sec / BASELINE_TASKS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""TPU-native batch placement scheduler (the north-star kernel).
+
+The reference schedules tasks one at a time in a C++ loop
+(reference: ``src/ray/raylet/scheduling_policy.cc:31-134``). Here the whole
+pending set is batched into dense tensors and placed by a jit-compiled kernel
+(kernel.py); reference.py is the scalar spec implementation that the kernel
+must match bit-for-bit; dag.py generates benchmark DAGs.
+"""
+
+from .kernel import BatchScheduler, schedule_dag  # noqa: F401
+from .reference import schedule_dag_reference  # noqa: F401
+from .dag import random_dag, uniform_cluster  # noqa: F401

@@ -1,0 +1,79 @@
+"""Benchmark DAG and cluster generators.
+
+Workload shapes mirror the reference's stress suite
+(``ci/regression_test/stress_tests/test_many_tasks.py``): wide no-op fan-outs
+(stage 1), chained dependency rounds (stage 2), plus mixed-class random DAGs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .._private.resources import KILO, NUM_PREDEFINED
+
+
+def uniform_cluster(num_nodes: int, cpu: float = 16.0, mem_gb: float = 64.0,
+                    tpu: float = 0.0) -> np.ndarray:
+    """[N, R] availability matrix in fixed-point kilo-units."""
+    avail = np.zeros((num_nodes, NUM_PREDEFINED), dtype=np.int32)
+    avail[:, 0] = int(cpu * KILO)
+    avail[:, 1] = int(mem_gb * KILO)
+    avail[:, 2] = int(tpu * KILO)
+    return avail
+
+
+def random_dag(
+    num_tasks: int,
+    max_parents: int = 3,
+    num_classes: int = 4,
+    parent_window: int = 1024,
+    edge_prob: float = 0.5,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Random layered DAG: (demand [T, R], parents [T, K]).
+
+    Task t draws parents from the preceding ``parent_window`` tasks, so depth
+    grows with T while keeping wide waves (the scheduling-heavy regime).
+    Demands are drawn from ``num_classes`` scheduling classes (CPU 0.5-4).
+    """
+    rng = np.random.default_rng(seed)
+    T, K = num_tasks, max_parents
+
+    classes = np.zeros((num_classes, NUM_PREDEFINED), dtype=np.int32)
+    classes[:, 0] = rng.choice([KILO // 2, KILO, 2 * KILO, 4 * KILO], num_classes)
+    classes[:, 1] = rng.integers(KILO // 4, 4 * KILO, num_classes)
+    demand = classes[rng.integers(0, num_classes, T)]
+
+    parents = np.full((T, K), -1, dtype=np.int32)
+    has_parent = rng.random((T, K)) < edge_prob
+    lo = np.maximum(0, np.arange(T) - parent_window)
+    span = np.maximum(1, np.arange(T) - lo)
+    draws = lo[:, None] + (rng.random((T, K)) * span[:, None]).astype(np.int64)
+    mask = has_parent & (np.arange(T) > 0)[:, None]
+    parents[mask] = draws[mask].astype(np.int32)
+    return demand, parents
+
+
+def fanout_dag(num_tasks: int, cpu: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
+    """Stage-1 shape: independent no-op tasks (test_many_tasks.py:63-66)."""
+    demand = np.zeros((num_tasks, NUM_PREDEFINED), dtype=np.int32)
+    demand[:, 0] = int(cpu * KILO)
+    parents = np.full((num_tasks, 1), -1, dtype=np.int32)
+    return demand, parents
+
+
+def chain_rounds_dag(rounds: int, width: int,
+                     cpu: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
+    """Stage-2 shape: each round's tasks depend on the previous round
+    (test_many_tasks.py:75-86: 20 rounds x 500 tasks)."""
+    T = rounds * width
+    demand = np.zeros((T, NUM_PREDEFINED), dtype=np.int32)
+    demand[:, 0] = int(cpu * KILO)
+    parents = np.full((T, 1), -1, dtype=np.int32)
+    for r in range(1, rounds):
+        start = r * width
+        # depend on one task of the previous round (ring offset)
+        parents[start : start + width, 0] = np.arange(width) + (r - 1) * width
+    return demand, parents

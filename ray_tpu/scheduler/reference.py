@@ -1,0 +1,76 @@
+"""Scalar reference implementation of the placement spec.
+
+The sequential, obviously-correct version of the kernel's semantics — the
+analogue of running the reference's per-task C++ loop
+(``scheduling_policy.cc:31-134``) against which the batched kernel is
+verified. ``schedule_dag`` (kernel.py) must produce bit-identical placements
+for any input (the BASELINE.json acceptance criterion).
+
+Uses the same threefry draws via ``task_bits`` so randomness matches exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .kernel import INFEASIBLE, NO_PLACEMENT, task_bits_host
+
+
+def schedule_dag_reference(
+    demand: np.ndarray,
+    parents: np.ndarray,
+    avail: np.ndarray,
+    key,
+    locality: Optional[np.ndarray] = None,
+    chunk: int = 8192,
+    max_rounds: int = 0,
+) -> Tuple[np.ndarray, int]:
+    demand = np.asarray(demand, dtype=np.int64)
+    parents = np.asarray(parents, dtype=np.int64)
+    avail = np.asarray(avail, dtype=np.int64)
+    T, R = demand.shape
+    N = avail.shape[0]
+    if max_rounds <= 0:
+        max_rounds = T + 1
+    if locality is None:
+        locality = np.full(T, -1, dtype=np.int64)
+
+    feas_any = (demand[:, None, :] <= avail[None, :, :]).all(-1).any(-1)
+    placement = np.where(feas_any, NO_PLACEMENT, INFEASIBLE).astype(np.int64)
+
+    round_idx = 0
+    while round_idx < max_rounds:
+        placed = placement >= 0
+        parent_ok = np.ones(T, dtype=bool)
+        for k in range(parents.shape[1]):
+            p = parents[:, k]
+            has = p >= 0
+            parent_ok &= ~has | placed[np.clip(p, 0, T - 1)]
+        ready = (placement == NO_PLACEMENT) & parent_ok
+        ready_idx = np.nonzero(ready)[0][:chunk]
+        if len(ready_idx) == 0:
+            break
+
+        bits = task_bits_host(key, round_idx, np.asarray(ready_idx), chunk)
+        # Prefix-sum admission: accumulate the demand of every task that
+        # *prefers* a node (admitted or not), in submission order.
+        prefix = np.zeros((N, R), dtype=np.int64)
+        for j, t in enumerate(ready_idx):
+            feas = (demand[t] <= avail).all(axis=1)
+            cnt = int(feas.sum())
+            if cnt == 0:
+                continue
+            r = int(bits[j] % np.uint32(cnt))
+            pick = int(np.nonzero(feas)[0][r])
+            loc = int(locality[t])
+            if loc >= 0 and feas[loc]:
+                pick = loc
+            prefix[pick] += demand[t]
+            if (prefix[pick] <= avail[pick]).all():
+                placement[t] = pick
+            # else: deferred; retries next round with a fresh draw
+        round_idx += 1
+
+    return placement.astype(np.int32), round_idx
