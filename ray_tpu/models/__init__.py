@@ -1,0 +1,10 @@
+"""Model zoo: TPU-native reference models built on ray_tpu.parallel."""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_shardings,
+)

@@ -1,0 +1,216 @@
+"""Flagship model: decoder-only transformer LM, TPU-first.
+
+Llama-style architecture (RMSNorm, RoPE, SwiGLU, GQA) written as plain jax
+pytrees with explicit shardings so every parallelism axis is real:
+
+  dp — batch sharded, gradients psum'd by GSPMD
+  tp — heads/ffn/vocab sharded (megatron layout: column then row parallel)
+  sp — sequence sharded; attention runs as ring attention over the sp axis
+  pp — pipeline stages (ray_tpu.parallel.pipeline)
+
+Layers are scan-stacked ([L, ...] leading dim) for O(1) compile time in depth.
+The reference framework has no model zoo of its own (RLlib's models are
+torch/TF); this is the TPU-native flagship used by benchmarks and the trainer
+library (ray_tpu/train).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import flash_attention
+from ..parallel.ring_attention import ring_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16     # activation/weight compute dtype
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def validate_for_mesh(self, mesh: Mesh) -> None:
+        tp = mesh.shape["tp"]
+        assert self.n_heads % tp == 0, "n_heads must divide tp"
+        assert self.n_kv_heads % tp == 0, "n_kv_heads must divide tp"
+        assert self.d_ff % tp == 0 and self.vocab_size % tp == 0
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
+    E, H, KH, Dh, F, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                          cfg.head_dim, cfg.d_ff, cfg.n_layers)
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(0.02)
+
+    def layer_init(k):
+        ks = jax.random.split(k, 6)
+        return {
+            "attn_norm": jnp.ones((E,), cfg.param_dtype),
+            "wq": init(ks[0], (E, H * Dh), cfg.param_dtype),
+            "wk": init(ks[1], (E, KH * Dh), cfg.param_dtype),
+            "wv": init(ks[2], (E, KH * Dh), cfg.param_dtype),
+            "wo": init(ks[3], (H * Dh, E), cfg.param_dtype),
+            "mlp_norm": jnp.ones((E,), cfg.param_dtype),
+            "w_gate": init(ks[4], (E, F), cfg.param_dtype),
+            "w_up": init(ks[5], (E, F), cfg.param_dtype),
+            "w_down": init(ks[4], (F, E), cfg.param_dtype),
+        }
+
+    layers = jax.vmap(layer_init)(jax.random.split(k_layers, L))
+    return {
+        "embed": init(k_embed, (cfg.vocab_size, E), cfg.param_dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), cfg.param_dtype),
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
+    """Megatron layout: attention/ffn column-then-row parallel over tp."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "attn_norm": ns(None, None),
+        "wq": ns(None, None, "tp"),
+        "wk": ns(None, None, "tp"),
+        "wv": ns(None, None, "tp"),
+        "wo": ns(None, "tp", None),
+        "mlp_norm": ns(None, None),
+        "w_gate": ns(None, None, "tp"),
+        "w_up": ns(None, None, "tp"),
+        "w_down": ns(None, "tp", None),
+    }
+    return {
+        "embed": ns("tp", None),
+        "layers": layer,
+        "final_norm": ns(None),
+    }
+
+
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, D]; rotate pairs (d, d + D/2)."""
+    B, T, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def _attention(x: jax.Array, layer: Params, cfg: TransformerConfig,
+               mesh: Optional[Mesh], positions: jax.Array) -> jax.Array:
+    B, T, E = x.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    q = (x @ layer["wq"].astype(dt)).reshape(B, T, H, Dh)
+    k = (x @ layer["wk"].astype(dt)).reshape(B, T, KH, Dh)
+    v = (x @ layer["wv"].astype(dt)).reshape(B, T, KH, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        out = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        out = flash_attention(q, k, v, causal=True)
+    out = out.reshape(B, T, H * Dh)
+    return out @ layer["wo"].astype(dt)
+
+
+def _mlp(x: jax.Array, layer: Params, cfg: TransformerConfig) -> jax.Array:
+    dt = cfg.dtype
+    gate = jax.nn.silu(x @ layer["w_gate"].astype(dt))
+    up = x @ layer["w_up"].astype(dt)
+    return (gate * up) @ layer["w_down"].astype(dt)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, V]."""
+    B, T = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]          # [B, T, E]
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None))
+        )
+    positions = jnp.arange(T)
+
+    def block(x, layer):
+        h = x + _attention(
+            _rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg, mesh,
+            positions,
+        )
+        out = h + _mlp(_rms_norm(h, layer["mlp_norm"], cfg.norm_eps), layer, cfg)
+        if mesh is not None:
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, P("dp", "sp", None))
+            )
+        return out, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["embed"].astype(cfg.dtype).T        # [B, T, V]
+    if mesh is not None:
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P("dp", "sp", "tp"))
+        )
+    return logits
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """Next-token cross entropy; batch = {"tokens": [B, T+1]}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - target_logit)
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 3e-4):
+    """Returns (init_opt_state, train_step) with adamw; jit with shardings
+    is applied by the caller (see __graft_entry__.py / ray_tpu.train)."""
+    import optax
+
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def init_opt(params):
+        return tx.init(params)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt, train_step

@@ -1,0 +1,3 @@
+"""TPU compute ops: pallas kernels with XLA fallbacks."""
+
+from .attention import attention_reference, flash_attention  # noqa: F401

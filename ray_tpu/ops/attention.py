@@ -1,0 +1,223 @@
+"""Attention ops: pallas flash-attention kernel + XLA reference.
+
+The pallas kernel implements the standard online-softmax flash attention
+(single pass over KV blocks, f32 running max/sum in VMEM scratch, bf16-friendly
+matmuls on the MXU). It is used on TPU for shapes that tile cleanly; everything
+else (CPU tests, ragged shapes) uses the XLA reference, which XLA fuses well.
+
+Backward: custom_vjp with rematerialized XLA math — correct and memory-lean
+(no score tensor saved); a pallas backward kernel is a later optimization.
+
+Supports GQA: q has H heads, k/v have KH heads with H % KH == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
+    """[B, S, KH, D] -> [B, S, H, D] by repeating each kv head."""
+    kh = k.shape[2]
+    if kh == num_heads:
+        return k
+    return jnp.repeat(k, num_heads // kh, axis=2)
+
+
+def attention_reference(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, KH, D]
+    v: jax.Array,  # [B, S, KH, D]
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention; f32 softmax accumulation regardless of input dtype.
+
+    ``q_offset``/``k_offset`` are global position offsets, used by ring
+    attention where each shard holds a slice of the full sequence.
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    k = _repeat_kv(k, H)
+    v = _repeat_kv(v, H)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(T)[:, None]
+        k_pos = k_offset + jnp.arange(S)[None, :]
+        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, causal: bool, scale: float, block_q: int, block_k: int):
+    """One (batch*head, q_block, k_block) grid step with accumulation.
+
+    Inputs are reshaped to [B*H, T, D] so blocks tile the TPU-native
+    (sublane, lane) = (T, D) layout. Grid order puts the KV axis last, so for
+    a fixed q block we sweep KV blocks sequentially, maintaining the
+    online-softmax state in VMEM scratch (m: running max, l: running sum,
+    acc: unnormalized output).
+    """
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, :, :]                     # [block_q, D]
+        k = k_ref[0, :, :]                     # [block_k, D]
+        v = v_ref[0, :, :]                     # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                               # [block_q, block_k]
+
+        if causal:
+            # Mask only where the block straddles the diagonal.
+            def masked():
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+            straddles = (ki + 1) * block_k - 1 > qi * block_q
+            s2 = jax.lax.cond(straddles, masked, lambda: s)
+        else:
+            s2 = s
+
+        m_prev = m_scr[:]                       # [block_q, 1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s2 - m_new)                 # [block_q, block_k]
+        corr = jnp.exp(m_prev - m_new)          # [block_q, 1]
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    if causal:
+        # Skip blocks entirely above the diagonal (k_start > q_end).
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0, :, :] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int) -> jax.Array:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    group = H // KH
+    scale = D ** -0.5
+
+    # [B, T, H, D] -> [B*H, T, D]: tiles land on the native (T, D) layout.
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
+    grid = (B * H, T // block_q, S // block_k)
+
+    def kv_row(bh, ki, g=group, h_per_b=H, kh_per_b=KH):
+        b, h = bh // h_per_b, bh % h_per_b
+        return (b * kh_per_b + h // g, ki, 0)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_forward(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_forward(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    # Rematerialize through the XLA reference; XLA differentiates it.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool = True, block_q: int = 512, block_k: int = 512,
+) -> jax.Array:
+    """Flash attention with automatic pallas/XLA dispatch.
+
+    Uses the pallas kernel when running on TPU and the shapes tile cleanly;
+    otherwise the XLA reference (identical math).
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    tiles = (T % block_q == 0 and S % block_k == 0 and D % 128 == 0
+             and block_q % 8 == 0 and block_k % 128 == 0
+             and H % k.shape[2] == 0)
+    if on_tpu and tiles:
+        return _flash(q, k, v, causal, block_q, block_k)
+    return attention_reference(q, k, v, causal=causal)

@@ -1,0 +1,10 @@
+"""TPU-native parallelism layer.
+
+Replaces the reference's delegation to torch.distributed gloo/NCCL
+(reference: ``python/ray/util/sgd/torch/distributed_torch_runner.py:35-70``)
+with jax device meshes and XLA collectives over ICI/DCN: data/tensor/sequence
+parallelism via NamedSharding + shard_map, ring attention over the sequence
+axis, pipeline parallelism via collective permute microbatching.
+"""
+
+from .mesh import MeshSpec, make_mesh  # noqa: F401

@@ -1,0 +1,93 @@
+"""Device mesh construction and axis layout.
+
+The mesh is the TPU-native replacement for the reference's process-group
+bootstrap (``distributed_torch_runner.py:35-70`` rendezvous + init_process_group):
+axes are logical parallelism dimensions laid out so the heaviest-traffic axes
+(tp, then sp) map to the innermost (fastest-ICI) device dimensions, and dp/pp
+to the outermost — the standard scaling-book layout.
+
+Axes:
+    dp  — data parallel (gradient psum, outermost / DCN-friendly)
+    pp  — pipeline stages (ppermute of activations)
+    sp  — sequence/context parallel (ring attention collectives)
+    tp  — tensor parallel (allreduce of partial matmuls, innermost / ICI)
+    ep  — expert parallel for MoE layers (all_to_all), aliased onto tp/sp
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "pp", "sp", "tp")  # outermost -> innermost
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp)
+
+    @classmethod
+    def auto(cls, n_devices: int, *, want_tp: int = 0, want_sp: int = 0,
+             want_pp: int = 1) -> "MeshSpec":
+        """Factorize n_devices into a sensible (dp, pp, sp, tp) layout.
+
+        Preference order: give tp what it asks for (bounded by n), then sp,
+        then pp, and put the remainder in dp.
+        """
+        remaining = n_devices
+        pp = want_pp if remaining % max(want_pp, 1) == 0 else 1
+        remaining //= pp
+        tp = want_tp or _largest_divisor(remaining, cap=min(remaining, 8))
+        if remaining % tp != 0:
+            tp = _largest_divisor(remaining, cap=tp)
+        remaining //= tp
+        sp = want_sp or _largest_divisor(remaining, cap=min(remaining, 4))
+        if remaining % sp != 0:
+            sp = _largest_divisor(remaining, cap=sp)
+        remaining //= sp
+        dp = remaining
+        return cls(dp=dp, pp=pp, sp=sp, tp=tp)
+
+
+def _largest_divisor(n: int, cap: int) -> int:
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with AXIS_ORDER axes from the given devices."""
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec.auto(len(devices))
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec} needs {spec.size} devices, got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(spec.axis_sizes())
+    return Mesh(arr, AXIS_ORDER)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -8,12 +8,20 @@ initializes its backends, hence the top-of-conftest placement.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the axon sitecustomize hook sets jax_platforms via
+# jax.config at interpreter startup, which would route tests to the remote TPU
+# tunnel. Override both the env var and the config before any backend
+# initializes (XLA_FLAGS is read at CPU client creation).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
