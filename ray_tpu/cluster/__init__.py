@@ -1,0 +1,20 @@
+"""Multi-process / multi-node cluster runtime.
+
+The distributed control plane equivalent to the reference's raylet + GCS
+(reference: ``src/ray/raylet/``, ``src/ray/gcs/gcs_server/``), re-architected
+TPU-first:
+
+  - one GCS head process: tables (nodes/actors/objects/functions), pubsub,
+    heartbeat death detection, and the global placement service backed by the
+    batch placement kernel (ray_tpu.scheduler.BatchScheduler);
+  - one NodeController per host (the raylet equivalent): worker pool, local
+    object store, dependency fetching, task dispatch;
+  - worker processes executing tasks/actors with the same public API
+    (nested submits route through their node controller).
+
+Transport is a length-prefixed pickle protocol over TCP (protocol.py); bulk
+object payloads ride the same channel chunked. The shared-memory C++ arena
+(ray_tpu/native) backs the local object store when built.
+"""
+
+from .testing import Cluster  # noqa: F401

@@ -1,0 +1,420 @@
+"""NodeController: the per-host runtime (raylet equivalent).
+
+Reference counterpart: ``src/ray/raylet/node_manager.{h,cc}`` + worker_pool +
+local object store. Responsibilities here:
+
+  - register with the GCS, heartbeat loop (liveness; the GCS owns resource
+    accounting because placement is centralized in the batch kernel);
+  - local object store: serialized blobs keyed by ObjectID, with waiters;
+    remote fetch on demand (the ObjectManager Pull path, object_manager.h:213);
+  - worker pool: spawn/respawn python worker processes, route tasks to idle
+    workers, pin workers to actors, detect worker death and fail their tasks
+    (HandleUnexpectedWorkerFailure, node_manager.h:149);
+  - dependency staging: fetch all ref-args locally before dispatching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .._private.config import Config
+from .protocol import Connection, RpcClient, RpcServer
+
+ERR_PREFIX = b"E"
+VAL_PREFIX = b"V"
+
+
+def _payload(msg):
+    """Strip transport fields so forwards cannot resurrect the old type."""
+    return {k: v for k, v in msg.items() if k not in ("type", "rpc_id")}
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.conn: Optional[Connection] = None
+        self.idle = True
+        self.actor_id: Optional[bytes] = None
+        self.current_task: Optional[Dict] = None
+        self.ready = asyncio.Event()
+
+
+class NodeController:
+    def __init__(self, config: Config, gcs_addr: Tuple[str, int],
+                 resources: Dict[str, float], num_workers: int = 2,
+                 host: str = "127.0.0.1", port: int = 0,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.config = config
+        self.node_id = uuid.uuid4().hex
+        self.gcs_addr = gcs_addr
+        self.resources = resources
+        self.num_workers = num_workers
+        self.worker_env = worker_env or {}
+        self.server = RpcServer(host, port)
+        self.store: Dict[bytes, bytes] = {}
+        self._store_waiters: Dict[bytes, List[asyncio.Event]] = {}
+        self.workers: Dict[int, WorkerHandle] = {}  # pid -> handle
+        self._idle_event = asyncio.Event()
+        self._gcs: Optional[RpcClient] = None
+        self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._actor_queues: Dict[bytes, "asyncio.Queue"] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
+        self._shutting_down = False
+        self._register_handlers()
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.create_task(coro)
+        self._bg.add(task)
+
+        def done(t: asyncio.Task):
+            self._bg.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                import traceback
+                traceback.print_exception(t.exception())
+
+        task.add_done_callback(done)
+
+    # ------------------------------------------------------------------ setup
+    async def start(self) -> int:
+        port = await self.server.start()
+        self.address = (self.server.host, port)
+        self._gcs = RpcClient(*self.gcs_addr)
+        self._gcs.call({
+            "type": "register_node", "node_id": self.node_id,
+            "address": list(self.address), "resources": self.resources,
+        })
+        for _ in range(self.num_workers):
+            self._spawn_worker()
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.create_task(self._reap_loop()))
+        return port
+
+    async def stop(self):
+        self._shutting_down = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc.poll() is None:
+                w.proc.terminate()
+        await self.server.stop()
+        if self._gcs:
+            self._gcs.close()
+
+    def _spawn_worker(self) -> WorkerHandle:
+        import ray_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(self.worker_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.worker_main",
+             "--controller", f"{self.address[0]}:{self.address[1]}",
+             "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"],
+            env=env,
+        )
+        handle = WorkerHandle(proc)
+        self.workers[proc.pid] = handle
+        return handle
+
+    async def _heartbeat_loop(self):
+        interval = self.config.heartbeat_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self._gcs.send_oneway({
+                    "type": "heartbeat", "node_id": self.node_id,
+                })
+            except ConnectionError:
+                return
+
+    async def _reap_loop(self):
+        """Detect dead worker processes; fail their tasks; respawn."""
+        while True:
+            await asyncio.sleep(0.2)
+            for pid, w in list(self.workers.items()):
+                if w.proc.poll() is not None:
+                    del self.workers[pid]
+                    if w.current_task is not None:
+                        await self._fail_task(
+                            w.current_task,
+                            f"worker died executing task (exit "
+                            f"{w.proc.returncode})", crashed=True,
+                        )
+                    if w.actor_id is not None:
+                        self._gcs.call({
+                            "type": "update_actor",
+                            "actor_id": w.actor_id, "state": "DEAD",
+                        })
+                    if not self._shutting_down:
+                        self._spawn_worker()
+
+    # ------------------------------------------------------------ object store
+    async def _store_put(self, oid: bytes, blob: bytes):
+        if oid in self.store:
+            return
+        self.store[oid] = blob
+        for ev in self._store_waiters.pop(oid, []):
+            ev.set()
+        try:
+            self._gcs.send_oneway({
+                "type": "add_object_location", "object_id": oid,
+                "node_id": self.node_id, "size": len(blob),
+            })
+        except ConnectionError:
+            pass
+
+    async def _store_get(self, oid: bytes, timeout: float = 60.0) -> bytes:
+        """Local get; fetches from a remote node if needed (Pull path)."""
+        blob = self.store.get(oid)
+        if blob is not None:
+            return blob
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = await asyncio.to_thread(self._gcs.call, {
+                "type": "get_object_locations", "object_id": oid,
+                "wait": True, "timeout": min(5.0, timeout),
+            })
+            if oid in self.store:
+                return self.store[oid]
+            for addr in resp.get("addresses", []):
+                addr = tuple(addr)
+                if addr == self.address:
+                    continue
+                try:
+                    peer = self._peer(addr)
+                    fetched = await asyncio.to_thread(
+                        peer.call, {"type": "fetch_object", "object_id": oid}
+                    )
+                    blob = fetched["blob"]
+                    await self._store_put(oid, blob)
+                    return blob
+                except Exception:  # noqa: BLE001 - node may have just died
+                    continue
+            if oid in self.store:
+                return self.store[oid]
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"object {oid.hex()[:16]} not available")
+
+    def _peer(self, addr: Tuple[str, int]) -> RpcClient:
+        client = self._peer_clients.get(addr)
+        if client is None or client._closed:
+            client = RpcClient(*addr)
+            self._peer_clients[addr] = client
+        return client
+
+    # ---------------------------------------------------------------- workers
+    async def _pop_idle_worker(self, timeout: float = 60.0) -> WorkerHandle:
+        deadline = time.monotonic() + timeout
+        while True:
+            for w in self.workers.values():
+                if w.idle and w.conn is not None and w.actor_id is None:
+                    w.idle = False
+                    return w
+            if all(w.conn is not None for w in self.workers.values()) and \
+                    len(self.workers) < self.num_workers + 8:
+                self._spawn_worker()  # grow under load (bounded)
+            if time.monotonic() > deadline:
+                raise TimeoutError("no idle worker available")
+            self._idle_event.clear()
+            try:
+                await asyncio.wait_for(self._idle_event.wait(), 0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _fail_task(self, task: Dict, message: str, crashed: bool = False):
+        import pickle
+
+        from ..exceptions import WorkerCrashedError
+
+        err = WorkerCrashedError(message) if crashed else RuntimeError(message)
+        blob = ERR_PREFIX + pickle.dumps(err)
+        for oid in task["return_ids"]:
+            await self._store_put(oid, blob)
+        await self._release(task)
+
+    async def _release(self, task: Dict):
+        if task.get("released"):
+            return
+        task["released"] = True
+        try:
+            self._gcs.send_oneway({
+                "type": "release_resources", "node_id": self.node_id,
+                "resources": task.get("resources", {}),
+            })
+        except ConnectionError:
+            pass
+
+    # -------------------------------------------------------------- handlers
+    def _register_handlers(self):
+        s = self.server
+
+        @s.handler("register_worker")
+        async def register_worker(msg, conn):
+            handle = self.workers.get(msg["pid"])
+            if handle is None:
+                return {"ok": False, "error": "unknown worker pid"}
+            handle.conn = conn
+            conn.meta["worker_pid"] = msg["pid"]
+            handle.ready.set()
+            self._idle_event.set()
+            return {"ok": True, "node_id": self.node_id}
+
+        @s.handler("assign_task")
+        async def assign_task(msg, conn):
+            self._spawn_bg(self._run_task(_payload(msg)))
+            return {"ok": True}
+
+        @s.handler("task_done")
+        async def task_done(msg, conn):
+            """Worker finished: blobs already stored via store_object."""
+            pid = conn.meta.get("worker_pid")
+            w = self.workers.get(pid)
+            if w is not None:
+                task = w.current_task
+                w.current_task = None
+                if w.actor_id is None:
+                    w.idle = True
+                    self._idle_event.set()
+                if task is not None:
+                    await self._release(task)
+            return None
+
+        @s.handler("store_object")
+        async def store_object(msg, conn):
+            await self._store_put(msg["object_id"], msg["blob"])
+            return {"ok": True}
+
+        @s.handler("fetch_object")
+        async def fetch_object(msg, conn):
+            oid = msg["object_id"]
+            if msg.get("remote_ok", False):
+                blob = await self._store_get(oid, msg.get("timeout", 60.0))
+            else:
+                blob = self.store.get(oid)
+                if blob is None:
+                    return {"ok": False, "error": "object not local"}
+            return {"ok": True, "blob": blob}
+
+        @s.handler("has_object")
+        async def has_object(msg, conn):
+            return {"ok": True, "has": msg["object_id"] in self.store}
+
+        @s.handler("delete_objects")
+        async def delete_objects(msg, conn):
+            for oid in msg["object_ids"]:
+                self.store.pop(oid, None)
+            return None
+
+        @s.handler("create_actor")
+        async def create_actor(msg, conn):
+            self._spawn_bg(self._create_actor(_payload(msg)))
+            return {"ok": True}
+
+        @s.handler("actor_call")
+        async def actor_call(msg, conn):
+            """Enqueue on the actor's ordered dispatch queue.
+
+            Dep staging must not run inline (it would block this connection's
+            read loop), and per-actor FIFO order must survive the detach —
+            hence one queue + dispatcher task per actor.
+            """
+            actor_id = msg["actor_id"]
+            q = self._actor_queues.get(actor_id)
+            if q is None:
+                q = asyncio.Queue()
+                self._actor_queues[actor_id] = q
+                self._spawn_bg(self._actor_dispatch_loop(actor_id, q))
+            await q.put(_payload(msg))
+            return {"ok": True}
+
+        @s.handler("kill_actor")
+        async def kill_actor(msg, conn):
+            worker = self._actor_worker(msg["actor_id"])
+            if worker is not None:
+                worker.proc.terminate()
+                task = {"return_ids": [], "resources": msg.get("resources", {})}
+                await self._release(task)
+            return {"ok": True}
+
+        @s.handler("stats")
+        async def stats(msg, conn):
+            return {"ok": True, "node_id": self.node_id,
+                    "num_objects": len(self.store),
+                    "num_workers": len(self.workers),
+                    "workers": [
+                        {"pid": pid, "registered": w.conn is not None,
+                         "idle": w.idle, "actor": bool(w.actor_id),
+                         "task": (w.current_task or {}).get("name")}
+                        for pid, w in self.workers.items()
+                    ]}
+
+    async def _actor_dispatch_loop(self, actor_id: bytes, q: "asyncio.Queue"):
+        """Stage deps and forward actor calls strictly in arrival order."""
+        while True:
+            msg = await q.get()
+            worker = self._actor_worker(actor_id)
+            if worker is None:
+                await self._fail_actor_call(msg)
+                continue
+            try:
+                for oid in msg.get("deps", []):
+                    await self._store_get(oid)
+            except Exception:  # noqa: BLE001 - dep fetch failed: fail the call
+                await self._fail_actor_call(msg)
+                continue
+            await worker.conn.send(dict(msg, type="execute_actor_task"))
+
+    def _actor_worker(self, actor_id: bytes) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.actor_id == actor_id and w.conn is not None:
+                return w
+        return None
+
+    async def _fail_actor_call(self, msg: Dict):
+        import pickle
+
+        from ..exceptions import ActorDiedError
+
+        blob = ERR_PREFIX + pickle.dumps(ActorDiedError(msg["actor_id"].hex()[:12]))
+        for oid in msg["return_ids"]:
+            await self._store_put(oid, blob)
+
+    # -------------------------------------------------------------- task run
+    async def _run_task(self, task: Dict):
+        try:
+            for oid in task.get("deps", []):
+                await self._store_get(oid)
+            worker = await self._pop_idle_worker()
+        except Exception as e:  # noqa: BLE001
+            await self._fail_task(task, f"dispatch failed: {e}")
+            return
+        worker.current_task = task
+        await worker.conn.send(dict(task, type="execute_task"))
+
+    async def _create_actor(self, msg: Dict):
+        try:
+            for oid in msg.get("deps", []):
+                await self._store_get(oid)
+            worker = await self._pop_idle_worker()
+        except Exception as e:  # noqa: BLE001
+            await self._fail_task(msg, f"actor creation dispatch failed: {e}")
+            self._gcs.call({"type": "update_actor", "actor_id": msg["actor_id"],
+                            "state": "DEAD"})
+            return
+        worker.actor_id = msg["actor_id"]
+        worker.current_task = msg
+        await worker.conn.send(dict(msg, type="create_actor_instance"))
+        self._gcs.call({
+            "type": "update_actor", "actor_id": msg["actor_id"],
+            "state": "ALIVE", "node_id": self.node_id,
+            "address": list(self.address),
+        })

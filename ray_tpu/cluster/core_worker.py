@@ -1,0 +1,381 @@
+"""ClusterCoreWorker: the per-process runtime in cluster mode.
+
+Reference counterpart: ``src/ray/core_worker/core_worker.h:262`` — the object
+ops (Put/Get/Wait), task ops (SubmitTask/CreateActor/SubmitActorTask) and
+bookkeeping embedded in every driver and worker process. Implements the same
+interface the local-mode LocalRuntime exposes to the public API, but routes:
+
+  placement     -> GCS batch placement service (the kernel)
+  task dispatch -> placed node's NodeController
+  objects       -> node object stores, located via the GCS directory
+  actors        -> GCS actor table + the owning node's controller
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import pickle
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from .._private.ids import ActorID, JobID, ObjectID, TaskID
+from .._private.runtime import _EventLog, ensure_context
+from .._private.serialization import SerializedObject, get_context
+from .._private.task_spec import TaskSpec
+from ..exceptions import ActorDiedError, GetTimeoutError
+from ..object_ref import ObjectRef
+from .protocol import RpcClient
+
+ERR_PREFIX = b"E"
+VAL_PREFIX = b"V"
+
+
+class ClusterCoreWorker:
+    def __init__(self, gcs_addr: Tuple[str, int],
+                 controller_addr: Optional[Tuple[str, int]] = None,
+                 role: str = "driver", config=None):
+        from .._private.config import get_config
+
+        self.config = config or get_config()
+        self.role = role
+        self.gcs = RpcClient(*gcs_addr)
+        self.gcs_addr = gcs_addr
+        self.job_id = JobID.from_int(int(time.time()) & 0x7FFFFFFF)
+        self.driver_task_id = TaskID.for_driver_task(self.job_id)
+        self.events = _EventLog(self.config.event_log_enabled)
+        self._thread_scope_counter = itertools.count(1 << 31)
+        self._ser = get_context()
+        self._exported_fns: set = set()
+        self._fn_lock = threading.Lock()
+        self._controllers: Dict[Tuple[str, int], RpcClient] = {}
+        self._controller_lock = threading.Lock()
+        self._home_addr = controller_addr  # workers: their own node
+        self._actor_addr_cache: Dict[bytes, Tuple[str, int]] = {}
+        self._actor_resources: Dict[bytes, Dict[str, float]] = {}
+        self._blob_cache: Dict[bytes, bytes] = {}
+        self._blob_cache_order: deque = deque()
+
+    # ---------------------------------------------------------------- helpers
+    def _controller(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        with self._controller_lock:
+            client = self._controllers.get(addr)
+            if client is None or client._closed:
+                client = RpcClient(*addr)
+                self._controllers[addr] = client
+            return client
+
+    def _home_controller(self) -> RpcClient:
+        if self._home_addr is not None:
+            return self._controller(self._home_addr)
+        nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
+        for n in nodes:
+            if not n["Alive"]:
+                continue
+            try:
+                client = self._controller(tuple(n["Address"]))
+                self._home_addr = tuple(n["Address"])
+                return client
+            except (ConnectionError, OSError):
+                self.gcs.call({"type": "report_node_dead",
+                               "node_id": n["NodeID"]})
+        raise RuntimeError("no reachable nodes in cluster")
+
+    def _export_fn(self, fn: Callable) -> bytes:
+        blob = cloudpickle.dumps(fn)
+        fn_id = hashlib.blake2b(blob, digest_size=16).digest()
+        with self._fn_lock:
+            if fn_id not in self._exported_fns:
+                self.gcs.call({"type": "put_function", "fn_id": fn_id,
+                               "blob": blob})
+                self._exported_fns.add(fn_id)
+        return fn_id
+
+    def _pack_value(self, value: Any) -> Tuple[str, bytes]:
+        return ("value", self._ser.serialize(value).to_bytes())
+
+    def _pack_args(self, spec: TaskSpec):
+        args = []
+        deps = []
+        for kind, payload in spec.args:
+            if kind == "ref":
+                args.append(("ref", payload.binary()))
+                deps.append(payload.binary())
+            else:
+                args.append(self._pack_value(payload))
+        kwargs = {}
+        for key, val in spec.metadata.get("kwargs", {}).items():
+            if isinstance(val, ObjectRef):
+                kwargs[key] = ("ref", val.id.binary())
+                deps.append(val.id.binary())
+            else:
+                kwargs[key] = self._pack_value(val)
+        return args, kwargs, deps
+
+    # ------------------------------------------------------------------ tasks
+    def next_task_id(self) -> TaskID:
+        ctx = ensure_context(self)
+        return TaskID.for_normal_task(
+            ctx.job_id, ctx.current_task_id, next(ctx.task_counter)
+        )
+
+    def _place_and_send(self, resources: Dict[str, float], message: Dict,
+                        attempts: int = 5) -> Dict:
+        """Request placement and deliver to the granted node; a node that
+        refuses connections is reported dead and placement retried."""
+        last_err: Optional[BaseException] = None
+        for _ in range(attempts):
+            placement = self.gcs.call({
+                "type": "request_placement", "resources": resources,
+                "locality": None, "timeout": 60.0,
+            }, timeout=90.0)
+            addr = tuple(placement["address"])
+            try:
+                node = self._controller(addr)
+                node.call(message)
+                return placement
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last_err = e
+                self.gcs.call({"type": "report_node_dead",
+                               "node_id": placement["node_id"]})
+        raise RuntimeError(f"could not deliver task after {attempts} "
+                           f"placements: {last_err}")
+
+    def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
+        fn_id = self._export_fn(fn)
+        args, kwargs, deps = self._pack_args(spec)
+        return_ids = [oid.binary() for oid in spec.return_ids()]
+        resources = spec.resources.to_dict()
+        self._place_and_send(resources, {
+            "type": "assign_task",
+            "task_id": spec.task_id.binary(),
+            "name": spec.function.repr_name,
+            "fn_id": fn_id, "args": args, "kwargs": kwargs,
+            "deps": deps, "return_ids": return_ids,
+            "resources": resources, "max_retries": spec.max_retries,
+        })
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    # ----------------------------------------------------------------- actors
+    def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
+        actor_id = spec.actor_id
+        methods = tuple(n for n in dir(cls) if not n.startswith("_"))
+        resp = self.gcs.call({
+            "type": "register_actor", "actor_id": actor_id.binary(),
+            "name": spec.name, "class_name": cls.__name__,
+            "module": cls.__module__, "methods": methods,
+        })
+        fn_id = self._export_fn(cls)
+        packed_args = []
+        deps = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                packed_args.append(("ref", a.id.binary()))
+                deps.append(a.id.binary())
+            else:
+                packed_args.append(self._pack_value(a))
+        packed_kwargs = {}
+        for key, val in (kwargs or {}).items():
+            if isinstance(val, ObjectRef):
+                packed_kwargs[key] = ("ref", val.id.binary())
+                deps.append(val.id.binary())
+            else:
+                packed_kwargs[key] = self._pack_value(val)
+        resources = spec.resources.to_dict()
+        self._actor_resources[actor_id.binary()] = resources
+        placement = self._place_and_send(resources, {
+            "type": "create_actor", "actor_id": actor_id.binary(),
+            "fn_id": fn_id, "args": packed_args, "kwargs": packed_kwargs,
+            "deps": deps,
+            "return_ids": [spec.return_ids()[0].binary()],
+            "resources": resources,
+            "name": spec.name,
+        })
+        self._actor_addr_cache[actor_id.binary()] = tuple(placement["address"])
+        return actor_id
+
+    def _actor_address(self, actor_id: bytes) -> Optional[Tuple[str, int]]:
+        info = self.gcs.call({"type": "get_actor", "actor_id": actor_id})
+        if info.get("state") == "ALIVE" and info.get("address"):
+            addr = tuple(info["address"])
+            self._actor_addr_cache[actor_id] = addr
+            return addr
+        return self._actor_addr_cache.get(actor_id) \
+            if info.get("state") != "DEAD" else None
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        actor_id = spec.actor_id.binary()
+        args, kwargs, deps = self._pack_args(spec)
+        return_ids = [oid.binary() for oid in spec.return_ids()]
+        addr = self._actor_address(actor_id)
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        if addr is None:
+            self._store_error_blobs(
+                return_ids, ActorDiedError(spec.actor_id.hex()[:12])
+            )
+            return refs
+        node = self._controller(addr)
+        node.call({
+            "type": "actor_call", "actor_id": actor_id,
+            "method": spec.function.qualname,
+            "args": args, "kwargs": kwargs, "deps": deps,
+            "return_ids": return_ids,
+            "name": spec.function.repr_name,
+        })
+        return refs
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        addr = self._actor_address(actor_id.binary())
+        resources = self._actor_resources.get(actor_id.binary(), {})
+        if addr is not None:
+            self._controller(addr).call({
+                "type": "kill_actor", "actor_id": actor_id.binary(),
+                "resources": resources,
+            })
+        self.gcs.call({"type": "update_actor", "actor_id": actor_id.binary(),
+                       "state": "DEAD"})
+        self._actor_addr_cache.pop(actor_id.binary(), None)
+
+    def get_actor(self, name: str) -> ActorID:
+        info = self.gcs.call({"type": "get_actor", "name": name})
+        return ActorID(info["actor_id"])
+
+    def actor_class_info(self, actor_id: ActorID):
+        info = self.gcs.call({"type": "get_actor",
+                              "actor_id": actor_id.binary()})
+        return info["class_name"], info["module"], tuple(info["methods"])
+
+    def actor_handle_alive(self, actor_id: ActorID) -> bool:
+        info = self.gcs.call({"type": "get_actor",
+                              "actor_id": actor_id.binary()})
+        return info.get("state") == "ALIVE"
+
+    def _store_error_blobs(self, return_ids: List[bytes], err: BaseException):
+        blob = ERR_PREFIX + pickle.dumps(err)
+        node = self._home_controller()
+        for oid in return_ids:
+            node.call({"type": "store_object", "object_id": oid, "blob": blob})
+
+    # ---------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        ctx = ensure_context(self)
+        oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
+        blob = VAL_PREFIX + self._ser.serialize(value).to_bytes()
+        self._home_controller().call(
+            {"type": "store_object", "object_id": oid.binary(), "blob": blob}
+        )
+        return ObjectRef(oid)
+
+    def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
+        cached = self._blob_cache.get(oid)
+        if cached is not None:
+            return cached
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
+            if step <= 0:
+                raise GetTimeoutError(f"object {oid.hex()[:16]} not ready")
+            resp = self.gcs.call({
+                "type": "get_object_locations", "object_id": oid,
+                "wait": True, "timeout": step,
+            }, timeout=step + 30.0)
+            for addr in resp.get("addresses", []):
+                try:
+                    fetched = self._controller(tuple(addr)).call(
+                        {"type": "fetch_object", "object_id": oid}
+                    )
+                    blob = fetched["blob"]
+                    self._cache_blob(oid, blob)
+                    return blob
+                except (RuntimeError, ConnectionError, TimeoutError):
+                    continue
+
+    def _cache_blob(self, oid: bytes, blob: bytes):
+        self._blob_cache[oid] = blob
+        self._blob_cache_order.append(oid)
+        while len(self._blob_cache_order) > 4096:
+            old = self._blob_cache_order.popleft()
+            self._blob_cache.pop(old, None)
+
+    def get_blob_value(self, oid: bytes, timeout: Optional[float] = None) -> Any:
+        blob = self._fetch_blob(oid, timeout)
+        if blob[:1] == ERR_PREFIX:
+            raise pickle.loads(blob[1:])
+        return self._ser.deserialize(SerializedObject.from_bytes(blob[1:]))
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        return [self.get_blob_value(r.id.binary(), timeout) for r in refs]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = {r.id.binary(): r for r in refs}
+        ready: set = set()
+        while True:
+            for oid in list(pending):
+                if oid in ready:
+                    continue
+                if oid in self._blob_cache:
+                    ready.add(oid)
+                    continue
+                resp = self.gcs.call({
+                    "type": "get_object_locations", "object_id": oid,
+                    "wait": False,
+                })
+                if resp.get("locations"):
+                    ready.add(oid)
+            expired = deadline is not None and time.monotonic() >= deadline
+            if len(ready) >= num_returns or expired:
+                # at most num_returns in the ready list, input order preserved
+                out_ready = [r for r in refs if r.id.binary() in ready]
+                out_ready = out_ready[:num_returns]
+                taken = {r.id.binary() for r in out_ready}
+                out_rest = [r for r in refs if r.id.binary() not in taken]
+                return out_ready, out_rest
+            time.sleep(0.005)
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        pass  # cooperative cancel lands with the lineage/retry rework
+
+    # ------------------------------------------------------------------ state
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs.call({"type": "cluster_resources"})["total"]
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs.call({"type": "cluster_resources"})["available"]
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return self.gcs.call({"type": "list_nodes"})["nodes"]
+
+    def actors(self) -> Dict[str, Dict[str, Any]]:
+        raw = self.gcs.call({"type": "list_actors"})["actors"]
+        return {
+            aid.hex(): {"ActorID": aid.hex(), "State": info["state"],
+                        "Name": info.get("name")}
+            for aid, info in raw.items()
+        }
+
+    def shutdown(self):
+        for client in self._controllers.values():
+            client.close()
+        self.gcs.close()

@@ -1,0 +1,115 @@
+"""Process entry points for cluster components.
+
+``python -m ray_tpu.cluster.launch head --port P`` starts the GCS (and
+optionally a colocated node controller); ``... node --gcs H:P`` starts a
+NodeController. Reference counterpart: ``python/ray/node.py`` +
+``services.py`` process supervision, collapsed into one module because our
+head has no redis/plasma/raylet trio to babysit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _force_cpu_jax():
+    """Control-plane processes must not grab the (single) TPU chip."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+async def run_head(port: int, resources: dict, num_workers: int,
+                   with_node: bool = True, worker_env: dict | None = None):
+    from ray_tpu._private.config import get_config
+    from ray_tpu.cluster.gcs import GcsServer
+
+    config = get_config()
+    gcs = GcsServer(config, port=port)
+    gcs_port = await gcs.start()
+    print(json.dumps({"event": "gcs_started", "port": gcs_port}), flush=True)
+    if with_node:
+        # The controller does blocking RPCs to the GCS, so it must live on
+        # its own event loop (thread); sharing the GCS loop deadlocks.
+        import threading
+
+        def node_thread():
+            asyncio.run(run_node(
+                "127.0.0.1", gcs_port, resources, num_workers,
+                worker_env=worker_env,
+            ))
+
+        threading.Thread(target=node_thread, daemon=True).start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await gcs.stop()
+
+
+async def run_node(gcs_host: str, gcs_port: int, resources: dict,
+                   num_workers: int, worker_env: dict | None = None):
+    from ray_tpu._private.config import get_config
+    from ray_tpu.cluster.controller import NodeController
+
+    config = get_config()
+    node = NodeController(
+        config, (gcs_host, gcs_port), resources, num_workers=num_workers,
+        worker_env=worker_env,
+    )
+    port = await node.start()
+    print(json.dumps({"event": "node_started", "port": port,
+                      "node_id": node.node_id}), flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await node.stop()
+
+
+def main():
+    _force_cpu_jax()
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    head = sub.add_parser("head")
+    head.add_argument("--port", type=int, default=0)
+    head.add_argument("--resources", default='{"CPU": 4}')
+    head.add_argument("--num-workers", type=int, default=2)
+    head.add_argument("--no-node", action="store_true")
+    head.add_argument("--worker-env", default="{}")
+
+    node = sub.add_parser("node")
+    node.add_argument("--gcs", required=True)
+    node.add_argument("--resources", default='{"CPU": 4}')
+    node.add_argument("--num-workers", type=int, default=2)
+    node.add_argument("--worker-env", default="{}")
+
+    args = parser.parse_args()
+    worker_env = json.loads(args.worker_env)
+    worker_env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        if args.role == "head":
+            asyncio.run(run_head(
+                args.port, json.loads(args.resources), args.num_workers,
+                with_node=not args.no_node, worker_env=worker_env,
+            ))
+        else:
+            host, port = args.gcs.rsplit(":", 1)
+            asyncio.run(run_node(
+                host, int(port), json.loads(args.resources),
+                args.num_workers, worker_env=worker_env,
+            ))
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

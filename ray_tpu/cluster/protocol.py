@@ -1,0 +1,236 @@
+"""Cluster wire protocol: length-prefixed pickled messages over TCP.
+
+Plays the role of the reference's gRPC plumbing (``src/ray/rpc/``): typed
+request/response with correlation ids, plus server-push messages (pubsub).
+A message is ``[8-byte LE length][pickle bytes]``; payloads are plain dicts
+with a ``type`` field. Object payloads are raw bytes inside the pickle — the
+pickle module handles them zero-copy-ish via protocol 5 out-of-band buffers
+when large.
+
+Server side: asyncio. Client side: a blocking, thread-safe RpcClient (the
+runtime's callers are threads, not coroutines).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_LEN = struct.Struct("<Q")
+MAX_MESSAGE = 1 << 34
+
+
+def _dumps(msg: Dict[str, Any]) -> bytes:
+    body = pickle.dumps(msg, protocol=5)
+    return _LEN.pack(len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# asyncio server side
+# ---------------------------------------------------------------------------
+
+async def read_message(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
+    try:
+        header = await reader.readexactly(8)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_MESSAGE:
+        raise ValueError(f"message too large: {length}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+async def write_message(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
+    writer.write(_dumps(msg))
+    await writer.drain()
+
+
+class RpcServer:
+    """Asyncio TCP server dispatching requests to handler coroutines.
+
+    Handlers are registered per message type; each gets (msg, connection) and
+    returns a response dict (or None for one-way messages). Connections are
+    tracked so services can push messages (pubsub, task assignment).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._on_disconnect: Optional[Callable] = None
+
+    def handler(self, msg_type: str):
+        def deco(fn):
+            self._handlers[msg_type] = fn
+            return fn
+        return deco
+
+    def on_disconnect(self, fn: Callable) -> None:
+        self._on_disconnect = fn
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        conn = Connection(reader, writer)
+        try:
+            while True:
+                msg = await read_message(reader)
+                if msg is None:
+                    break
+                handler = self._handlers.get(msg.get("type"))
+                if handler is None:
+                    resp = {"ok": False, "error": f"unknown type {msg.get('type')}"}
+                else:
+                    try:
+                        resp = await handler(msg, conn)
+                    except Exception as e:  # noqa: BLE001 - reported to caller
+                        import traceback
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}",
+                                "traceback": traceback.format_exc()}
+                if "rpc_id" in msg and resp is not None:
+                    resp["rpc_id"] = msg["rpc_id"]
+                    await conn.send(resp)
+        finally:
+            if self._on_disconnect is not None:
+                try:
+                    res = self._on_disconnect(conn)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:  # noqa: BLE001
+                    pass
+            writer.close()
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class Connection:
+    """One inbound connection; supports locked writes for server push."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.meta: Dict[str, Any] = {}  # handler-attached identity (node id...)
+        self._wlock = asyncio.Lock()
+
+    async def send(self, msg: Dict[str, Any]):
+        async with self._wlock:
+            await write_message(self.writer, msg)
+
+
+# ---------------------------------------------------------------------------
+# blocking client side
+# ---------------------------------------------------------------------------
+
+class RpcClient:
+    """Thread-safe blocking RPC client with a reader thread.
+
+    Responses are matched by rpc_id; unsolicited messages (server push) go to
+    the ``push_handler``.
+    """
+
+    def __init__(self, host: str, port: int,
+                 push_handler: Optional[Callable[[Dict], None]] = None,
+                 timeout: float = 30.0):
+        self.addr = (host, port)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, "threading.Event"] = {}
+        self._responses: Dict[int, Dict] = {}
+        self._counter = itertools.count(1)
+        self._push_handler = push_handler
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while not self._closed:
+                header = self._recv_exact(8)
+                if header is None:
+                    break
+                (length,) = _LEN.unpack(header)
+                body = self._recv_exact(length)
+                if body is None:
+                    break
+                msg = pickle.loads(body)
+                rpc_id = msg.get("rpc_id")
+                if rpc_id is not None and rpc_id in self._pending:
+                    self._responses[rpc_id] = msg
+                    self._pending[rpc_id].set()
+                elif self._push_handler is not None:
+                    try:
+                        self._push_handler(msg)
+                    except Exception:  # noqa: BLE001
+                        pass
+        except OSError:
+            pass
+        finally:
+            self._closed = True
+            for ev in list(self._pending.values()):
+                ev.set()
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def call(self, msg: Dict[str, Any], timeout: Optional[float] = 60.0) -> Dict:
+        if self._closed:
+            raise ConnectionError(f"connection to {self.addr} closed")
+        rpc_id = next(self._counter)
+        msg = dict(msg, rpc_id=rpc_id)
+        ev = threading.Event()
+        self._pending[rpc_id] = ev
+        with self._wlock:
+            self._sock.sendall(_dumps(msg))
+        if not ev.wait(timeout):
+            self._pending.pop(rpc_id, None)
+            raise TimeoutError(f"rpc {msg['type']} to {self.addr} timed out")
+        self._pending.pop(rpc_id, None)
+        resp = self._responses.pop(rpc_id, None)
+        if resp is None:
+            raise ConnectionError(f"connection to {self.addr} lost mid-call")
+        if resp.get("ok") is False:
+            raise RuntimeError(
+                f"rpc {msg['type']} failed: {resp.get('error')}\n"
+                f"{resp.get('traceback', '')}"
+            )
+        return resp
+
+    def send_oneway(self, msg: Dict[str, Any]) -> None:
+        if self._closed:
+            raise ConnectionError(f"connection to {self.addr} closed")
+        with self._wlock:
+            self._sock.sendall(_dumps(msg))
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

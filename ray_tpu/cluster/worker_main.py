@@ -1,0 +1,152 @@
+"""Worker process entry point.
+
+Reference counterpart: ``python/ray/workers/default_worker.py`` + the
+core-worker task execution loop (``core_worker.cc:1421 RunTaskExecutionLoop``).
+Connects to its NodeController, registers, then executes pushed tasks:
+fetch function blob (cached), resolve args from the local store, run, store
+returns, report done. Actor workers keep the instance alive and execute
+method calls in arrival order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+ERR_PREFIX = b"E"
+VAL_PREFIX = b"V"
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--gcs", required=True)
+    args = parser.parse_args()
+
+    chost, cport = args.controller.rsplit(":", 1)
+    ghost, gport = args.gcs.rsplit(":", 1)
+
+    from ray_tpu._private.serialization import get_context
+    from ray_tpu.cluster.core_worker import ClusterCoreWorker
+    from ray_tpu.cluster.protocol import RpcClient
+    from ray_tpu.exceptions import TaskError
+
+    inbox: "queue.Queue[Dict]" = queue.Queue()
+    controller = RpcClient(chost, int(cport), push_handler=inbox.put)
+
+    # The worker's own core runtime: nested ray_tpu API calls from task code
+    # route through the same cluster machinery.
+    core = ClusterCoreWorker(
+        (ghost, int(gport)), controller_addr=(chost, int(cport)),
+        role="worker",
+    )
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    worker.core = core
+    worker.mode = "worker"
+    worker.connected = True
+
+    controller.call({"type": "register_worker", "pid": os.getpid()})
+
+    ser = get_context()
+    fn_cache: Dict[bytes, Any] = {}
+    actor_instance: Optional[Any] = None
+
+    def load_function(fn_id: bytes):
+        fn = fn_cache.get(fn_id)
+        if fn is None:
+            resp = core.gcs.call({"type": "get_function", "fn_id": fn_id})
+            fn = pickle.loads(resp["blob"])
+            fn_cache[fn_id] = fn
+        return fn
+
+    def resolve_args(msg) -> tuple:
+        pos = []
+        for kind, payload in msg["args"]:
+            if kind == "ref":
+                pos.append(core.get_blob_value(payload))
+            else:
+                pos.append(ser.deserialize(
+                    type(ser.serialize(None)).from_bytes(payload)))
+        kwargs = {}
+        for key, (kind, payload) in msg.get("kwargs", {}).items():
+            if kind == "ref":
+                kwargs[key] = core.get_blob_value(payload)
+            else:
+                kwargs[key] = ser.deserialize(
+                    type(ser.serialize(None)).from_bytes(payload))
+        return pos, kwargs
+
+    def store_result(oid: bytes, value: Any):
+        blob = VAL_PREFIX + ser.serialize(value).to_bytes()
+        controller.call({"type": "store_object", "object_id": oid, "blob": blob})
+
+    def store_error(msg, exc: BaseException):
+        if not isinstance(exc, TaskError):
+            exc = TaskError(msg.get("name", "task"), exc)
+        blob = ERR_PREFIX + pickle.dumps(exc)
+        for oid in msg["return_ids"]:
+            controller.call({"type": "store_object", "object_id": oid,
+                             "blob": blob})
+
+    def run_returns(msg, result):
+        oids = msg["return_ids"]
+        if len(oids) == 1:
+            store_result(oids[0], result)
+        else:
+            if not isinstance(result, tuple) or len(result) != len(oids):
+                raise ValueError(
+                    f"expected {len(oids)} returns, got {type(result).__name__}"
+                )
+            for oid, val in zip(oids, result):
+                store_result(oid, val)
+
+    while True:
+        msg = inbox.get()
+        mtype = msg.get("type")
+        if mtype == "shutdown":
+            break
+        try:
+            if mtype == "execute_task":
+                fn = load_function(msg["fn_id"])
+                pos, kwargs = resolve_args(msg)
+                result = fn(*pos, **kwargs)
+                run_returns(msg, result)
+            elif mtype == "create_actor_instance":
+                cls = load_function(msg["fn_id"])
+                pos, kwargs = resolve_args(msg)
+                actor_instance = cls(*pos, **kwargs)
+                store_result(msg["return_ids"][0], True)
+            elif mtype == "execute_actor_task":
+                if actor_instance is None:
+                    raise RuntimeError("actor not initialized")
+                method = getattr(actor_instance, msg["method"])
+                pos, kwargs = resolve_args(msg)
+                result = method(*pos, **kwargs)
+                import asyncio
+                if asyncio.iscoroutine(result):
+                    result = asyncio.run(result)
+                run_returns(msg, result)
+            else:
+                continue
+        except BaseException as e:  # noqa: BLE001 - task errors are data
+            try:
+                store_error(msg, e)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        finally:
+            try:
+                controller.send_oneway({"type": "task_done"})
+            except ConnectionError:
+                break
+
+
+if __name__ == "__main__":
+    main()

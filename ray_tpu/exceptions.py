@@ -35,13 +35,29 @@ class TaskError(RayTpuError):
             f"remote traceback:\n{self.remote_traceback}"
         )
 
+    def __reduce__(self):
+        # Cross-process safe: fall back to a repr stand-in for causes that
+        # don't pickle (tracebacks never do; we carry the formatted text).
+        import pickle
+
+        try:
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:  # noqa: BLE001
+            cause = RuntimeError(repr(self.cause))
+        return (TaskError, (self.function_name, cause, self.remote_traceback))
+
 
 class ActorError(RayTpuError):
     """An actor task cannot complete because the actor died."""
 
     def __init__(self, actor_id=None, message="The actor died unexpectedly"):
         self.actor_id = actor_id
+        self.message = message
         super().__init__(f"{message} (actor_id={actor_id})")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.message))
 
 
 class ActorDiedError(ActorError):
@@ -57,7 +73,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_id, message="Object lost"):
         self.object_id = object_id
+        self.message = message
         super().__init__(f"{message}: {object_id}")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.message))
 
 
 class ObjectStoreFullError(RayTpuError):
@@ -80,6 +100,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task was cancelled (task_id={task_id})")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id,))
 
 
 class RuntimeEnvError(RayTpuError):
