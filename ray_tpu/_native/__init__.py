@@ -1,0 +1,16 @@
+"""Native runtime components, built lazily with the system toolchain.
+
+The C++ sources live in ``src/``; the first import compiles them with g++
+into this directory (cached by source mtime). Anything that fails to build
+falls back to a pure-Python implementation with the same interface, so the
+framework always works — the native path is the fast path, not a hard dep.
+"""
+
+from .build import load_native_library  # noqa: F401
+from .shm_store import (  # noqa: F401
+    PyObjectStore,
+    ShmObjectStore,
+    StoreFullError,
+    create_store,
+    open_store,
+)

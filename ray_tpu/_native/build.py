@@ -1,0 +1,51 @@
+"""Lazy native build: compile C++ sources to shared libraries with g++.
+
+No pip/apt at runtime, so the toolchain contract is just "g++ exists". The
+built .so is cached next to the sources and rebuilt when the source is newer
+(mtime). Import never raises: callers get None on failure and are expected
+to fall back to a Python implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _needs_build(src: str, out: str) -> bool:
+    if not os.path.exists(out):
+        return True
+    return os.path.getmtime(src) > os.path.getmtime(out)
+
+
+def load_native_library(name: str) -> Optional[ctypes.CDLL]:
+    """Builds (if stale) and dlopens src/<name>.cc -> _build/lib<name>.so."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_SRC_DIR, f"{name}.cc")
+        out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        lib: Optional[ctypes.CDLL] = None
+        try:
+            if _needs_build(src, out):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = out + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-Wall", "-o", tmp, src, "-lpthread", "-lrt"],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, out)  # atomic under concurrent builders
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _cache[name] = lib
+        return lib

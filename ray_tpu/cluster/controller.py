@@ -24,6 +24,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .._private.config import Config
+from .._native import create_store
 from .protocol import Connection, RpcClient, RpcServer
 
 ERR_PREFIX = b"E"
@@ -57,7 +58,18 @@ class NodeController:
         self.num_workers = num_workers
         self.worker_env = worker_env or {}
         self.server = RpcServer(host, port)
-        self.store: Dict[bytes, bytes] = {}
+        # Shared-memory arena (the plasma equivalent, ray_tpu/_native):
+        # workers on this host attach by name and read/write zero-copy.
+        self.store_name = f"rtps-{self.node_id[:12]}"
+        self.store = create_store(self.store_name, config.object_store_memory)
+        self._overflow: Dict[bytes, bytes] = {}  # blobs too big for the arena
+        # The arena outlives SIGKILL'd processes (/dev/shm persists); make
+        # every normal exit path unlink it, even when stop() never runs
+        # (e.g. the head's colocated controller thread dying with the
+        # process).
+        import atexit
+
+        atexit.register(self.store.close)
         self._store_waiters: Dict[bytes, List[asyncio.Event]] = {}
         self.workers: Dict[int, WorkerHandle] = {}  # pid -> handle
         self._idle_event = asyncio.Event()
@@ -89,6 +101,7 @@ class NodeController:
         self._gcs.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
+            "store_name": self.store_name,
         })
         for _ in range(self.num_workers):
             self._spawn_worker()
@@ -106,6 +119,7 @@ class NodeController:
         await self.server.stop()
         if self._gcs:
             self._gcs.close()
+        self.store.close()
 
     def _spawn_worker(self) -> WorkerHandle:
         import ray_tpu
@@ -114,6 +128,7 @@ class NodeController:
             os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_STORE_NAME"] = self.store_name
         env.update(self.worker_env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
@@ -158,23 +173,49 @@ class NodeController:
                         self._spawn_worker()
 
     # ------------------------------------------------------------ object store
-    async def _store_put(self, oid: bytes, blob: bytes):
-        if oid in self.store:
-            return
-        self.store[oid] = blob
+    def _register_object(self, oid: bytes, size: int):
+        """Wake local waiters and report the location to the GCS directory."""
         for ev in self._store_waiters.pop(oid, []):
             ev.set()
         try:
             self._gcs.send_oneway({
                 "type": "add_object_location", "object_id": oid,
-                "node_id": self.node_id, "size": len(blob),
+                "node_id": self.node_id, "size": size,
             })
         except ConnectionError:
             pass
 
+    def _drop_location(self, oid: bytes):
+        """Retract this node from the GCS object directory (eviction or
+        deletion made our advertised copy a lie)."""
+        try:
+            self._gcs.send_oneway({
+                "type": "remove_object_location", "object_id": oid,
+                "node_id": self.node_id,
+            })
+        except ConnectionError:
+            pass
+
+    async def _store_put(self, oid: bytes, blob: bytes):
+        try:
+            self.store.put(oid, blob)  # immutable; double-put is a no-op
+        except Exception:  # noqa: BLE001 - blob exceeds the arena: overflow
+            # Plasma's external-store spill path (plasma/external_store.h):
+            # objects that can't fit in shared memory still must be storable.
+            self._overflow[oid] = blob
+        # Register even for duplicates: the writer may have stored the blob
+        # via shm earlier but failed to deliver its object_added message.
+        self._register_object(oid, len(blob))
+
+    def _local_blob(self, oid: bytes) -> Optional[bytes]:
+        blob = self.store.get_bytes(oid)
+        if blob is None:
+            blob = self._overflow.get(oid)
+        return blob
+
     async def _store_get(self, oid: bytes, timeout: float = 60.0) -> bytes:
         """Local get; fetches from a remote node if needed (Pull path)."""
-        blob = self.store.get(oid)
+        blob = self._local_blob(oid)
         if blob is not None:
             return blob
         deadline = time.monotonic() + timeout
@@ -183,8 +224,9 @@ class NodeController:
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": min(5.0, timeout),
             })
-            if oid in self.store:
-                return self.store[oid]
+            blob = self._local_blob(oid)
+            if blob is not None:
+                return blob
             for addr in resp.get("addresses", []):
                 addr = tuple(addr)
                 if addr == self.address:
@@ -199,8 +241,9 @@ class NodeController:
                     return blob
                 except Exception:  # noqa: BLE001 - node may have just died
                     continue
-            if oid in self.store:
-                return self.store[oid]
+            blob = self._local_blob(oid)
+            if blob is not None:
+                return blob
             await asyncio.sleep(0.01)
         raise TimeoutError(f"object {oid.hex()[:16]} not available")
 
@@ -293,25 +336,41 @@ class NodeController:
             await self._store_put(msg["object_id"], msg["blob"])
             return {"ok": True}
 
+        @s.handler("object_added")
+        async def object_added(msg, conn):
+            """A local worker wrote the object straight into the shared
+            arena (zero-copy); register it (plasma notification path)."""
+            self._register_object(msg["object_id"], msg.get("size", 0))
+            return {"ok": True}
+
         @s.handler("fetch_object")
         async def fetch_object(msg, conn):
             oid = msg["object_id"]
             if msg.get("remote_ok", False):
                 blob = await self._store_get(oid, msg.get("timeout", 60.0))
             else:
-                blob = self.store.get(oid)
+                blob = self._local_blob(oid)
                 if blob is None:
+                    # Likely LRU-evicted: retract our stale directory entry
+                    # so consumers move on to a surviving replica.
+                    self._drop_location(oid)
                     return {"ok": False, "error": "object not local"}
             return {"ok": True, "blob": blob}
 
         @s.handler("has_object")
         async def has_object(msg, conn):
-            return {"ok": True, "has": msg["object_id"] in self.store}
+            oid = msg["object_id"]
+            has = self.store.contains(oid) or oid in self._overflow
+            if not has:
+                self._drop_location(oid)
+            return {"ok": True, "has": has}
 
         @s.handler("delete_objects")
         async def delete_objects(msg, conn):
             for oid in msg["object_ids"]:
-                self.store.pop(oid, None)
+                self.store.delete(oid)
+                self._overflow.pop(oid, None)
+                self._drop_location(oid)
             return None
 
         @s.handler("create_actor")
@@ -347,8 +406,10 @@ class NodeController:
 
         @s.handler("stats")
         async def stats(msg, conn):
+            st = self.store.stats()
             return {"ok": True, "node_id": self.node_id,
-                    "num_objects": len(self.store),
+                    "store": st,
+                    "num_objects": st["num_objects"],
                     "num_workers": len(self.workers),
                     "workers": [
                         {"pid": pid, "registered": w.conn is not None,

@@ -59,6 +59,10 @@ class ClusterCoreWorker:
         self._actor_resources: Dict[bytes, Dict[str, float]] = {}
         self._blob_cache: Dict[bytes, bytes] = {}
         self._blob_cache_order: deque = deque()
+        # Same-host shared-memory arena, when one is reachable (workers get
+        # it from their controller's env; drivers attach lazily — shm
+        # existence doubles as the same-host check).
+        self.local_store = None
 
     # ---------------------------------------------------------------- helpers
     def _controller(self, addr: Tuple[str, int]) -> RpcClient:
@@ -80,6 +84,12 @@ class ClusterCoreWorker:
             try:
                 client = self._controller(tuple(n["Address"]))
                 self._home_addr = tuple(n["Address"])
+                if self.local_store is None and n.get("StoreName"):
+                    # Attach to the node's shm arena if it exists on this
+                    # host (open failure == different host).
+                    from .._native import open_store
+
+                    self.local_store = open_store(n["StoreName"])
                 return client
             except (ConnectionError, OSError):
                 self.gcs.call({"type": "report_node_dead",
@@ -266,12 +276,26 @@ class ClusterCoreWorker:
         ctx = ensure_context(self)
         oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
         blob = VAL_PREFIX + self._ser.serialize(value).to_bytes()
-        self._home_controller().call(
+        controller = self._home_controller()
+        if self.local_store is not None:
+            try:
+                self.local_store.put(oid.binary(), blob)
+                controller.call({"type": "object_added",
+                                 "object_id": oid.binary(),
+                                 "size": len(blob)})
+                return ObjectRef(oid)
+            except Exception:  # noqa: BLE001 - arena full: RPC path below
+                pass
+        controller.call(
             {"type": "store_object", "object_id": oid.binary(), "blob": blob}
         )
         return ObjectRef(oid)
 
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
+        if self.local_store is not None:
+            blob = self.local_store.get_bytes(oid)
+            if blob is not None:
+                return blob
         cached = self._blob_cache.get(oid)
         if cached is not None:
             return cached

@@ -24,10 +24,11 @@ from .protocol import Connection, RpcServer
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
-                 "alive", "index")
+                 "alive", "index", "store_name")
 
     def __init__(self, node_id: str, address: Tuple[str, int],
-                 resources: Dict[str, float], index: int):
+                 resources: Dict[str, float], index: int,
+                 store_name: str = ""):
         self.node_id = node_id
         self.address = address
         self.resources = resources
@@ -35,6 +36,7 @@ class NodeEntry:
         self.last_heartbeat = time.monotonic()
         self.alive = True
         self.index = index
+        self.store_name = store_name
 
 
 class GcsServer:
@@ -237,7 +239,8 @@ class GcsServer:
         async def register_node(msg, conn):
             node_id = msg["node_id"]
             entry = NodeEntry(node_id, tuple(msg["address"]), msg["resources"],
-                              index=len(self._node_order))
+                              index=len(self._node_order),
+                              store_name=msg.get("store_name", ""))
             self.nodes[node_id] = entry
             self._node_order.append(node_id)
             conn.meta["node_id"] = node_id
@@ -268,7 +271,7 @@ class GcsServer:
             return {"ok": True, "nodes": [
                 {"NodeID": n.node_id, "Alive": n.alive,
                  "Resources": n.resources, "Available": n.available,
-                 "Address": n.address}
+                 "Address": n.address, "StoreName": n.store_name}
                 for n in self.nodes.values()
             ]}
 
@@ -337,6 +340,17 @@ class GcsServer:
         async def remove_object_locations(msg, conn):
             for oid in msg["object_ids"]:
                 self.objects.pop(oid, None)
+            return None
+
+        @s.handler("remove_object_location")
+        async def remove_object_location(msg, conn):
+            """One node retracts its copy (LRU eviction / local delete);
+            other replicas stay valid."""
+            entry = self.objects.get(msg["object_id"])
+            if entry is not None:
+                entry["locations"].discard(msg["node_id"])
+                if not entry["locations"]:
+                    self.objects.pop(msg["object_id"], None)
             return None
 
         # ---- actors ----

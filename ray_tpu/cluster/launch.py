@@ -12,7 +12,19 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import signal
 import sys
+
+
+def _install_sigterm(stop_event: asyncio.Event) -> None:
+    """Graceful SIGTERM: lets the finally-blocks run so the shm arena is
+    unlinked (a SIGKILL'd controller leaks its segment until reboot)."""
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, stop_event.set)
+        loop.add_signal_handler(signal.SIGINT, stop_event.set)
+    except (NotImplementedError, RuntimeError):
+        pass
 
 
 def _force_cpu_jax():
@@ -37,26 +49,37 @@ async def run_head(port: int, resources: dict, num_workers: int,
     gcs = GcsServer(config, port=port)
     gcs_port = await gcs.start()
     print(json.dumps({"event": "gcs_started", "port": gcs_port}), flush=True)
+    node_stop = None
     if with_node:
         # The controller does blocking RPCs to the GCS, so it must live on
         # its own event loop (thread); sharing the GCS loop deadlocks.
         import threading
 
+        node_stop = threading.Event()
+
         def node_thread():
             asyncio.run(run_node(
                 "127.0.0.1", gcs_port, resources, num_workers,
-                worker_env=worker_env,
+                worker_env=worker_env, stop_signal=node_stop,
             ))
 
         threading.Thread(target=node_thread, daemon=True).start()
+    stop = asyncio.Event()
+    _install_sigterm(stop)
     try:
-        await asyncio.Event().wait()
+        await stop.wait()
     finally:
+        if node_stop is not None:
+            # Wake the colocated controller's loop so its finally block
+            # (worker terminate + arena unlink) actually runs.
+            node_stop.set()
+            await asyncio.sleep(0.5)
         await gcs.stop()
 
 
 async def run_node(gcs_host: str, gcs_port: int, resources: dict,
-                   num_workers: int, worker_env: dict | None = None):
+                   num_workers: int, worker_env: dict | None = None,
+                   stop_signal=None):
     from ray_tpu._private.config import get_config
     from ray_tpu.cluster.controller import NodeController
 
@@ -68,8 +91,16 @@ async def run_node(gcs_host: str, gcs_port: int, resources: dict,
     port = await node.start()
     print(json.dumps({"event": "node_started", "port": port,
                       "node_id": node.node_id}), flush=True)
+    stop = asyncio.Event()
+    _install_sigterm(stop)
     try:
-        await asyncio.Event().wait()
+        if stop_signal is not None:
+            # Colocated controller: woken by the head's SIGTERM handler
+            # (threading.Event — this loop is not the signal-owning thread).
+            while not stop_signal.is_set():
+                await asyncio.sleep(0.2)
+        else:
+            await stop.wait()
     finally:
         await node.stop()
 

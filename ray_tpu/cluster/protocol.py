@@ -147,7 +147,9 @@ class RpcClient:
 
     def __init__(self, host: str, port: int,
                  push_handler: Optional[Callable[[Dict], None]] = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 on_close: Optional[Callable[[], None]] = None):
+        self._on_close = on_close
         self.addr = (host, port)
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(None)
@@ -186,6 +188,11 @@ class RpcClient:
             self._closed = True
             for ev in list(self._pending.values()):
                 ev.set()
+            if self._on_close is not None:
+                try:
+                    self._on_close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = bytearray()

@@ -47,6 +47,15 @@ class ClusterNode:
         """Hard-kill the controller (and its workers die with the tasks)."""
         self.proc.kill()
         self.proc.wait()
+        self._unlink_store()
+
+    def _unlink_store(self):
+        """SIGKILL skips the controller's atexit unlink; reap the arena."""
+        if self.node_id:
+            try:
+                os.unlink(f"/dev/shm/rtps-{self.node_id[:12]}")
+            except OSError:
+                pass
 
 
 class Cluster:
@@ -99,7 +108,8 @@ class Cluster:
         self.gcs_port = evt["port"]
         evt = self._read_event(proc, log_path=log_path)  # colocated head node
         assert evt["event"] == "node_started"
-        self.nodes.append(ClusterNode(proc, evt["port"], "head", log_path))
+        self.nodes.append(
+            ClusterNode(proc, evt["port"], evt.get("node_id", ""), log_path))
 
     def add_node(self, resources: Optional[Dict[str, float]] = None,
                  num_workers: int = 2) -> ClusterNode:
@@ -146,6 +156,7 @@ class Cluster:
                 node.proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 node.proc.kill()
+            node._unlink_store()
         self.nodes.clear()
 
     def __enter__(self):

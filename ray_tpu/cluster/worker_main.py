@@ -32,13 +32,24 @@ def main():
     chost, cport = args.controller.rsplit(":", 1)
     ghost, gport = args.gcs.rsplit(":", 1)
 
+    from ray_tpu._native import open_store
     from ray_tpu._private.serialization import get_context
     from ray_tpu.cluster.core_worker import ClusterCoreWorker
     from ray_tpu.cluster.protocol import RpcClient
     from ray_tpu.exceptions import TaskError
 
     inbox: "queue.Queue[Dict]" = queue.Queue()
-    controller = RpcClient(chost, int(cport), push_handler=inbox.put)
+    # A dead controller connection must terminate the worker (otherwise a
+    # SIGKILL'd controller leaves its workers orphaned on inbox.get forever).
+    controller = RpcClient(
+        chost, int(cport), push_handler=inbox.put,
+        on_close=lambda: inbox.put({"type": "shutdown"}),
+    )
+
+    # Attach to the node's shared-memory arena: results are written straight
+    # into shm and dependencies read from it, no blob bytes on the socket.
+    store_name = os.environ.get("RAY_TPU_STORE_NAME", "")
+    local_store = open_store(store_name) if store_name else None
 
     # The worker's own core runtime: nested ray_tpu API calls from task code
     # route through the same cluster machinery.
@@ -46,6 +57,7 @@ def main():
         (ghost, int(gport)), controller_addr=(chost, int(cport)),
         role="worker",
     )
+    core.local_store = local_store
     from ray_tpu._private.worker import global_worker
 
     worker = global_worker()
@@ -84,17 +96,26 @@ def main():
                     type(ser.serialize(None)).from_bytes(payload))
         return pos, kwargs
 
-    def store_result(oid: bytes, value: Any):
-        blob = VAL_PREFIX + ser.serialize(value).to_bytes()
+    def store_blob(oid: bytes, blob: bytes):
+        if local_store is not None:
+            try:
+                local_store.put(oid, blob)
+                controller.call({"type": "object_added", "object_id": oid,
+                                 "size": len(blob)})
+                return
+            except Exception:  # noqa: BLE001 - arena full: spill to RPC path
+                pass
         controller.call({"type": "store_object", "object_id": oid, "blob": blob})
+
+    def store_result(oid: bytes, value: Any):
+        store_blob(oid, VAL_PREFIX + ser.serialize(value).to_bytes())
 
     def store_error(msg, exc: BaseException):
         if not isinstance(exc, TaskError):
             exc = TaskError(msg.get("name", "task"), exc)
         blob = ERR_PREFIX + pickle.dumps(exc)
         for oid in msg["return_ids"]:
-            controller.call({"type": "store_object", "object_id": oid,
-                             "blob": blob})
+            store_blob(oid, blob)
 
     def run_returns(msg, result):
         oids = msg["return_ids"]

@@ -1,0 +1,200 @@
+"""Native shared-memory object store tests.
+
+Mirrors the reference's plasma client test surface
+(src/ray/object_manager/plasma + python plasma tests): create/seal/get
+protocol, immutability, eviction under pressure, pinning semantics, and
+cross-process sharing of one segment.
+"""
+
+import os
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+from ray_tpu._native import (
+    PyObjectStore,
+    ShmObjectStore,
+    StoreFullError,
+    create_store,
+)
+from ray_tpu._native.build import load_native_library
+
+native_available = load_native_library("shm_store") is not None
+
+pytestmark = pytest.mark.skipif(
+    not native_available, reason="native shm_store failed to build"
+)
+
+
+def _name():
+    return f"tpstest-{uuid.uuid4().hex[:12]}"
+
+
+@pytest.fixture
+def store():
+    s = ShmObjectStore(_name(), capacity=8 * 1024 * 1024, create=True)
+    yield s
+    s.close()
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(4, "big") * 6  # 24 bytes == ObjectID.SIZE
+
+
+def test_put_get_roundtrip(store):
+    data = os.urandom(100_000)
+    assert store.put(oid(1), data)
+    assert store.contains(oid(1))
+    assert store.get_bytes(oid(1)) == data
+
+
+def test_double_put_is_noop(store):
+    assert store.put(oid(1), b"first")
+    assert not store.put(oid(1), b"second")
+    assert store.get_bytes(oid(1)) == b"first"
+
+
+def test_missing_object(store):
+    assert store.get(oid(99)) is None
+    assert not store.contains(oid(99))
+
+
+def test_create_seal_two_phase(store):
+    view = store.create(oid(2), 1000)
+    assert view is not None
+    # Unsealed objects are invisible to get/contains.
+    assert store.get(oid(2)) is None
+    assert not store.contains(oid(2))
+    view[:] = b"x" * 1000
+    view.release()
+    store.seal(oid(2))
+    assert store.get_bytes(oid(2)) == b"x" * 1000
+
+
+def test_abort_frees_space(store):
+    view = store.create(oid(3), 4 * 1024 * 1024)
+    view.release()
+    store.abort(oid(3))
+    # The space must be reusable.
+    assert store.put(oid(4), b"y" * (4 * 1024 * 1024))
+
+
+def test_zero_copy_get_is_view(store):
+    data = b"z" * 4096
+    store.put(oid(5), data)
+    buf = store.get(oid(5))
+    with buf as view:
+        assert isinstance(view, memoryview)
+        assert bytes(view[:4]) == b"zzzz"
+
+
+def test_delete(store):
+    store.put(oid(6), b"gone")
+    store.delete(oid(6))
+    assert not store.contains(oid(6))
+    # Deleting again / deleting missing is fine.
+    store.delete(oid(6))
+
+
+def test_delete_deferred_while_pinned(store):
+    store.put(oid(7), b"pinned")
+    buf = store.get(oid(7))
+    store.delete(oid(7))  # deferred: a reader holds a pin
+    assert bytes(buf.view) == b"pinned"
+    buf.release()
+    assert not store.contains(oid(7))
+
+
+def test_lru_eviction_under_pressure(store):
+    blob = os.urandom(1024 * 1024)
+    for i in range(20):  # 20MB into an 8MB arena: oldest get evicted
+        store.put(oid(100 + i), blob)
+    stats = store.stats()
+    assert stats["num_evictions"] > 0
+    assert store.contains(oid(119))  # newest survives
+    assert not store.contains(oid(100))  # oldest evicted
+
+
+def test_pinned_objects_survive_eviction(store):
+    store.put(oid(200), b"keep" * 1000)
+    pin = store.get(oid(200))
+    blob = os.urandom(1024 * 1024)
+    for i in range(20):
+        store.put(oid(300 + i), blob)
+    assert store.contains(oid(200))  # pinned: not evictable
+    pin.release()
+
+
+def test_store_full_when_everything_pinned(store):
+    store.put(oid(400), os.urandom(6 * 1024 * 1024))
+    pin = store.get(oid(400))
+    with pytest.raises(StoreFullError):
+        store.put(oid(401), os.urandom(6 * 1024 * 1024))
+    pin.release()
+
+
+def test_many_small_objects_and_list(store):
+    for i in range(500):
+        store.put(oid(1000 + i), i.to_bytes(8, "big"))
+    ids = store.list_ids()
+    assert len(ids) == 500
+    for i in (0, 250, 499):
+        assert store.get_bytes(oid(1000 + i)) == i.to_bytes(8, "big")
+
+
+def test_stats(store):
+    store.put(oid(500), b"a" * 1000)
+    st = store.stats()
+    assert st["num_objects"] == 1
+    assert st["used_bytes"] >= 1000
+    assert st["arena_bytes"] > 0
+
+
+def test_cross_process_attach():
+    """A second process attaches to the same segment and sees the object
+    without any socket traffic — the plasma worker path."""
+    name = _name()
+    store = ShmObjectStore(name, capacity=4 * 1024 * 1024, create=True)
+    try:
+        store.put(oid(1), b"shared-bytes")
+        child = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys\n"
+                "from ray_tpu._native import ShmObjectStore\n"
+                f"s = ShmObjectStore({name!r}, create=False)\n"
+                f"data = s.get_bytes({oid(1)!r})\n"
+                "assert data == b'shared-bytes', data\n"
+                f"s.put({oid(2)!r}, b'from-child')\n"
+                "s.close()\n"
+            )],
+            capture_output=True, text=True, timeout=60,
+            env=dict(os.environ,
+                     PYTHONPATH=os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))),
+        )
+        assert child.returncode == 0, child.stderr
+        # The parent sees the child's write.
+        assert store.get_bytes(oid(2)) == b"from-child"
+    finally:
+        store.close()
+
+
+def test_fallback_store_same_interface():
+    s = PyObjectStore("fallback", capacity=1024 * 1024)
+    assert s.put(oid(1), b"abc")
+    assert s.get_bytes(oid(1)) == b"abc"
+    buf = s.get(oid(1))
+    s.delete(oid(1))
+    buf.release()
+    s.close()
+
+
+def test_create_store_factory():
+    s = create_store(_name(), 1024 * 1024)
+    try:
+        s.put(oid(1), b"via-factory")
+        assert s.get_bytes(oid(1)) == b"via-factory"
+    finally:
+        s.close()
