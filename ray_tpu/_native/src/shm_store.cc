@@ -358,6 +358,12 @@ int tps_create_obj(void* handle, const uint8_t* id, uint64_t size,
     unlock(h);
     return kAlreadyExists;
   }
+  // An object that can never fit must not trigger the eviction loop below —
+  // it would destroy every idle object before failing anyway.
+  if (align_up(size, kAlign) + sizeof(BlockHeader) > h->hdr->arena_size) {
+    unlock(h);
+    return kOutOfMemory;
+  }
   uint64_t block = alloc_block(h, size);
   while (block == 0) {
     if (!evict_one(h)) {
@@ -396,6 +402,10 @@ int tps_seal(void* handle, const uint8_t* id) {
   if (s == nullptr) {
     unlock(h);
     return kNotFound;
+  }
+  if (s->sealed) {  // idempotent: never steal a reader's pin on re-seal
+    unlock(h);
+    return kAlreadyExists;
   }
   s->sealed = 1;
   if (s->refcount > 0) s->refcount -= 1;

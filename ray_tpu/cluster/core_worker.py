@@ -267,28 +267,33 @@ class ClusterCoreWorker:
 
     def _store_error_blobs(self, return_ids: List[bytes], err: BaseException):
         blob = ERR_PREFIX + pickle.dumps(err)
-        node = self._home_controller()
         for oid in return_ids:
-            node.call({"type": "store_object", "object_id": oid, "blob": blob})
+            self.put_blob(oid, blob)
 
     # ---------------------------------------------------------------- objects
+    def put_blob(self, oid: bytes, blob: bytes) -> None:
+        """Store one serialized blob: straight into the same-host shm arena
+        (notifying the controller) when attached, else over RPC. The single
+        write path for puts, task results, and error blobs."""
+        controller = self._home_controller()
+        if self.local_store is not None:
+            try:
+                self.local_store.put(oid, blob)
+                controller.call({"type": "object_added", "object_id": oid,
+                                 "size": len(blob)})
+                return
+            except ConnectionError:
+                raise
+            except Exception:  # noqa: BLE001 - arena full: RPC/overflow path
+                pass
+        controller.call({"type": "store_object", "object_id": oid,
+                         "blob": blob})
+
     def put(self, value: Any) -> ObjectRef:
         ctx = ensure_context(self)
         oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
         blob = VAL_PREFIX + self._ser.serialize(value).to_bytes()
-        controller = self._home_controller()
-        if self.local_store is not None:
-            try:
-                self.local_store.put(oid.binary(), blob)
-                controller.call({"type": "object_added",
-                                 "object_id": oid.binary(),
-                                 "size": len(blob)})
-                return ObjectRef(oid)
-            except Exception:  # noqa: BLE001 - arena full: RPC path below
-                pass
-        controller.call(
-            {"type": "store_object", "object_id": oid.binary(), "blob": blob}
-        )
+        self.put_blob(oid.binary(), blob)
         return ObjectRef(oid)
 
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:

@@ -96,26 +96,15 @@ def main():
                     type(ser.serialize(None)).from_bytes(payload))
         return pos, kwargs
 
-    def store_blob(oid: bytes, blob: bytes):
-        if local_store is not None:
-            try:
-                local_store.put(oid, blob)
-                controller.call({"type": "object_added", "object_id": oid,
-                                 "size": len(blob)})
-                return
-            except Exception:  # noqa: BLE001 - arena full: spill to RPC path
-                pass
-        controller.call({"type": "store_object", "object_id": oid, "blob": blob})
-
     def store_result(oid: bytes, value: Any):
-        store_blob(oid, VAL_PREFIX + ser.serialize(value).to_bytes())
+        core.put_blob(oid, VAL_PREFIX + ser.serialize(value).to_bytes())
 
     def store_error(msg, exc: BaseException):
         if not isinstance(exc, TaskError):
             exc = TaskError(msg.get("name", "task"), exc)
         blob = ERR_PREFIX + pickle.dumps(exc)
         for oid in msg["return_ids"]:
-            store_blob(oid, blob)
+            core.put_blob(oid, blob)
 
     def run_returns(msg, result):
         oids = msg["return_ids"]

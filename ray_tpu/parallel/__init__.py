@@ -8,3 +8,8 @@ axis, pipeline parallelism via collective permute microbatching.
 """
 
 from .mesh import MeshSpec, make_mesh  # noqa: F401
+from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
+from .pipeline import gpipe, gpipe_sharded  # noqa: F401
+from .moe import MoEConfig, init_moe_params, moe_ffn, moe_param_shardings  # noqa: F401
+from . import collectives  # noqa: F401

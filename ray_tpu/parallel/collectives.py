@@ -1,0 +1,111 @@
+"""Named collective wrappers over ICI/DCN.
+
+The reference delegates collectives to torch.distributed gloo/NCCL
+(``python/ray/util/sgd/torch/distributed_torch_runner.py:35-70``) and has no
+collective library of its own (SURVEY.md §5 "distributed communication
+backend"). TPU-native, collectives are XLA ops scheduled onto ICI by the
+compiler; these wrappers give them the framework's vocabulary and one place
+to document the mesh-axis conventions (ray_tpu.parallel.mesh.AXIS_ORDER).
+
+All functions must be called inside ``shard_map``/``pjit`` with the named
+axis in scope. Gradient behavior follows jax's collective AD rules (psum's
+transpose is psum, ppermute's transpose is the inverse permutation, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def all_reduce_sum(x: jax.Array, axis_name: AxisName) -> jax.Array:
+    """Sum across the axis (the DP gradient reduction; NCCL allreduce)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x: jax.Array, axis_name: AxisName) -> jax.Array:
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_reduce_max(x: jax.Array, axis_name: AxisName) -> jax.Array:
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x: jax.Array, axis_name: AxisName, *, axis: int = 0,
+               tiled: bool = True) -> jax.Array:
+    """Concatenate per-device shards along ``axis`` (NCCL allgather)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis_name: AxisName, *,
+                   axis: int = 0) -> jax.Array:
+    """Sum then scatter shards along ``axis`` (NCCL reduce_scatter); the
+    building block of ZeRO-style sharded optimizers."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def ring_permute(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array:
+    """Rotate shards around the axis ring (the ring-attention/pipeline hop)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x: jax.Array, axis_name: str, *, split_axis: int,
+               concat_axis: int) -> jax.Array:
+    """Transpose shard ownership: split ``split_axis`` across devices while
+    gathering ``concat_axis`` (the Ulysses/MoE dispatch primitive)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def broadcast_from(x: jax.Array, axis_name: str, *, src: int = 0) -> jax.Array:
+    """Every rank gets rank ``src``'s value (masked psum)."""
+    n = jax.lax.axis_size(axis_name)
+    masked = jnp.where(jax.lax.axis_index(axis_name) == src, x,
+                       jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name) if n > 1 else x
+
+
+def axis_index(axis_name: AxisName) -> jax.Array:
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def barrier_value(axis_name: AxisName) -> jax.Array:
+    """A data dependency that forces all ranks to rendezvous (XLA has no
+    standalone barrier; a tiny psum is the idiom)."""
+    return jax.lax.psum(jnp.ones((), jnp.int32), axis_name)
+
+
+def pvary(x: jax.Array, axis_name: AxisName) -> jax.Array:
+    """Mark a replicated value as device-varying for shard_map's vma checks."""
+    try:
+        return jax.lax.pvary(x, axis_name)
+    except AttributeError:  # older jax
+        return x
+
+
+def tree_all_reduce_mean(tree, axis_name: AxisName):
+    """pmean over every leaf — the whole-gradient DP reduction."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.lax.pmean(leaf, axis_name), tree
+    )
+
+
+def global_norm(tree, axis_name: AxisName = None) -> jax.Array:
+    """L2 norm over a (possibly device-sharded) gradient pytree; pass the
+    sharded axis to include remote shards in the norm."""
+    sq = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+             for leaf in jax.tree_util.tree_leaves(tree))
+    if axis_name is not None:
+        sq = jax.lax.psum(sq, axis_name)
+    return jnp.sqrt(sq)

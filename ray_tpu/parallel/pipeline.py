@@ -1,0 +1,123 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Net-new relative to the reference (Ray 0.9 has no pipeline parallelism —
+SURVEY.md §2.3); the closest analogue is streaming's stage-to-stage channels.
+TPU-native design: a GPipe microbatch schedule written as one jit-compiled
+program — stages are mesh shards (shard_map over ``pp``), activations hop to
+the next stage with ``ppermute`` (one ICI neighbor exchange per tick), and
+the whole schedule is a ``lax.scan``, so XLA overlaps each tick's compute
+with the activation transfer.
+
+Schedule (S stages, M microbatches, T = M + S - 1 ticks):
+
+    tick t:  stage s computes f_s on microbatch (t - s), if 0 <= t - s < M;
+             then shifts its activation to stage s+1.
+
+Stages run their bubble ticks on garbage data (results masked out) — on TPU
+it's cheaper to compute-and-mask than to branch per stage.
+
+The primitive is homogeneous-stage (every stage runs ``stage_fn`` with its
+own shard of params — the transformer-block case, which is where pipeline
+depth goes). Embed/head stay outside the pipelined region.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe_sharded(
+    stage_fn: Callable,   # (stage_params, x_mb) -> y_mb, same shape as x_mb
+    stage_params,         # this stage's params (leading layer dim already local)
+    microbatches: jax.Array,  # [M, ...mb...] — read by stage 0, shape-donor elsewhere
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Per-shard GPipe body; call inside shard_map with params sharded over
+    ``axis_name``. Returns [M, ...] outputs, identical on every stage."""
+    n_stage = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_mb = microbatches.shape[0]
+    ticks = n_mb + n_stage - 1
+
+    out_buf = jnp.zeros_like(microbatches)
+    state = jnp.zeros_like(microbatches[0])
+
+    def tick(carry, t):
+        state, out_buf = carry
+        # Stage 0 ingests microbatch t (clamped; bubble results are masked).
+        fresh = microbatches[jnp.clip(t, 0, n_mb - 1)]
+        x = jnp.where(stage == 0, fresh, state)
+        y = stage_fn(stage_params, x)
+        # The last stage emits microbatch t - (S-1) when it's a real one.
+        out_idx = t - (n_stage - 1)
+        is_out = jnp.logical_and(stage == n_stage - 1,
+                                 jnp.logical_and(out_idx >= 0, out_idx < n_mb))
+        written = jax.lax.dynamic_update_index_in_dim(
+            out_buf, y, jnp.clip(out_idx, 0, n_mb - 1), 0
+        )
+        out_buf = jnp.where(is_out, written, out_buf)
+        # One ICI hop: activation moves to the next stage.
+        state = jax.lax.ppermute(
+            y, axis_name, [(i, (i + 1) % n_stage) for i in range(n_stage)]
+        )
+        return (state, out_buf), None
+
+    (state, out_buf), _ = jax.lax.scan(
+        tick, (state, out_buf), jnp.arange(ticks)
+    )
+    # Broadcast the last stage's buffer to every stage (masked psum): callers
+    # downstream of the pipeline (loss/head) see the full output everywhere.
+    mask = (stage == n_stage - 1).astype(out_buf.dtype)
+    return jax.lax.psum(out_buf * mask, axis_name)
+
+
+def gpipe(
+    stage_fn: Callable,
+    params,                # pytree with leading [L, ...] layer dim, L % S == 0
+    x: jax.Array,          # [B, ...] global input
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Global entry: runs ``stage_fn`` as a pipeline over ``mesh``'s
+    ``axis_name`` axis with ``num_microbatches`` splits of the batch.
+
+    ``stage_fn(layer_params, x) -> x`` applies ONE layer; layers are stacked
+    on the params' leading dim and split contiguously across stages; each
+    stage scans its local layers per tick.
+    """
+    n_stage = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % num_microbatches != 0:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {num_microbatches}")
+    n_layers = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if n_layers % n_stage != 0:
+        raise ValueError(f"{n_layers} layers not divisible over "
+                         f"{n_stage} stages")
+
+    mb = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+
+    def stage_body(stage_params, x_mb):
+        # Scan this stage's local slice of layers.
+        def one(x, layer_params):
+            return stage_fn(layer_params, x), None
+
+        y, _ = jax.lax.scan(one, x_mb, stage_params)
+        return y
+
+    body = functools.partial(gpipe_sharded, stage_body, axis_name=axis_name)
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), params)
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P()), out_specs=P(),
+        check_vma=False,
+    )(params, mb)
+    return out.reshape(batch, *x.shape[1:])

@@ -1,0 +1,72 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence re-sharding.
+
+Net-new vs the reference (no sequence parallelism existed in Ray 0.9 —
+SURVEY.md §5). Complementary to ring attention: instead of rotating KV
+blocks, Ulysses re-shards [B, T/S, H, D] -> [B, T, H/S, D] with one
+``all_to_all`` on each side of attention, so every device runs *dense*
+attention over the full sequence for its subset of heads. Two collectives
+total (vs S ppermute hops for ring) — better when H >= S and the sequence
+fits; ring wins at extreme lengths. Both ride the ``sp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import _repeat_kv, flash_attention
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,  # [B, T/S, H, D] — this device's sequence shard
+    k: jax.Array,  # [B, T/S, KH, D]
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard body; call inside shard_map with sequence sharded on
+    ``axis_name``. Requires n_heads % axis_size == 0."""
+    sp = jax.lax.axis_size(axis_name)
+    n_heads = q.shape[2]
+    if n_heads % sp != 0:
+        raise ValueError(f"n_heads={n_heads} not divisible by sp={sp}")
+    # GQA: replicate KV heads up to H first so the head split is uniform.
+    k = _repeat_kv(k, n_heads)
+    v = _repeat_kv(v, n_heads)
+
+    # [B, T/S, H, D] -> [B, T, H/S, D]: trade sequence shards for head shards.
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    q_full, k_full, v_full = a2a(q), a2a(k), a2a(v)
+
+    if scale is not None and scale != q.shape[-1] ** -0.5:
+        # flash_attention fixes scale = D**-0.5; fold a custom scale into q.
+        q_full = q_full * (scale * q.shape[-1] ** 0.5)
+    out = flash_attention(q_full, k_full, v_full, causal=causal)
+
+    # [B, T, H/S, D] -> [B, T/S, H, D]: back to sequence sharding.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, T, H, D] — global arrays
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Global entry: shard_map over (dp, sp, tp) with all_to_all re-sharding
+    around dense attention."""
+    spec = P("dp", "sp", "tp", None)
+    fn = functools.partial(ulysses_attention_sharded, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,18 @@
+"""Distributed training library (the RaySGD replacement).
+
+Two complementary trainers:
+
+- ``MeshTrainer`` — the TPU-native fast path: one controller, a global
+  ``jax.sharding.Mesh``, a pjit'd train step with dp/tp/sp/pp shardings.
+  XLA inserts the collectives; this is how training actually runs fast on
+  TPU slices (replaces torch DDP + NCCL allreduce with GSPMD).
+- ``TPUTrainer`` — actor-based data parallelism with elastic fault
+  tolerance, mirroring the reference's TorchTrainer semantics
+  (``python/ray/util/sgd/torch/torch_trainer.py:39``): N worker actors,
+  gradient averaging, worker-failure recovery and resizing, checkpointing.
+  Use it when workers must be separate processes/hosts outside one jax
+  runtime (the RaySGD-shaped contract).
+"""
+
+from .mesh_trainer import MeshTrainer, TrainState  # noqa: F401
+from .trainer import TPUTrainer  # noqa: F401

@@ -1,0 +1,264 @@
+"""Tests for the extended parallel layer: Ulysses SP, GPipe, MoE,
+collectives. All on the 8-device virtual CPU mesh (conftest)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import attention_reference
+from ray_tpu.parallel import (
+    MoEConfig,
+    collectives,
+    gpipe,
+    init_moe_params,
+    moe_ffn,
+    moe_param_shardings,
+    ulysses_attention,
+)
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshSpec(dp=2, pp=1, sp=2, tp=2))
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return make_mesh(MeshSpec(dp=2, pp=4, sp=1, tp=1))
+
+
+def _qkv(B=4, T=64, H=4, KH=4, D=32, dtype=jnp.float32):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, T, H, D), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, T, KH, D), dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, T, KH, D), dtype)
+    return q, k, v
+
+
+class TestUlysses:
+    def test_matches_reference_causal(self, mesh):
+        q, k, v = _qkv()
+        out = ulysses_attention(q, k, v, mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_matches_reference_non_causal(self, mesh):
+        q, k, v = _qkv()
+        out = ulysses_attention(q, k, v, mesh, causal=False)
+        ref = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_kv_heads(self, mesh):
+        q, k, v = _qkv(H=8, KH=2)
+        out = ulysses_attention(q, k, v, mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grads_flow(self, mesh):
+        q, k, v = _qkv(B=2, T=32)
+
+        def loss(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, mesh) ** 2)
+
+        g = jax.grad(loss)(q, k, v)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestGPipe:
+    def test_matches_sequential(self, pp_mesh):
+        """4-stage pipeline over 8 stacked linear+relu layers == running the
+        layers sequentially."""
+        L, B, E = 8, 16, 32
+        key = jax.random.PRNGKey(1)
+        ws = jax.random.normal(key, (L, E, E)) * 0.3
+        bs = jax.random.normal(jax.random.fold_in(key, 1), (L, E)) * 0.1
+        params = {"w": ws, "b": bs}
+        x = jax.random.normal(jax.random.fold_in(key, 2), (B, E))
+
+        def layer(p, x):
+            return jax.nn.relu(x @ p["w"] + p["b"])
+
+        out = gpipe(layer, params, x, pp_mesh, num_microbatches=4)
+
+        expect = x
+        for i in range(L):
+            expect = jax.nn.relu(expect @ ws[i] + bs[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_single_microbatch(self, pp_mesh):
+        L, B, E = 4, 4, 16
+        key = jax.random.PRNGKey(2)
+        params = {"w": jax.random.normal(key, (L, E, E)) * 0.3}
+        x = jax.random.normal(jax.random.fold_in(key, 1), (B, E))
+
+        def layer(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        out = gpipe(layer, params, x, pp_mesh, num_microbatches=1)
+        expect = x
+        for i in range(L):
+            expect = jnp.tanh(expect @ params["w"][i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_sequential(self, pp_mesh):
+        L, B, E = 4, 8, 16
+        key = jax.random.PRNGKey(3)
+        params = {"w": jax.random.normal(key, (L, E, E)) * 0.3}
+        x = jax.random.normal(jax.random.fold_in(key, 1), (B, E))
+
+        def layer(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def pipe_loss(params):
+            return jnp.sum(gpipe(layer, params, x, pp_mesh,
+                                 num_microbatches=2) ** 2)
+
+        def seq_loss(params):
+            y = x
+            for i in range(L):
+                y = jnp.tanh(y @ params["w"][i])
+            return jnp.sum(y ** 2)
+
+        g_pipe = jax.grad(pipe_loss)(params)
+        g_seq = jax.grad(seq_loss)(params)
+        np.testing.assert_allclose(np.asarray(g_pipe["w"]),
+                                   np.asarray(g_seq["w"]),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_validation_errors(self, pp_mesh):
+        params = {"w": jnp.zeros((6, 8, 8))}  # 6 layers over 4 stages: no
+
+        def layer(p, x):
+            return x
+
+        with pytest.raises(ValueError):
+            gpipe(layer, params, jnp.zeros((8, 8)), pp_mesh,
+                  num_microbatches=2)
+        params = {"w": jnp.zeros((8, 8, 8))}
+        with pytest.raises(ValueError):
+            gpipe(layer, params, jnp.zeros((7, 8)), pp_mesh,
+                  num_microbatches=2)  # batch 7 % 2 != 0
+
+
+class TestMoE:
+    def _cfg(self, **kw):
+        return MoEConfig(d_model=32, d_ff=64, n_experts=4, top_k=2,
+                         dtype=jnp.float32, **kw)
+
+    def test_forward_shape_and_finite(self):
+        cfg = self._cfg()
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+        y, aux = moe_ffn(x, params, cfg)
+        assert y.shape == x.shape
+        assert np.isfinite(np.asarray(y)).all()
+        assert float(aux) > 0
+
+    def test_gating_selects_topk_only(self):
+        """With capacity_factor high enough nothing drops; output is a
+        convex combination over <= top_k experts per token."""
+        cfg = self._cfg(capacity_factor=4.0)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 32))
+        y, _ = moe_ffn(x, params, cfg)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_grads_flow_incl_router(self):
+        cfg = self._cfg()
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+
+        def loss(params):
+            y, aux = moe_ffn(x, params, cfg)
+            return jnp.sum(y ** 2) + aux
+
+        g = jax.grad(loss)(params)
+        for name in ("router", "w_gate", "w_up", "w_down"):
+            leaf = np.asarray(g[name])
+            assert np.isfinite(leaf).all()
+            assert np.abs(leaf).sum() > 0, f"zero grad through {name}"
+
+    def test_expert_parallel_matches_single_device(self, mesh):
+        """Sharding experts over tp must not change the math."""
+        cfg = self._cfg()
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 32))
+        y_local, aux_local = moe_ffn(x, params, cfg)
+
+        shardings = moe_param_shardings(cfg, mesh, axis="tp")
+        params_sharded = jax.tree_util.tree_map(
+            jax.device_put, params, shardings)
+        y_sharded, aux_sharded = jax.jit(
+            functools.partial(moe_ffn, cfg=cfg))(x, params_sharded)
+        np.testing.assert_allclose(np.asarray(y_local),
+                                   np.asarray(y_sharded),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux_local), float(aux_sharded),
+                                   atol=1e-6)
+
+    def test_capacity_drops_tokens(self):
+        """Tiny capacity must drop tokens (gates zeroed) without NaNs."""
+        cfg = self._cfg(capacity_factor=0.1)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32))
+        y, _ = moe_ffn(x, params, cfg)
+        assert np.isfinite(np.asarray(y)).all()
+
+
+class TestCollectives:
+    def test_all_reduce_and_gather(self, mesh):
+        def body(x):
+            s = collectives.all_reduce_sum(x, "tp")
+            g = collectives.all_gather(x, "tp", axis=0)
+            return s, g
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        s, g = jax.shard_map(
+            body, mesh=mesh, in_specs=P("tp"), out_specs=(P("tp"), P("tp")),
+            check_vma=False,
+        )(x)
+        assert s.shape == (8, 1)
+        assert g.shape == (16, 1)
+
+    def test_reduce_scatter(self, mesh):
+        def body(x):
+            return collectives.reduce_scatter(x, "tp", axis=0)
+
+        x = jnp.ones((8, 4), jnp.float32)
+        out = jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+                            out_specs=P("tp"), check_vma=False)(x)
+        # Each rank keeps 1/tp of the summed rows: global [8/tp, 4] of 2.0.
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_ring_permute(self, mesh):
+        def body(x):
+            return collectives.ring_permute(x, "sp")
+
+        x = jnp.asarray([[1.0], [2.0]])
+        out = jax.shard_map(body, mesh=mesh, in_specs=P("sp"),
+                            out_specs=P("sp"), check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(out), [[2.0], [1.0]])
+
+    def test_broadcast_from(self, mesh):
+        def body(x):
+            return collectives.broadcast_from(x, "tp", src=1)
+
+        x = jnp.asarray([[3.0], [7.0]])
+        out = jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+                            out_specs=P("tp"), check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(out), [[7.0], [7.0]])
+
+    def test_global_norm(self):
+        tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+        assert float(collectives.global_norm(tree)) == pytest.approx(5.0)

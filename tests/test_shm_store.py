@@ -90,6 +90,26 @@ def test_zero_copy_get_is_view(store):
         assert bytes(view[:4]) == b"zzzz"
 
 
+def test_reseal_keeps_reader_pin(store):
+    """Sealing twice must not steal a live reader's refcount."""
+    store.put(oid(8), b"pinme")
+    buf = store.get(oid(8))          # refcount 1
+    store.seal(oid(8))               # idempotent no-op
+    store.delete(oid(8))             # must defer: reader still pinned
+    assert bytes(buf.view) == b"pinme"
+    buf.release()
+    assert not store.contains(oid(8))
+
+
+def test_oversized_put_does_not_wipe_store(store):
+    """A hopeless allocation must fail fast, not evict everything idle."""
+    store.put(oid(9), b"survivor")
+    with pytest.raises(StoreFullError):
+        store.put(oid(10), b"x" * (64 * 1024 * 1024))  # 64MB into 8MB arena
+    assert store.contains(oid(9))
+    assert store.stats()["num_evictions"] == 0
+
+
 def test_delete(store):
     store.put(oid(6), b"gone")
     store.delete(oid(6))

@@ -1,0 +1,158 @@
+"""Trainer library tests.
+
+Mirrors the reference's RaySGD test surface
+(``python/ray/util/sgd/tests/test_torch.py``): train-loss goes down,
+validate, state_dict save/restore round-trips, elastic resize, and
+worker-failure recovery. MeshTrainer additionally runs the sharded SPMD
+path on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.train import MeshTrainer, TPUTrainer
+
+DIM = 8
+TRUE_W = np.linspace(-1.0, 1.0, DIM).astype(np.float32)
+
+
+def init_fn(rng):
+    return {"w": jnp.zeros((DIM,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def loss_fn(params, batch):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _batches(seed, batch_size=32):
+    rng = np.random.default_rng(seed)
+    while True:
+        x = rng.standard_normal((batch_size, DIM)).astype(np.float32)
+        y = x @ TRUE_W + 0.5
+        yield {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def data_creator(rank, world_size, config):
+    return _batches(seed=1000 + rank)
+
+
+class TestMeshTrainer:
+    def test_loss_decreases_single_device(self):
+        t = MeshTrainer(init_fn, loss_fn, learning_rate=0.1)
+        first = t.train(_batches(0), num_steps=5)
+        last = t.train(_batches(1), num_steps=40)
+        assert last["loss"] < first["loss"]
+        assert t.state.step == 45
+
+    def test_sharded_dp_training(self):
+        mesh = make_mesh(MeshSpec(dp=8, pp=1, sp=1, tp=1))
+        shardings = {"w": NamedSharding(mesh, P()),
+                     "b": NamedSharding(mesh, P())}
+        t = MeshTrainer(
+            init_fn, loss_fn, learning_rate=0.1, mesh=mesh,
+            param_shardings=shardings, batch_spec=P("dp"),
+        )
+        stats = t.train(_batches(0), num_steps=30)
+        assert stats["loss"] < 2.0
+        w = np.asarray(jax.device_get(t.state.params["w"]))
+        assert np.abs(w - TRUE_W).mean() < 0.5
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        t = MeshTrainer(init_fn, loss_fn, learning_rate=0.1)
+        t.train(_batches(0), num_steps=10)
+        path = str(tmp_path / "ckpt.pkl")
+        t.save(path)
+        t2 = MeshTrainer(init_fn, loss_fn, learning_rate=0.1)
+        t2.restore(path)
+        assert t2.state.step == 10
+        np.testing.assert_allclose(
+            np.asarray(t2.state.params["w"]),
+            np.asarray(t.state.params["w"]))
+
+    def test_evaluate(self):
+        t = MeshTrainer(init_fn, loss_fn, learning_rate=0.1)
+        t.train(_batches(0), num_steps=30)
+        val = t.evaluate(_batches(7), num_batches=3)
+        assert val["val_loss"] < 3.0
+
+
+@pytest.mark.usefixtures("local_ray")
+class TestTPUTrainer:
+    def _trainer(self, **kw):
+        kw.setdefault("num_workers", 2)
+        kw.setdefault("learning_rate", 0.1)
+        return TPUTrainer(init_fn, loss_fn, data_creator, **kw)
+
+    def test_loss_decreases(self):
+        t = self._trainer()
+        try:
+            first = t.train(num_steps=2)
+            later = t.train(num_steps=20)
+            assert later["loss"] < first["loss"]
+            assert t.step == 22
+        finally:
+            t.shutdown()
+
+    def test_validate(self):
+        t = self._trainer()
+        try:
+            t.train(num_steps=20)
+            val = t.validate(num_batches=2)
+            assert val["val_loss"] < 3.0
+        finally:
+            t.shutdown()
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        t = self._trainer()
+        try:
+            t.train(num_steps=5)
+            path = t.save(str(tmp_path / "sgd.pkl"))
+        finally:
+            t.shutdown()
+        t2 = self._trainer()
+        try:
+            t2.restore(path)
+            assert t2.step == 5
+        finally:
+            t2.shutdown()
+
+    def test_elastic_resize(self):
+        t = self._trainer(num_workers=2)
+        try:
+            t.train(num_steps=3)
+            t.resize(3)
+            assert len(t.workers) == 3
+            stats = t.train(num_steps=3)
+            assert stats["num_steps"] == 3
+        finally:
+            t.shutdown()
+
+    def test_worker_failure_recovery(self):
+        t = self._trainer(num_workers=2, max_retries=2)
+        try:
+            t.train(num_steps=2)
+            # Kill one worker out from under the trainer; the next train()
+            # must recover by rebuilding the worker set.
+            import ray_tpu
+
+            ray_tpu.kill(t.workers[0])
+            stats = t.train(num_steps=3)
+            assert stats["num_steps"] == 3
+            assert t.step == 5
+        finally:
+            t.shutdown()
+
+    def test_same_init_across_workers(self):
+        """All ranks must start from identical params (same seed)."""
+        t = self._trainer(num_workers=2)
+        try:
+            stats = t.train(num_steps=1)
+            assert np.isfinite(stats["loss"])
+        finally:
+            t.shutdown()
