@@ -94,7 +94,9 @@ class ClusterCoreWorker:
             except (ConnectionError, OSError):
                 self.gcs.call({"type": "report_node_dead",
                                "node_id": n["NodeID"]})
-        raise RuntimeError("no reachable nodes in cluster")
+        from ..exceptions import ClusterUnavailableError
+
+        raise ClusterUnavailableError("no reachable nodes in cluster")
 
     def _export_fn(self, fn: Callable) -> bytes:
         blob = cloudpickle.dumps(fn)
@@ -153,8 +155,10 @@ class ClusterCoreWorker:
                 last_err = e
                 self.gcs.call({"type": "report_node_dead",
                                "node_id": placement["node_id"]})
-        raise RuntimeError(f"could not deliver task after {attempts} "
-                           f"placements: {last_err}")
+        from ..exceptions import ClusterUnavailableError
+
+        raise ClusterUnavailableError(
+            f"could not deliver task after {attempts} placements: {last_err}")
 
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
         fn_id = self._export_fn(fn)

@@ -109,6 +109,11 @@ class RuntimeEnvError(RayTpuError):
     pass
 
 
+class ClusterUnavailableError(RayTpuError):
+    """Cluster infrastructure failure (no reachable nodes, undeliverable
+    task) — distinct from user-code errors so callers can retry safely."""
+
+
 __all__ = [
     "RayTpuError",
     "TaskError",

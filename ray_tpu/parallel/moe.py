@@ -15,6 +15,7 @@ auxiliary loss (Shazeer et al.; public Switch/GShard recipe).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Dict, Tuple
 
 import jax
@@ -91,7 +92,7 @@ def moe_ffn(x: jax.Array, params: Params, cfg: MoEConfig,
     aux_loss = cfg.aux_loss_weight * jnp.mean(frac_routed * mean_prob)
 
     # --- capacity assignment ------------------------------------------------
-    capacity = int(max(1, (K * n_tok * cfg.capacity_factor) // N))
+    capacity = int(max(1, math.ceil(K * n_tok * cfg.capacity_factor / N)))
     # Position of each (token, k) choice within its expert's queue.
     flat_choice = one_hot_k.reshape(n_tok * K, N)
     position = (jnp.cumsum(flat_choice, axis=0) - flat_choice).reshape(

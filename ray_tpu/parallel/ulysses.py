@@ -33,16 +33,25 @@ def ulysses_attention_sharded(
     ``axis_name``. Requires n_heads % axis_size == 0."""
     sp = jax.lax.axis_size(axis_name)
     n_heads = q.shape[2]
+    kv_heads = k.shape[2]
     if n_heads % sp != 0:
         raise ValueError(f"n_heads={n_heads} not divisible by sp={sp}")
-    # GQA: replicate KV heads up to H first so the head split is uniform.
-    k = _repeat_kv(k, n_heads)
-    v = _repeat_kv(v, n_heads)
 
     # [B, T/S, H, D] -> [B, T, H/S, D]: trade sequence shards for head shards.
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             split_axis=2, concat_axis=1, tiled=True)
-    q_full, k_full, v_full = a2a(q), a2a(k), a2a(v)
+    if kv_heads % sp == 0:
+        # GQA: all_to_all the original KV heads (H/KH× less ICI traffic),
+        # then replicate locally up to this shard's query-head count.
+        q_full, k_full, v_full = a2a(q), a2a(k), a2a(v)
+        k_full = _repeat_kv(k_full, n_heads // sp)
+        v_full = _repeat_kv(v_full, n_heads // sp)
+    else:
+        # KV heads don't split across sp: replicate up to H first so the
+        # head split is uniform.
+        k = _repeat_kv(k, n_heads)
+        v = _repeat_kv(v, n_heads)
+        q_full, k_full, v_full = a2a(q), a2a(k), a2a(v)
 
     if scale is not None and scale != q.shape[-1] ** -0.5:
         # flash_attention fixes scale = D**-0.5; fold a custom scale into q.

@@ -82,6 +82,7 @@ class MeshTrainer:
 
         donate_args = (0, 1) if donate else ()
         self._step = jax.jit(step_fn, donate_argnums=donate_args)
+        self._eval_step = jax.jit(loss_fn)
 
     # ------------------------------------------------------------------ train
     def _device_batch(self, batch):
@@ -90,23 +91,30 @@ class MeshTrainer:
         return jax.tree_util.tree_map(
             lambda leaf: jax.device_put(leaf, self._batch_sharding), batch)
 
-    def train_step(self, batch) -> float:
+    def _train_step_async(self, batch):
+        """One step; returns the loss as an unmaterialized device scalar so
+        host dispatch overlaps device execution."""
         batch = self._device_batch(batch)
         params, opt_state, loss = self._step(
             self.state.params, self.state.opt_state, batch)
         self.state = TrainState(params, opt_state, self.state.step + 1)
-        return float(loss)
+        return loss
+
+    def train_step(self, batch) -> float:
+        return float(self._train_step_async(batch))
 
     def train(self, data: Iterable, num_steps: int) -> Dict[str, float]:
         """Runs ``num_steps`` over ``data``; returns throughput stats
-        (mirrors TorchTrainer.train's stats dict)."""
+        (mirrors TorchTrainer.train's stats dict). Losses stay on device
+        until the end of the loop — no per-step host sync."""
         it = iter(data)
         losses = []
         t0 = time.perf_counter()
         for _ in range(num_steps):
-            losses.append(self.train_step(next(it)))
+            losses.append(self._train_step_async(next(it)))
         jax.block_until_ready(self.state.params)
         dt = time.perf_counter() - t0
+        losses = [float(l) for l in losses]
         return {
             "loss": sum(losses) / max(len(losses), 1),
             "last_loss": losses[-1] if losses else float("nan"),
@@ -117,11 +125,10 @@ class MeshTrainer:
 
     def evaluate(self, data: Iterable, num_batches: int) -> Dict[str, float]:
         it = iter(data)
-        eval_loss = jax.jit(self.loss_fn)
         total = 0.0
         for _ in range(num_batches):
-            total += float(eval_loss(self.state.params,
-                                     self._device_batch(next(it))))
+            total += float(self._eval_step(self.state.params,
+                                           self._device_batch(next(it))))
         return {"val_loss": total / max(num_batches, 1)}
 
     # ------------------------------------------------------------- checkpoint

@@ -21,7 +21,13 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 
 from .. import api as _api
-from ..exceptions import ActorDiedError, RayTpuError, WorkerCrashedError
+from ..exceptions import (
+    ActorDiedError,
+    ClusterUnavailableError,
+    NodeDiedError,
+    RayTpuError,
+    WorkerCrashedError,
+)
 from ..remote_function import remote
 
 
@@ -152,8 +158,8 @@ class TPUTrainer:
         while len(losses) < num_steps:
             try:
                 losses.append(self._try_one_step())
-            except (ActorDiedError, WorkerCrashedError, RayTpuError,
-                    RuntimeError):
+            except (ActorDiedError, WorkerCrashedError,
+                    ClusterUnavailableError, NodeDiedError):
                 retries += 1
                 if retries > self.max_retries:
                     raise
@@ -163,7 +169,7 @@ class TPUTrainer:
         dt = time.perf_counter() - t0
         return {
             "loss": sum(losses) / max(len(losses), 1),
-            "last_loss": losses[-1],
+            "last_loss": losses[-1] if losses else float("nan"),
             "num_steps": num_steps,
             "step": self.step,
             "retries": retries,

@@ -1,0 +1,266 @@
+"""Tests for ray_tpu.util — parallel iterators, actor pool, queue, mp pool.
+
+Mirrors reference test coverage: python/ray/tests (test_iter, actor pool,
+multiprocessing) — behavior-level, local runtime.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    ActorPool,
+    Empty,
+    ParallelIteratorWorker,
+    Queue,
+    from_actors,
+    from_items,
+    from_iterators,
+    from_range,
+)
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture
+def ray_local():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- iterators
+
+def test_from_items_gather_sync(ray_local):
+    it = from_items(list(range(10)), num_shards=2)
+    assert sorted(it.gather_sync().take(10)) == list(range(10))
+
+
+def test_from_range_shards(ray_local):
+    it = from_range(8, num_shards=4)
+    assert it.num_shards() == 4
+    assert sorted(x for x in it) == list(range(8))
+
+
+def test_for_each_filter_batch_flatten(ray_local):
+    it = from_items(list(range(8)), num_shards=2)
+    out = it.for_each(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert sorted(out.take(10)) == [0, 4, 8, 12]
+
+    it2 = from_items(list(range(6)), num_shards=1).batch(2)
+    batches = it2.take(3)
+    assert batches == [[0, 1], [2, 3], [4, 5]]
+    assert from_items(list(range(6)), num_shards=1).batch(2).flatten().take(6) \
+        == [0, 1, 2, 3, 4, 5]
+
+
+def test_gather_async(ray_local):
+    it = from_items(list(range(12)), num_shards=3)
+    got = sorted(it.gather_async(num_async=2).take(12))
+    assert got == list(range(12))
+
+
+def test_batch_across_shards(ray_local):
+    it = from_range(6, num_shards=2)
+    rows = list(it.batch_across_shards())
+    assert len(rows) == 3
+    assert sorted(x for row in rows for x in row) == list(range(6))
+
+
+def test_union_and_select_shards(ray_local):
+    a = from_items([1, 2], num_shards=1)
+    b = from_items([3, 4], num_shards=1)
+    u = a.union(b)
+    assert u.num_shards() == 2
+    assert sorted(u.take(4)) == [1, 2, 3, 4]
+
+    it = from_range(8, num_shards=4)
+    sel = it.select_shards([0, 1])
+    assert sel.num_shards() == 2
+
+
+def test_repartition(ray_local):
+    it = from_items(list(range(10)), num_shards=2)
+    rep = it.repartition(3)
+    assert rep.num_shards() == 3
+    assert sorted(rep.gather_sync().take(10)) == list(range(10))
+
+
+def test_local_shuffle_preserves_elements(ray_local):
+    it = from_items(list(range(20)), num_shards=1).local_shuffle(5, seed=0)
+    assert sorted(it.take(20)) == list(range(20))
+
+
+def test_get_shard(ray_local):
+    it = from_range(10, num_shards=2)
+    s0 = it.get_shard(0).take(100)
+    s1 = it.get_shard(1).take(100)
+    assert sorted(s0 + s1) == list(range(10))
+
+
+def test_from_actors_custom_worker(ray_local):
+    @ray_tpu.remote
+    class MyWorker(ParallelIteratorWorker):
+        def __init__(self, items):
+            super().__init__(items, False)
+
+    actors = [MyWorker.remote([1, 2]), MyWorker.remote([3, 4])]
+    it = from_actors(actors)
+    assert sorted(it.take(4)) == [1, 2, 3, 4]
+
+
+def test_local_iterator_metrics(ray_local):
+    from ray_tpu.util.iter import LocalIterator
+
+    it = from_items(list(range(4)), num_shards=1).gather_sync()
+
+    def count(x):
+        m = LocalIterator.get_metrics()
+        m.counters["n"] += 1
+        return x
+
+    out = it.for_each(count)
+    out.take(4)
+    assert out.shared_metrics.counters["n"] == 4
+
+
+def test_local_iterator_duplicate(ray_local):
+    it = from_items(list(range(5)), num_shards=1).gather_sync()
+    a, b = it.duplicate(2)
+    assert a.take(5) == b.take(5) == list(range(5))
+
+
+# ---------------------------------------------------------------- actor pool
+
+def test_actor_pool_map(ray_local):
+    @ray_tpu.remote
+    class A:
+        def double(self, v):
+            return 2 * v
+
+    pool = ActorPool([A.remote(), A.remote()])
+    assert list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4])) \
+        == [2, 4, 6, 8]
+
+
+def test_actor_pool_unordered_and_reuse(ray_local):
+    @ray_tpu.remote
+    class A:
+        def double(self, v):
+            return 2 * v
+
+    pool = ActorPool([A.remote()])
+    got = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(5)))
+    assert got == [0, 2, 4, 6, 8]
+    # pool is reusable after a full drain
+    assert list(pool.map(lambda a, v: a.double.remote(v), [10])) == [20]
+
+
+def test_actor_pool_submit_get_next(ray_local):
+    @ray_tpu.remote
+    class A:
+        def f(self, v):
+            return v + 1
+
+    pool = ActorPool([A.remote(), A.remote()])
+    for i in range(4):
+        pool.submit(lambda a, v: a.f.remote(v), i)
+    results = [pool.get_next() for _ in range(4)]
+    assert results == [1, 2, 3, 4]
+    assert not pool.has_next()
+
+
+# ---------------------------------------------------------------- queue
+
+def test_queue_fifo(ray_local):
+    q = Queue()
+    q.put(1)
+    q.put(2)
+    assert q.size() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_queue_maxsize(ray_local):
+    from ray_tpu.util import Full
+
+    q = Queue(maxsize=1)
+    q.put("a")
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait("b")
+    assert q.get() == "a"
+    q.put("b")
+
+
+def test_queue_passed_to_task(ray_local):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(3):
+            q.put(i)
+        return "done"
+
+    assert ray_tpu.get(producer.remote(q)) == "done"
+    assert [q.get(timeout=5) for _ in range(3)] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------- mp pool
+
+def _sq(x):
+    return x * x
+
+
+def test_mp_pool_map(ray_local):
+    with Pool(2) as p:
+        assert p.map(_sq, range(6)) == [0, 1, 4, 9, 16, 25]
+
+
+def test_mp_pool_apply_starmap(ray_local):
+    import operator
+
+    with Pool(2) as p:
+        assert p.apply(operator.add, (1, 2)) == 3
+        r = p.apply_async(operator.mul, (3, 4))
+        assert r.get(timeout=10) == 12
+        assert p.starmap(operator.add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_mp_pool_imap(ray_local):
+    with Pool(2) as p:
+        assert list(p.imap(_sq, range(5), chunksize=2)) == [0, 1, 4, 9, 16]
+        assert sorted(p.imap_unordered(_sq, range(5), chunksize=2)) \
+            == [0, 1, 4, 9, 16]
+
+
+def test_named_actor_registry(ray_local):
+    from ray_tpu.util import get_actor as util_get_actor
+    from ray_tpu.util import register_actor
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    register_actor("my_counter", c)
+    c2 = util_get_actor("my_counter")
+    assert ray_tpu.get(c2.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote()) == 2
+
+
+def test_joblib_backend(ray_local):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
