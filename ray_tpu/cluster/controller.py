@@ -276,9 +276,10 @@ class NodeController:
     async def _fail_task(self, task: Dict, message: str, crashed: bool = False):
         import pickle
 
-        from ..exceptions import WorkerCrashedError
+        from ..exceptions import ClusterUnavailableError, WorkerCrashedError
 
-        err = WorkerCrashedError(message) if crashed else RuntimeError(message)
+        err = (WorkerCrashedError(message) if crashed
+               else ClusterUnavailableError(message))
         blob = ERR_PREFIX + pickle.dumps(err)
         for oid in task["return_ids"]:
             await self._store_put(oid, blob)

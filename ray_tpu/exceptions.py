@@ -127,4 +127,5 @@ __all__ = [
     "GetTimeoutError",
     "TaskCancelledError",
     "RuntimeEnvError",
+    "ClusterUnavailableError",
 ]

@@ -25,6 +25,7 @@ from ..exceptions import (
     ActorDiedError,
     ClusterUnavailableError,
     NodeDiedError,
+    ObjectLostError,
     RayTpuError,
     WorkerCrashedError,
 )
@@ -159,7 +160,7 @@ class TPUTrainer:
             try:
                 losses.append(self._try_one_step())
             except (ActorDiedError, WorkerCrashedError,
-                    ClusterUnavailableError, NodeDiedError):
+                    ClusterUnavailableError, NodeDiedError, ObjectLostError):
                 retries += 1
                 if retries > self.max_retries:
                     raise

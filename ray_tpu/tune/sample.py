@@ -1,0 +1,46 @@
+"""Search-space sampling primitives (reference: tune's grid_search /
+sample_from / tune.uniform-family helpers)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Sequence
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    """Mark a config key for exhaustive expansion."""
+    return {"grid_search": list(values)}
+
+
+class sample_from:
+    """Defer a config value to a callable of the resolved spec."""
+
+    def __init__(self, func: Callable[[Dict], Any]):
+        self.func = func
+
+    def __repr__(self):
+        return f"sample_from({self.func})"
+
+
+def uniform(low: float, high: float) -> sample_from:
+    return sample_from(lambda _: random.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> sample_from:
+    import math
+
+    return sample_from(
+        lambda _: math.exp(random.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> sample_from:
+    return sample_from(lambda _: random.randint(low, high - 1))
+
+
+def choice(options: Sequence[Any]) -> sample_from:
+    opts = list(options)
+    return sample_from(lambda _: random.choice(opts))
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> sample_from:
+    return sample_from(lambda _: random.gauss(mean, sd))

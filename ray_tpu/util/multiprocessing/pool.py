@@ -59,6 +59,9 @@ class AsyncResult:
             t.start()
 
     def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._chunk_refs:
+            self._collect()
+            return
         ready, _ = ray_tpu.wait(self._chunk_refs,
                                 num_returns=len(self._chunk_refs),
                                 timeout=timeout)
@@ -94,6 +97,8 @@ class AsyncResult:
 
     def ready(self) -> bool:
         if self._done:
+            return True
+        if not self._chunk_refs:
             return True
         ready, _ = ray_tpu.wait(self._chunk_refs,
                                 num_returns=len(self._chunk_refs), timeout=0)

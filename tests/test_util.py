@@ -264,3 +264,9 @@ def test_joblib_backend(ray_local):
     with joblib.parallel_backend("ray_tpu", n_jobs=2):
         out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
     assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_mp_pool_empty_iterable(ray_local):
+    with Pool(2) as p:
+        assert p.map(_sq, []) == []
+        assert list(p.imap(_sq, [])) == []
