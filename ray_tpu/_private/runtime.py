@@ -250,6 +250,15 @@ class LocalActor:
                 await self._wake.wait()
                 continue
             asyncio.get_event_loop().create_task(self._execute_method_async(spec))
+        # Cancel stragglers (e.g. long-lived background loops the actor
+        # spawned) so loop teardown doesn't warn about pending tasks; yield
+        # once so the cancellations actually propagate before stop().
+        stragglers = [t for t in asyncio.all_tasks(self.loop)
+                      if t is not asyncio.current_task()]
+        for task in stragglers:
+            task.cancel()
+        if stragglers:
+            await asyncio.gather(*stragglers, return_exceptions=True)
         self.loop.stop()
 
     def _wake_loop(self):

@@ -1,0 +1,46 @@
+"""ray_tpu.serve: model serving on tasks/actors.
+
+TPU-native re-design of the reference's serving library
+(``python/ray/serve/``): a control-plane master actor, an asyncio router
+actor with per-backend batching and traffic splitting, replica actors, and an
+HTTP ingress. The data plane is plain actor calls, so a backend can hold
+jitted jax callables and sharded params in device memory between requests.
+"""
+
+from .api import (  # noqa: F401
+    accept_batch,
+    create_backend,
+    create_endpoint,
+    delete_backend,
+    delete_endpoint,
+    get_handle,
+    http_address,
+    init,
+    list_backends,
+    list_endpoints,
+    set_traffic,
+    shutdown,
+    stat,
+    update_backend_config,
+)
+from .config import BackendConfig  # noqa: F401
+from .handle import ServeHandle  # noqa: F401
+
+__all__ = [
+    "init",
+    "shutdown",
+    "create_backend",
+    "create_endpoint",
+    "delete_backend",
+    "delete_endpoint",
+    "set_traffic",
+    "get_handle",
+    "list_backends",
+    "list_endpoints",
+    "update_backend_config",
+    "accept_batch",
+    "stat",
+    "http_address",
+    "BackendConfig",
+    "ServeHandle",
+]

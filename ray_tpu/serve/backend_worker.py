@@ -1,0 +1,89 @@
+"""Replica actor wrapping a user backend (reference: python/ray/serve/backend_worker.py).
+
+A replica holds the user's callable (a function, or a class instance whose
+``__call__``/named methods serve queries). For TPU backends the instance
+typically owns jitted functions and device-resident params, so keeping the
+replica alive between queries is what amortizes compilation and weight
+transfer.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, List
+
+from .config import ServeRequest
+
+
+def _is_batched(fn: Callable) -> bool:
+    return bool(getattr(fn, "__serve_accept_batch__", False))
+
+
+class ReplicaActor:
+    """One backend replica. Created by the ServeMaster as a plain actor."""
+
+    def __init__(self, backend_tag: str, func_or_class: Any, init_args: tuple,
+                 user_config: dict):
+        self.backend_tag = backend_tag
+        if inspect.isclass(func_or_class):
+            self.callable = func_or_class(*init_args)
+        else:
+            if init_args:
+                raise ValueError("init args are only valid for class backends")
+            self.callable = func_or_class
+        self.user_config = user_config
+        self.num_queries = 0
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+
+    def _target(self, method: str) -> Callable:
+        if method:
+            return getattr(self.callable, method)
+        if inspect.isfunction(self.callable) or inspect.ismethod(self.callable):
+            return self.callable
+        if callable(self.callable):
+            # Bound __call__, so markers set on the class's __call__ (e.g.
+            # @serve.accept_batch) are visible through getattr.
+            return self.callable.__call__
+        raise TypeError(
+            f"backend {self.backend_tag} is not callable and no method given"
+        )
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        self.num_queries += 1
+        target = self._target(method)
+        if _is_batched(target):
+            # A batched callable still accepts singleton batches.
+            return target([ServeRequest(args, kwargs)])[0]
+        return target(*args, **kwargs)
+
+    def handle_batch(self, method: str, requests: List[tuple]) -> List[Any]:
+        """Serve a batch collected by the router.
+
+        ``requests`` is a list of (args, kwargs). Batched targets get the whole
+        list as ``List[ServeRequest]`` and must return a same-length list;
+        unbatched targets are called per-request (the router batches only when
+        the backend opted in, so this path is a safety net).
+        """
+        self.num_queries += len(requests)
+        target = self._target(method)
+        if _is_batched(target):
+            out = target([ServeRequest(a, k) for a, k in requests])
+            if not isinstance(out, (list, tuple)) or len(out) != len(requests):
+                raise ValueError(
+                    f"batched backend {self.backend_tag} must return a list of "
+                    f"length {len(requests)}, got {type(out).__name__}"
+                )
+            return list(out)
+        return [target(*a, **k) for a, k in requests]
+
+    def reconfigure(self, user_config: dict) -> None:
+        self.user_config = user_config
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+
+    def stats(self) -> dict:
+        return {"backend": self.backend_tag, "num_queries": self.num_queries}
+
+    def ready(self) -> bool:
+        return True

@@ -1,0 +1,66 @@
+"""Backend configuration (reference: python/ray/serve/config.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class BackendConfig:
+    """Tunables for one backend.
+
+    Mirrors the reference's BackendConfig keys (num_replicas, max_batch_size,
+    batch_wait_timeout, max_concurrent_queries) with TPU-relevant defaults:
+    batching is the lever that keeps the MXU busy, so ``max_batch_size`` is
+    first-class rather than an afterthought.
+    """
+
+    num_replicas: int = 1
+    max_batch_size: int = 0  # 0 = no batching
+    batch_wait_timeout_s: float = 0.01
+    max_concurrent_queries: int = 8
+    user_config: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_batch_size < 0:
+            raise ValueError("max_batch_size must be >= 0")
+        if self.max_concurrent_queries < 1:
+            raise ValueError("max_concurrent_queries must be >= 1")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "num_replicas": self.num_replicas,
+            "max_batch_size": self.max_batch_size,
+            "batch_wait_timeout_s": self.batch_wait_timeout_s,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "user_config": dict(self.user_config),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BackendConfig":
+        cfg = cls(**d)
+        cfg.validate()
+        return cfg
+
+
+@dataclass
+class ServeRequest:
+    """One query as seen by a backend callable.
+
+    Batched backends (``@serve.accept_batch``) receive ``List[ServeRequest]``;
+    unbatched backends are called as ``fn(*request.args, **request.kwargs)``.
+    Reference: the ServeRequest/Query objects in python/ray/serve/request_params.py.
+    """
+
+    args: tuple
+    kwargs: dict
+
+    @property
+    def data(self):
+        """Convenience accessor for single-payload requests."""
+        if self.args:
+            return self.args[0]
+        return self.kwargs

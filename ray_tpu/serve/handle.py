@@ -1,0 +1,33 @@
+"""ServeHandle: Python-side handle to an endpoint (reference: python/ray/serve/handle.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ServeHandle:
+    """Submit queries to an endpoint from Python; returns ObjectRefs.
+
+    ``handle.remote(x)`` routes through the Router actor (traffic split,
+    batching, replica selection) and resolves to the backend's return value.
+    """
+
+    def __init__(self, router_handle: Any, endpoint: str,
+                 method: Optional[str] = None):
+        self._router = router_handle
+        self._endpoint = endpoint
+        self._method = method or ""
+
+    def options(self, *, method: Optional[str] = None) -> "ServeHandle":
+        """A handle that invokes a named method of a class backend."""
+        return ServeHandle(self._router, self._endpoint, method)
+
+    def remote(self, *args, **kwargs):
+        return self._router.route.remote(
+            self._endpoint, self._method, args, kwargs)
+
+    def __repr__(self):
+        return f"ServeHandle(endpoint={self._endpoint!r})"
+
+    def __reduce__(self):
+        return (ServeHandle, (self._router, self._endpoint, self._method or None))

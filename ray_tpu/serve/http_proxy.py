@@ -1,0 +1,111 @@
+"""HTTP ingress actor (reference: python/ray/serve/http_proxy.py).
+
+A threaded actor running a stdlib ThreadingHTTPServer (the image has no
+uvicorn); each request is routed through the Router actor and the JSON reply
+carries the backend's return value. Request body: JSON — either a bare value
+(single positional arg) or {"args": [...], "kwargs": {...}}.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import ray_tpu
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        # route -> (endpoint, methods)
+        self.routes: Dict[str, Tuple[str, List[str]]] = {}
+        self.router = None
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _serve(self, method: str):
+                path = self.path.split("?", 1)[0]
+                if path == "/-/routes":
+                    self._reply(200, proxy.routes)
+                    return
+                entry = proxy.routes.get(path)
+                if entry is None:
+                    self._reply(404, {"error": f"no route {path}"})
+                    return
+                endpoint, methods = entry
+                if method not in methods:
+                    self._reply(405, {"error": f"{method} not allowed"})
+                    return
+                args, kwargs = (), {}
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError:
+                        self._reply(400, {"error": "body must be JSON"})
+                        return
+                    if isinstance(body, dict) and ("args" in body or "kwargs" in body):
+                        args = tuple(body.get("args", ()))
+                        kwargs = dict(body.get("kwargs", {}))
+                    else:
+                        args = (body,)
+                try:
+                    ref = proxy.router.route.remote(endpoint, "", args, kwargs)
+                    result = ray_tpu.get(ref)
+                    self._reply(200, {"result": result})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": str(e)})
+
+            def _reply(self, code: int, payload):
+                try:
+                    data = json.dumps(payload).encode()
+                except TypeError:
+                    data = json.dumps({"result": repr(payload)}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, name="serve-http", daemon=True)
+        self.thread.start()
+
+    def ready(self) -> int:
+        if self.router is None:
+            from .master import ROUTER_NAME
+
+            # Resolve lazily: the router is a sibling actor created by the
+            # master; by the time a route is set it exists.
+            try:
+                self.router = ray_tpu.get_actor(ROUTER_NAME)
+            except Exception:
+                pass
+        return self.port
+
+    def set_route(self, route: str, endpoint: str, methods: List[str]) -> None:
+        self.ready()
+        self.routes[route] = (endpoint, [m.upper() for m in methods])
+
+    def remove_route(self, route: str) -> None:
+        self.routes.pop(route, None)
+
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()

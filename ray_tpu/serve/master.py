@@ -1,0 +1,170 @@
+"""ServeMaster control-plane actor (reference: python/ray/serve/master.py).
+
+Owns all serving state: endpoint registry, backend registry, traffic
+policies, and replica lifecycle. The router and replicas are child actors it
+creates and reconciles; every mutation is pushed to the router so the data
+plane never consults the master on the request path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from .backend_worker import ReplicaActor
+from .config import BackendConfig
+from .router import Router
+
+MASTER_NAME = "__serve_master__"
+ROUTER_NAME = "__serve_router__"
+
+
+class ServeMaster:
+    def __init__(self, http_host: Optional[str] = None,
+                 http_port: Optional[int] = None):
+        self.router = ray_tpu.remote(num_cpus=0)(Router).options(
+            name=ROUTER_NAME).remote()
+        # endpoint -> {"route": str|None, "methods": [..]}
+        self.endpoints: Dict[str, Dict[str, Any]] = {}
+        # backend -> {"config": dict, "func_or_class": obj, "init_args": tuple}
+        self.backends: Dict[str, Dict[str, Any]] = {}
+        self.replicas: Dict[str, List[Any]] = {}
+        self.traffic: Dict[str, Dict[str, float]] = {}
+        self.http_proxy = None
+        if http_port is not None:
+            from .http_proxy import HTTPProxyActor
+
+            self.http_proxy = ray_tpu.remote(num_cpus=0)(HTTPProxyActor).remote(
+                http_host or "127.0.0.1", http_port)
+            ray_tpu.get(self.http_proxy.ready.remote())
+
+    def get_router(self):
+        return [self.router]
+
+    def get_http_proxy(self):
+        return [self.http_proxy]
+
+    # ---- backends ----
+
+    def create_backend(self, backend_tag: str, func_or_class: Any,
+                       init_args: tuple, config_dict: dict) -> None:
+        if backend_tag in self.backends:
+            raise ValueError(f"backend {backend_tag!r} already exists")
+        config = BackendConfig.from_dict(config_dict)
+        self.backends[backend_tag] = {
+            "config": config, "func_or_class": func_or_class,
+            "init_args": init_args,
+        }
+        self.replicas[backend_tag] = []
+        self._scale(backend_tag, config.num_replicas)
+
+    def delete_backend(self, backend_tag: str) -> None:
+        for policy in self.traffic.values():
+            if backend_tag in policy:
+                raise ValueError(
+                    f"backend {backend_tag!r} still receives traffic")
+        self.backends.pop(backend_tag, None)
+        for h in self.replicas.pop(backend_tag, []):
+            ray_tpu.kill(h)
+        ray_tpu.get(self.router.remove_backend.remote(backend_tag))
+
+    def update_backend_config(self, backend_tag: str, config_dict: dict) -> None:
+        entry = self._backend(backend_tag)
+        merged = entry["config"].to_dict()
+        merged.update(config_dict)
+        config = BackendConfig.from_dict(merged)
+        entry["config"] = config
+        self._scale(backend_tag, config.num_replicas)
+        if "user_config" in config_dict:
+            ray_tpu.get([h.reconfigure.remote(config.user_config)
+                         for h in self.replicas[backend_tag]])
+
+    def list_backends(self) -> Dict[str, dict]:
+        return {t: e["config"].to_dict() for t, e in self.backends.items()}
+
+    def _backend(self, backend_tag: str) -> Dict[str, Any]:
+        if backend_tag not in self.backends:
+            raise ValueError(f"no backend {backend_tag!r}")
+        return self.backends[backend_tag]
+
+    def _scale(self, backend_tag: str, target: int) -> None:
+        entry = self._backend(backend_tag)
+        current = self.replicas[backend_tag]
+        config: BackendConfig = entry["config"]
+        while len(current) < target:
+            h = ray_tpu.remote(num_cpus=0)(ReplicaActor).remote(
+                backend_tag, entry["func_or_class"], entry["init_args"],
+                dict(config.user_config))
+            current.append(h)
+        retired = []
+        while len(current) > target:
+            retired.append(current.pop())
+        # Block until new replicas constructed so traffic never hits a
+        # half-initialized model, and sync the router BEFORE killing retired
+        # replicas so no in-flight route targets a dead actor.
+        ray_tpu.get([h.ready.remote() for h in current])
+        self._sync_router(backend_tag)
+        for h in retired:
+            ray_tpu.kill(h)
+
+    def _sync_router(self, backend_tag: str) -> None:
+        entry = self._backend(backend_tag)
+        ray_tpu.get(self.router.set_backend.remote(
+            backend_tag, list(self.replicas[backend_tag]),
+            entry["config"].to_dict()))
+
+    # ---- endpoints ----
+
+    def create_endpoint(self, endpoint: str, backend_tag: str,
+                        route: Optional[str], methods: List[str]) -> None:
+        if endpoint in self.endpoints:
+            raise ValueError(f"endpoint {endpoint!r} already exists")
+        self._backend(backend_tag)
+        self.endpoints[endpoint] = {"route": route, "methods": list(methods)}
+        self.set_traffic(endpoint, {backend_tag: 1.0})
+        if self.http_proxy is not None and route is not None:
+            ray_tpu.get(self.http_proxy.set_route.remote(
+                route, endpoint, list(methods)))
+
+    def delete_endpoint(self, endpoint: str) -> None:
+        info = self.endpoints.pop(endpoint, None)
+        self.traffic.pop(endpoint, None)
+        ray_tpu.get(self.router.remove_endpoint.remote(endpoint))
+        if self.http_proxy is not None and info and info.get("route"):
+            ray_tpu.get(self.http_proxy.remove_route.remote(info["route"]))
+
+    def list_endpoints(self) -> Dict[str, dict]:
+        return {
+            ep: {**info, "traffic": self.traffic.get(ep, {})}
+            for ep, info in self.endpoints.items()
+        }
+
+    def set_traffic(self, endpoint: str, traffic: Dict[str, float]) -> None:
+        if endpoint not in self.endpoints:
+            raise ValueError(f"no endpoint {endpoint!r}")
+        for tag, w in traffic.items():
+            self._backend(tag)
+            if w < 0:
+                raise ValueError("traffic weights must be >= 0")
+        total = sum(traffic.values())
+        if total <= 0:
+            raise ValueError("traffic weights must sum to > 0")
+        normalized = {t: w / total for t, w in traffic.items()}
+        self.traffic[endpoint] = normalized
+        ray_tpu.get(self.router.set_traffic.remote(endpoint, normalized))
+
+    # ---- observability / lifecycle ----
+
+    def stat(self) -> dict:
+        return ray_tpu.get(self.router.stats.remote())
+
+    def shutdown_children(self) -> None:
+        """Kill every replica actor (the master itself is killed by the API)."""
+        for handles in self.replicas.values():
+            for h in handles:
+                ray_tpu.kill(h)
+        self.replicas.clear()
+        self.backends.clear()
+        self.endpoints.clear()
+        self.traffic.clear()

@@ -1,0 +1,215 @@
+"""Asyncio router actor (reference: python/ray/serve/router.py + policy.py).
+
+One router actor fronts all endpoints: it applies the endpoint's traffic
+split, enforces per-replica ``max_concurrent_queries`` with semaphores, and —
+for backends that opted in — coalesces queries into batches so the backend can
+feed the MXU one big matmul instead of many small ones. Everything is a single
+event loop; replica calls are awaited ObjectRefs, so slow replicas never block
+routing decisions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _Replica:
+    def __init__(self, handle: Any, max_concurrent: int):
+        self.handle = handle
+        self.sem = asyncio.Semaphore(max_concurrent)
+        self.inflight = 0
+
+
+class _Backend:
+    def __init__(self, config: dict):
+        self.config = config
+        self.replicas: List[_Replica] = []
+        self.rr = 0  # round-robin cursor among replicas
+        self.queue: Optional[asyncio.Queue] = None
+        self.batch_task: Optional[asyncio.Task] = None
+
+
+class Router:
+    """Routes (endpoint, query) -> backend replica. Runs as an asyncio actor."""
+
+    def __init__(self):
+        self.backends: Dict[str, _Backend] = {}
+        self.traffic: Dict[str, Dict[str, float]] = {}  # endpoint -> backend -> w
+        self.num_routed: Dict[str, int] = {}
+        self.num_errors: Dict[str, int] = {}
+
+    # ---- control plane (called by ServeMaster) ----
+
+    def _drain(self, old: Optional[_Backend], new: Optional[_Backend],
+               reason: str) -> None:
+        """Stop an old backend's batch loop; migrate queued queries to the
+        new backend's queue, or fail them if there is nowhere to go."""
+        if old is None:
+            return
+        if old.batch_task is not None:
+            old.batch_task.cancel()
+        if old.queue is None:
+            return
+        while not old.queue.empty():
+            item = old.queue.get_nowait()
+            if new is not None and new.queue is not None:
+                new.queue.put_nowait(item)
+            elif new is not None and new.replicas:
+                method, args, kwargs, fut = item
+                task = asyncio.get_event_loop().create_task(
+                    self._call_one(new, method, args, kwargs))
+
+                def _copy(t, f=fut):
+                    if f.done() or t.cancelled():
+                        return
+                    if t.exception() is not None:
+                        f.set_exception(t.exception())
+                    else:
+                        f.set_result(t.result())
+
+                task.add_done_callback(_copy)
+            else:
+                fut = item[3]
+                if not fut.done():
+                    fut.set_exception(RuntimeError(reason))
+
+    async def set_backend(self, backend_tag: str, replica_handles: List[Any],
+                          config: dict) -> None:
+        b = _Backend(config)
+        maxc = int(config.get("max_concurrent_queries", 8))
+        b.replicas = [_Replica(h, maxc) for h in replica_handles]
+        if config.get("max_batch_size", 0) and b.replicas:
+            b.queue = asyncio.Queue()
+            b.batch_task = asyncio.get_event_loop().create_task(
+                self._batch_loop(backend_tag, b))
+        old = self.backends.get(backend_tag)
+        self.backends[backend_tag] = b
+        self._drain(old, b, f"backend {backend_tag!r} lost all replicas")
+
+    async def remove_backend(self, backend_tag: str) -> None:
+        self._drain(self.backends.pop(backend_tag, None), None,
+                    f"backend {backend_tag!r} was deleted")
+
+    async def set_traffic(self, endpoint: str, traffic: Dict[str, float]) -> None:
+        self.traffic[endpoint] = dict(traffic)
+
+    async def remove_endpoint(self, endpoint: str) -> None:
+        self.traffic.pop(endpoint, None)
+
+    # ---- data plane ----
+
+    async def route(self, endpoint: str, method: str, args: tuple,
+                    kwargs: dict) -> Any:
+        traffic = self.traffic.get(endpoint)
+        if not traffic:
+            raise ValueError(f"no traffic policy for endpoint {endpoint!r}")
+        backend_tag = self._pick_backend(traffic)
+        b = self.backends.get(backend_tag)
+        if b is None or not b.replicas:
+            raise RuntimeError(
+                f"backend {backend_tag!r} for endpoint {endpoint!r} has no replicas")
+        self.num_routed[endpoint] = self.num_routed.get(endpoint, 0) + 1
+        try:
+            if b.queue is not None:
+                fut = asyncio.get_event_loop().create_future()
+                await b.queue.put((method, args, kwargs, fut))
+                return await fut
+            return await self._call_one(b, method, args, kwargs)
+        except Exception:
+            self.num_errors[endpoint] = self.num_errors.get(endpoint, 0) + 1
+            raise
+
+    def _pick_backend(self, traffic: Dict[str, float]) -> str:
+        tags = list(traffic.keys())
+        if len(tags) == 1:
+            return tags[0]
+        weights = [traffic[t] for t in tags]
+        return random.choices(tags, weights=weights, k=1)[0]
+
+    def _next_replica(self, b: _Backend) -> _Replica:
+        # Round-robin, but skip saturated replicas when an idle one exists
+        # (the reference's "least loaded among round robin" refinement).
+        n = len(b.replicas)
+        for i in range(n):
+            r = b.replicas[(b.rr + i) % n]
+            if not r.sem.locked():
+                b.rr = (b.rr + i + 1) % n
+                return r
+        r = b.replicas[b.rr % n]
+        b.rr = (b.rr + 1) % n
+        return r
+
+    async def _call_one(self, b: _Backend, method: str, args: tuple,
+                        kwargs: dict) -> Any:
+        r = self._next_replica(b)
+        async with r.sem:
+            r.inflight += 1
+            try:
+                return await r.handle.handle_request.remote(method, args, kwargs)
+            finally:
+                r.inflight -= 1
+
+    async def _batch_loop(self, backend_tag: str, b: _Backend) -> None:
+        max_bs = int(b.config.get("max_batch_size", 1))
+        wait_s = float(b.config.get("batch_wait_timeout_s", 0.01))
+        while True:
+            first = await b.queue.get()
+            batch: List[Tuple[str, tuple, dict, asyncio.Future]] = [first]
+            deadline = asyncio.get_event_loop().time() + wait_s
+            while len(batch) < max_bs:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(b.queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
+            # A batch must be method-homogeneous: group before dispatch so a
+            # concurrent .options(method=...) call can't ride along and be
+            # executed against the wrong target.
+            by_method: Dict[str, list] = {}
+            for item in batch:
+                by_method.setdefault(item[0], []).append(item)
+            for group in by_method.values():
+                asyncio.get_event_loop().create_task(
+                    self._dispatch_batch(b, group))
+
+    async def _dispatch_batch(self, b: _Backend, batch) -> None:
+        method = batch[0][0]
+        requests = [(args, kwargs) for _, args, kwargs, _ in batch]
+        futs = [fut for _, _, _, fut in batch]
+        r = self._next_replica(b)
+        try:
+            async with r.sem:
+                r.inflight += 1
+                try:
+                    results = await r.handle.handle_batch.remote(method, requests)
+                finally:
+                    r.inflight -= 1
+            for fut, res in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # noqa: BLE001
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    # ---- observability ----
+
+    async def stats(self) -> dict:
+        return {
+            "endpoints": {
+                ep: {"routed": self.num_routed.get(ep, 0),
+                     "errors": self.num_errors.get(ep, 0),
+                     "traffic": self.traffic.get(ep, {})}
+                for ep in self.traffic
+            },
+            "backends": {
+                tag: {"num_replicas": len(b.replicas),
+                      "inflight": sum(r.inflight for r in b.replicas),
+                      "batched": b.queue is not None}
+                for tag, b in self.backends.items()
+            },
+        }

@@ -326,7 +326,10 @@ class PopulationBasedTraining(FIFOScheduler):
         self.num_perturbations = 0
 
     def _quantiles(self, trials: List[Trial]):
-        scored = [t for t in trials if t.trial_id in self._scores]
+        # Only live trials participate: a TERMINATED trial has no runner to
+        # donate state from, and perturbing a finished trial is meaningless.
+        scored = [t for t in trials
+                  if t.trial_id in self._scores and t.runner is not None]
         if len(scored) <= 1:
             return [], []
         scored.sort(key=lambda t: self._scores[t.trial_id])

@@ -22,9 +22,12 @@ class TrialRunner:
                  search_alg: Optional[SearchAlgorithm] = None,
                  trial_executor: Optional[RayTrialExecutor] = None,
                  fail_fast: bool = False,
-                 loggers: Optional[List] = None):
+                 loggers: Optional[List] = None,
+                 trial_creator=None):
         self._scheduler = scheduler or FIFOScheduler()
         self._search_alg = search_alg
+        self._trial_creator = trial_creator or (
+            lambda tag, cfg: Trial(None, cfg, experiment_tag=tag))
         self._executor = trial_executor or RayTrialExecutor()
         self._trials: List[Trial] = []
         self._fail_fast = fail_fast
@@ -47,7 +50,24 @@ class TrialRunner:
         return all(t.is_finished() for t in self._trials)
 
     # ------------------------------------------------------------- loop
+    def _pull_from_search_alg(self) -> None:
+        """Drain whatever configs the search algorithm has ready right now.
+
+        Adaptive algorithms (BO-style) return None while waiting on results
+        and produce more configs after on_trial_complete — so this runs every
+        step, not once up front (reference: trial_runner's
+        _update_trial_queue)."""
+        if self._search_alg is None:
+            return
+        while True:
+            nxt = self._search_alg.next_trial_config()
+            if nxt is None:
+                return
+            tag, cfg = nxt
+            self.add_trial(self._trial_creator(tag, cfg))
+
     def step(self) -> None:
+        self._pull_from_search_alg()
         self._maybe_start_trials()
         trial, result = self._executor.get_next_available_result(timeout=120.0)
         if trial is None:

@@ -144,19 +144,8 @@ def run(run_or_experiment,
         for lg in loggers:
             logger_objs.append(lg(exp_dir) if isinstance(lg, type) else lg)
 
-    runner = TrialRunner(
-        scheduler=scheduler,
-        trial_executor=RayTrialExecutor(reuse_actors=reuse_actors),
-        fail_fast=fail_fast,
-        loggers=logger_objs,
-    )
-
-    while True:
-        nxt = variant_gen.next_trial_config()
-        if nxt is None:
-            break
-        tag, cfg = nxt
-        runner.add_trial(Trial(
+    def make_trial(tag, cfg):
+        trial = Trial(
             trainable_cls, cfg,
             experiment_tag=tag,
             resources=resources_per_trial,
@@ -166,9 +155,21 @@ def run(run_or_experiment,
             keep_checkpoints_num=keep_checkpoints_num,
             checkpoint_score_attr=checkpoint_score_attr,
             max_failures=max_failures,
-        ))
+        )
         if restore:
-            runner.get_trials()[-1].restore_path = restore
+            trial.restore_path = restore
+        return trial
+
+    # The search algorithm feeds the runner lazily (every step), so adaptive
+    # algorithms that suggest configs only after observing results work.
+    runner = TrialRunner(
+        scheduler=scheduler,
+        search_alg=variant_gen,
+        trial_creator=make_trial,
+        trial_executor=RayTrialExecutor(reuse_actors=reuse_actors),
+        fail_fast=fail_fast,
+        loggers=logger_objs,
+    )
 
     reporter = progress_reporter or (CLIReporter() if verbose else None)
     while not runner.is_finished():

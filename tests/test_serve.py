@@ -1,0 +1,184 @@
+"""Serve layer tests (model: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(local_ray):
+    serve.init()
+    yield serve
+    serve.shutdown()
+
+
+def test_function_backend_and_handle(serve_instance):
+    def echo(x):
+        return {"echo": x}
+
+    serve.create_backend("echo:v1", echo)
+    serve.create_endpoint("echo", backend="echo:v1")
+    h = serve.get_handle("echo")
+    assert ray_tpu.get(h.remote(42)) == {"echo": 42}
+    results = ray_tpu.get([h.remote(i) for i in range(10)])
+    assert [r["echo"] for r in results] == list(range(10))
+
+
+def test_class_backend_with_init_args_and_methods(serve_instance):
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+            self.calls = 0
+
+        def __call__(self, x):
+            self.calls += 1
+            return x * self.scale
+
+        def meta(self):
+            return {"scale": self.scale, "calls": self.calls}
+
+    serve.create_backend("m:v1", Model, 3)
+    serve.create_endpoint("model", backend="m:v1")
+    h = serve.get_handle("model")
+    assert ray_tpu.get(h.remote(7)) == 21
+    meta = ray_tpu.get(h.options(method="meta").remote())
+    assert meta["scale"] == 3 and meta["calls"] == 1
+
+
+def test_multiple_replicas(serve_instance):
+    import os
+    import threading
+
+    class Who:
+        def __call__(self, _):
+            return threading.get_ident()
+
+    serve.create_backend(
+        "who:v1", Who, config=serve.BackendConfig(num_replicas=3))
+    serve.create_endpoint("who", backend="who:v1")
+    h = serve.get_handle("who")
+    idents = set(ray_tpu.get([h.remote(None) for _ in range(30)]))
+    assert len(idents) >= 2  # spread across replica actors
+
+
+def test_traffic_split(serve_instance):
+    serve.create_backend("a:v1", lambda _: "a")
+    serve.create_backend("b:v1", lambda _: "b")
+    serve.create_endpoint("ab", backend="a:v1")
+    serve.set_traffic("ab", {"a:v1": 0.5, "b:v1": 0.5})
+    h = serve.get_handle("ab")
+    seen = set(ray_tpu.get([h.remote(None) for _ in range(60)]))
+    assert seen == {"a", "b"}
+    # all traffic to b
+    serve.set_traffic("ab", {"b:v1": 1.0})
+    seen = set(ray_tpu.get([h.remote(None) for _ in range(20)]))
+    assert seen == {"b"}
+
+
+def test_batching(serve_instance):
+    batch_sizes = []
+
+    class Batched:
+        @serve.accept_batch
+        def __call__(self, requests):
+            batch_sizes.append(len(requests))
+            return [r.data * 2 for r in requests]
+
+    serve.create_backend(
+        "batch:v1", Batched,
+        config=serve.BackendConfig(max_batch_size=8,
+                                   batch_wait_timeout_s=0.05))
+    serve.create_endpoint("batch", backend="batch:v1")
+    h = serve.get_handle("batch")
+    results = ray_tpu.get([h.remote(i) for i in range(16)])
+    assert results == [2 * i for i in range(16)]
+    stats = serve.stat()
+    assert stats["backends"]["batch:v1"]["batched"]
+
+
+def test_update_backend_config_scales(serve_instance):
+    serve.create_backend("s:v1", lambda _: "ok")
+    serve.create_endpoint("s", backend="s:v1")
+    serve.update_backend_config("s:v1", {"num_replicas": 4})
+    assert serve.list_backends()["s:v1"]["num_replicas"] == 4
+    h = serve.get_handle("s")
+    assert ray_tpu.get(h.remote(None)) == "ok"
+
+
+def test_delete_endpoint_and_backend(serve_instance):
+    serve.create_backend("d:v1", lambda _: 1)
+    serve.create_endpoint("d", backend="d:v1")
+    with pytest.raises(Exception):
+        serve.delete_backend("d:v1")  # still has traffic
+    serve.delete_endpoint("d")
+    serve.delete_backend("d:v1")
+    assert "d:v1" not in serve.list_backends()
+    assert "d" not in serve.list_endpoints()
+
+
+def test_jax_model_backend(serve_instance):
+    import jax
+    import jax.numpy as jnp
+
+    class JaxModel:
+        def __init__(self, dim):
+            key = jax.random.PRNGKey(0)
+            self.w = jax.random.normal(key, (dim, dim), dtype=jnp.float32)
+            self.fn = jax.jit(lambda w, x: jnp.tanh(x @ w))
+
+        @serve.accept_batch
+        def __call__(self, requests):
+            # Stack singleton queries into one batched matmul: this is why
+            # serve batching exists on TPU.
+            xs = jnp.stack([jnp.asarray(r.data, dtype=jnp.float32)
+                            for r in requests])
+            out = self.fn(self.w, xs)
+            return [np.asarray(o) for o in out]
+
+    serve.create_backend(
+        "jax:v1", JaxModel, 8,
+        config=serve.BackendConfig(max_batch_size=16,
+                                   batch_wait_timeout_s=0.05))
+    serve.create_endpoint("jax", backend="jax:v1")
+    h = serve.get_handle("jax")
+    xs = [np.random.RandomState(i).randn(8).astype(np.float32)
+          for i in range(8)]
+    outs = ray_tpu.get([h.remote(x) for x in xs])
+    assert all(o.shape == (8,) for o in outs)
+    assert not np.allclose(outs[0], outs[1])
+
+
+def test_http_ingress(local_ray):
+    serve.init(http_port=0)
+    try:
+        serve.create_backend("h:v1", lambda x=None: {"got": x})
+        serve.create_endpoint("h", backend="h:v1", route="/h",
+                              methods=["GET", "POST"])
+        addr = serve.http_address()
+        assert addr is not None
+
+        with urllib.request.urlopen(f"{addr}/h", timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body == {"result": {"got": None}}
+
+        req = urllib.request.Request(
+            f"{addr}/h", data=json.dumps(123).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body == {"result": {"got": 123}}
+
+        # unknown route -> 404
+        try:
+            urllib.request.urlopen(f"{addr}/nope", timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        serve.shutdown()
