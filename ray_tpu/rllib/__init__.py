@@ -1,0 +1,31 @@
+"""ray_tpu.rllib: reinforcement learning on tasks/actors (reference: rllib/).
+
+Policies are jitted pure-jax functions; rollout workers are actors with
+vectorized envs; training loops compose the execution ops the way the
+reference's execution plans do. Algorithms: PPO, DD-PPO, DQN (prioritized
+replay), IMPALA-style async learner, ES.
+"""
+
+from .agents import (  # noqa: F401
+    DDPPOTrainer,
+    DQNTrainer,
+    ESTrainer,
+    ImpalaTrainer,
+    PPOTrainer,
+    Trainer,
+    build_trainer,
+)
+from .env import CartPole, Env, StatelessBandit, VectorEnv, make_env, register_env  # noqa: F401
+from .execution import (  # noqa: F401
+    ConcatBatches,
+    LearnerThread,
+    ParallelRollouts,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    StoreToReplayBuffer,
+    TrainOneStep,
+)
+from .policy import DQNPolicy, Policy, PPOPolicy  # noqa: F401
+from .rollout_worker import RolloutWorker  # noqa: F401
+from .sample_batch import SampleBatch, compute_gae  # noqa: F401
+from .worker_set import WorkerSet  # noqa: F401

@@ -1,0 +1,5 @@
+from .trainer import Trainer, build_trainer  # noqa: F401
+from .ppo import PPOTrainer, DDPPOTrainer  # noqa: F401
+from .dqn import DQNTrainer  # noqa: F401
+from .impala import ImpalaTrainer  # noqa: F401
+from .es import ESTrainer  # noqa: F401

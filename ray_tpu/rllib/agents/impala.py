@@ -1,0 +1,94 @@
+"""IMPALA-style async learner (reference: rllib/agents/impala/impala.py +
+rllib/execution/learner_thread.py).
+
+Rollout workers sample continuously; batches stream into the learner thread's
+queue; the learner updates off-thread and workers refresh weights between
+samples. V-trace is approximated by PPO's clipped importance ratios (the
+reference offers both; the clipped-surrogate form is the jax-friendly one —
+same stale-policy correction, no per-timestep recursion).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import ray_tpu
+
+from ..execution import LearnerThread
+from ..policy import PPOPolicy
+from .trainer import Trainer
+
+IMPALA_CONFIG = {
+    "rollout_fragment_length": 64,
+    "train_batch_size": 256,
+    "sgd_minibatch_size": 64,
+    "num_sgd_iter": 2,
+    "num_workers": 2,
+    "lr": 5e-4,
+    "lambda": 0.95,
+    "clip_param": 0.3,
+    "vf_loss_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "use_gae": True,
+    "hiddens": [64, 64],
+    "broadcast_interval": 1,  # learner updates between weight broadcasts
+    "max_requests_in_flight": 2,
+}
+
+
+class ImpalaTrainer(Trainer):
+    _policy_cls = PPOPolicy
+    _default_config = IMPALA_CONFIG
+    _name = "IMPALA"
+
+    def _build(self, config: Dict) -> None:
+        self.learner = LearnerThread(self.workers.local_worker())
+        self.learner.start()
+        self._inflight: Dict = {}  # ref -> worker
+        self._last_broadcast_seq = 0
+        for w in self.workers.remote_workers():
+            for _ in range(self.raw_config["max_requests_in_flight"]):
+                self._inflight[w.sample.remote()] = w
+
+    def _train_step(self) -> Dict:
+        cfg = self.raw_config
+        remote = self.workers.remote_workers()
+        if not remote:
+            # Degenerate sync fallback (no async pipeline without workers).
+            batch = self.workers.local_worker().sample()
+            self._steps_sampled += batch.count
+            self.learner.inqueue.put(batch)
+            while self.learner.steps_trained < self._steps_sampled:
+                time.sleep(0.005)
+            return dict(self.learner.last_stats)
+
+        target = self._steps_sampled + cfg["train_batch_size"]
+        while self._steps_sampled < target:
+            ready, _ = ray_tpu.wait(
+                list(self._inflight.keys()), num_returns=1)
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            self._steps_sampled += batch.count
+            self.learner.inqueue.put(batch)
+            # Refresh the sampler's weights when the learner has advanced
+            # (stale-policy gap bounded by broadcast_interval updates).
+            if (self.learner.weights_seq - self._last_broadcast_seq
+                    >= cfg["broadcast_interval"]):
+                weights = ray_tpu.put(
+                    self.workers.local_worker().get_weights())
+                worker.set_weights.remote(weights)
+                self._last_broadcast_seq = self.learner.weights_seq
+            self._inflight[worker.sample.remote()] = worker
+
+        return {
+            "learner_updates": self.learner.num_updates,
+            "steps_trained": self.learner.steps_trained,
+            "learner_queue_size": self.learner.inqueue.qsize(),
+            **{k: float(v) for k, v in self.learner.last_stats.items()},
+        }
+
+    def cleanup(self) -> None:
+        self.learner.stop()
+        super().cleanup()

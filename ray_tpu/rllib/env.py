@@ -1,0 +1,164 @@
+"""Environment API (reference: rllib/env/).
+
+The reference wraps gym; this image has no gym, so the Env protocol is defined
+here natively (same reset/step contract) together with vectorization and two
+built-in numpy envs used throughout tests and examples. VectorEnv steps all
+sub-envs and returns stacked arrays — the natural shape for a jitted policy
+(one batched forward pass instead of E scalar ones).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    """Minimal env protocol (mirrors gym.Env as used by rllib/env/)."""
+
+    observation_dim: int
+    num_actions: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, Dict]:
+        raise NotImplementedError
+
+    def seed(self, seed: int) -> None:
+        pass
+
+
+class CartPole(Env):
+    """Classic cart-pole balance, numpy re-implementation of the standard
+    dynamics (reference tests use gym's CartPole-v0)."""
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 200):
+        self.max_steps = max_steps
+        self.rng = np.random.RandomState(0)
+        self.state: Optional[np.ndarray] = None
+        self.t = 0
+
+    def seed(self, seed: int) -> None:
+        self.rng = np.random.RandomState(seed)
+
+    def reset(self) -> np.ndarray:
+        self.state = self.rng.uniform(-0.05, 0.05, size=4).astype(np.float32)
+        self.t = 0
+        return self.state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(theta), np.sin(theta)
+        # Standard parameters: gravity 9.8, cart 1.0, pole 0.1, length 0.5.
+        temp = (force + 0.05 * theta_dot**2 * sinth) / 1.1
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh**2 / 1.1))
+        x_acc = temp - 0.05 * theta_acc * costh / 1.1
+        tau = 0.02
+        self.state = np.array(
+            [x + tau * x_dot, x_dot + tau * x_acc,
+             theta + tau * theta_dot, theta_dot + tau * theta_acc],
+            dtype=np.float32)
+        self.t += 1
+        done = bool(
+            abs(self.state[0]) > 2.4 or abs(self.state[2]) > 0.2095
+            or self.t >= self.max_steps)
+        return self.state.copy(), 1.0, done, {}
+
+
+class StatelessBandit(Env):
+    """A k-armed bandit: one step per episode, reward = 1 for the lucky arm.
+
+    Strong, immediate learning signal — used by fast policy-improvement tests
+    where CartPole would be too slow (analogue of the reference's mock envs in
+    rllib/tests).
+    """
+
+    observation_dim = 1
+    num_actions = 4
+
+    def __init__(self, best_arm: int = 2):
+        self.best_arm = best_arm
+
+    def reset(self) -> np.ndarray:
+        return np.zeros(1, dtype=np.float32)
+
+    def step(self, action: int):
+        reward = 1.0 if int(action) == self.best_arm else 0.0
+        return np.zeros(1, dtype=np.float32), reward, True, {}
+
+
+class VectorEnv:
+    """E independent copies stepped in lockstep (reference: rllib/env/vector_env.py).
+
+    Observations come back stacked [E, obs_dim] so the policy runs one batched
+    (jitted) forward pass; done sub-envs auto-reset.
+    """
+
+    def __init__(self, make_env, num_envs: int, base_seed: int = 0):
+        self.envs: List[Env] = [make_env() for _ in range(num_envs)]
+        for i, e in enumerate(self.envs):
+            e.seed(base_seed + i)
+        self.num_envs = num_envs
+        self.observation_dim = self.envs[0].observation_dim
+        self.num_actions = self.envs[0].num_actions
+        self.episode_rewards = np.zeros(num_envs)
+        self.episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self.completed: List[Tuple[float, int]] = []  # (reward, length)
+
+    def reset(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[Dict]]:
+        obs, rews, dones, infos = [], [], [], []
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            o, r, d, info = env.step(int(a))
+            self.episode_rewards[i] += r
+            self.episode_lens[i] += 1
+            if d:
+                self.completed.append(
+                    (float(self.episode_rewards[i]), int(self.episode_lens[i])))
+                self.episode_rewards[i] = 0.0
+                self.episode_lens[i] = 0
+                o = env.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(d)
+            infos.append(info)
+        return (np.stack(obs), np.asarray(rews, dtype=np.float32),
+                np.asarray(dones), infos)
+
+    def pop_episode_stats(self) -> List[Tuple[float, int]]:
+        out = self.completed
+        self.completed = []
+        return out
+
+
+_ENV_REGISTRY = {
+    "CartPole": CartPole,
+    "StatelessBandit": StatelessBandit,
+}
+
+
+def register_env(name: str, creator) -> None:
+    """Register a custom env creator (reference: tune/registry.py register_env)."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(spec: Any) -> Env:
+    if isinstance(spec, str):
+        try:
+            return _ENV_REGISTRY[spec]()
+        except KeyError:
+            raise ValueError(
+                f"unknown env {spec!r}; registered: {sorted(_ENV_REGISTRY)}"
+            ) from None
+    if callable(spec):
+        return spec()
+    raise TypeError(f"env spec must be str or callable, got {type(spec)}")

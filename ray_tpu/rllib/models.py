@@ -1,0 +1,48 @@
+"""Model catalog: pure-jax MLPs (reference: rllib/models/catalog.py).
+
+Plain pytree-of-arrays params and functional apply: no framework object
+between the optimizer and XLA, so policy updates jit/donate cleanly and ES can
+vmap over whole parameter pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes: Sequence[int]) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """He-initialized MLP params: [(W, b), ...]."""
+    params = []
+    for din, dout in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (din, dout)) * jnp.sqrt(2.0 / din)
+        params.append((w, jnp.zeros(dout)))
+    return params
+
+
+def apply_mlp(params, x: jnp.ndarray) -> jnp.ndarray:
+    for w, b in params[:-1]:
+        x = jnp.tanh(x @ w + b)
+    w, b = params[-1]
+    return x @ w + b
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+
+def flatten_params(params) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(params)
+    return jnp.concatenate([p.reshape(-1) for p in leaves])
+
+
+def unflatten_like(flat: jnp.ndarray, params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out, i = [], 0
+    for p in leaves:
+        out.append(flat[i:i + p.size].reshape(p.shape))
+        i += p.size
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,267 @@
+"""Policies: jitted pure-function actors/losses (reference: rllib/policy/).
+
+The reference carries four policy stacks (TF1/TF2/eager/torch); here there is
+one: params are pytrees, ``compute_actions`` and ``update`` are jitted pure
+functions, and weight transport between learner and rollout workers is a
+host-side pytree copy. Everything the MXU touches is batched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .models import apply_mlp, init_mlp
+from .sample_batch import (
+    ACTIONS, ADVANTAGES, DONES, LOGPS, NEXT_OBS, OBS, REWARDS, SampleBatch,
+    VALUE_TARGETS, VF_PREDS,
+)
+
+
+class Policy:
+    """Interface (reference rllib/policy/policy.py)."""
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        raise NotImplementedError
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError
+
+
+class PPOPolicy(Policy):
+    """Clipped-surrogate PPO with GAE (reference: rllib/agents/ppo/ppo_tf_policy.py).
+
+    One shared-nothing actor-critic MLP pair; ``update`` runs all SGD epochs
+    and minibatches inside a single jitted ``lax.scan``, so a train step is
+    one XLA program regardless of epoch count.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict[str, Any]):
+        self.config = config
+        hid = config.get("hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        k1, k2, self._act_key = jax.random.split(key, 3)
+        self.params = {
+            "pi": init_mlp(k1, [obs_dim] + hid + [num_actions]),
+            "vf": init_mlp(k2, [obs_dim] + hid + [1]),
+        }
+        self.opt = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self.opt.init(self.params)
+
+        clip = config.get("clip_param", 0.2)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.0)
+
+        def logits_fn(params, obs):
+            return apply_mlp(params["pi"], obs)
+
+        def value_fn(params, obs):
+            return apply_mlp(params["vf"], obs)[..., 0]
+
+        def sample_action(params, obs, key):
+            logits = logits_fn(params, obs)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(obs.shape[0]), action]
+            value = value_fn(params, obs)
+            return action, logp, value
+
+        def greedy_action(params, obs):
+            return jnp.argmax(logits_fn(params, obs), axis=-1)
+
+        def loss_fn(params, mb):
+            logits = logits_fn(params, mb[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            actions = mb[ACTIONS].astype(jnp.int32)
+            logp = logp_all[jnp.arange(actions.shape[0]), actions]
+            ratio = jnp.exp(logp - mb[LOGPS])
+            adv = mb[ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+            vf_pred = value_fn(params, mb[OBS])
+            vf_loss = jnp.mean((vf_pred - mb[VALUE_TARGETS]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = -jnp.mean(surr) + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": -jnp.mean(surr),
+                           "vf_loss": vf_loss, "entropy": entropy}
+
+        num_epochs = config.get("num_sgd_iter", 8)
+        mb_size = config.get("sgd_minibatch_size", 128)
+
+        def update(params, opt_state, batch, key):
+            n = batch[OBS].shape[0]  # static under jit
+            num_mb = max(n // mb_size, 1)
+
+            def epoch_body(carry, epoch_key):
+                params, opt_state = carry
+                perm = jax.random.permutation(epoch_key, n)
+
+                def mb_body(carry, i):
+                    params, opt_state = carry
+                    idx = jax.lax.dynamic_slice_in_dim(
+                        perm, i * mb_size, mb_size)
+                    mb = {k: v[idx] for k, v in batch.items()}
+                    (_, stats), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, mb)
+                    updates, opt_state = self.opt.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), stats
+
+                (params, opt_state), stats = jax.lax.scan(
+                    mb_body, (params, opt_state), jnp.arange(num_mb))
+                return (params, opt_state), jax.tree_util.tree_map(
+                    jnp.mean, stats)
+
+            keys = jax.random.split(key, num_epochs)
+            (params, opt_state), stats = jax.lax.scan(
+                epoch_body, (params, opt_state), keys)
+            return params, opt_state, jax.tree_util.tree_map(
+                lambda s: s[-1], stats)
+
+        self._sample = jax.jit(sample_action)
+        self._greedy = jax.jit(greedy_action)
+        self._value = jax.jit(value_fn)
+        self._update = jax.jit(update)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        obs = jnp.asarray(obs, dtype=jnp.float32)
+        if explore:
+            self._act_key, sub = jax.random.split(self._act_key)
+            action, logp, value = self._sample(self.params, obs, sub)
+            return (np.asarray(action), np.asarray(logp), np.asarray(value))
+        a = self._greedy(self.params, obs)
+        return np.asarray(a), None, None
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self._value(self.params, jnp.asarray(obs, dtype=jnp.float32)))
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        n = batch.count
+        mb = self.config.get("sgd_minibatch_size", 128)
+        if n < mb:
+            # pad by repetition so the scan always has one full minibatch
+            reps = -(-mb // n)
+            batch = SampleBatch(
+                {k: np.tile(np.asarray(v), (reps,) + (1,) * (np.asarray(v).ndim - 1))[:mb]
+                 for k, v in batch.items()})
+        dev_batch = {
+            k: jnp.asarray(np.asarray(v)) for k, v in batch.items()
+            if k in (OBS, ACTIONS, LOGPS, ADVANTAGES, VALUE_TARGETS)
+        }
+        self._act_key, sub = jax.random.split(self._act_key)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, dev_batch, sub)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+class DQNPolicy(Policy):
+    """Double-DQN with a target network (reference: rllib/agents/dqn/).
+
+    Epsilon-greedy exploration; the TD update is one jitted step over the
+    replay minibatch.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict[str, Any]):
+        self.config = config
+        self.num_actions = num_actions
+        hid = config.get("hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        k1, _ = jax.random.split(key)
+        self.params = init_mlp(k1, [obs_dim] + hid + [num_actions])
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.opt = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.opt.init(self.params)
+        self.epsilon = config.get("initial_epsilon", 1.0)
+        self.final_epsilon = config.get("final_epsilon", 0.02)
+        self.epsilon_timesteps = config.get("epsilon_timesteps", 10000)
+        self.steps = 0
+        gamma = config.get("gamma", 0.99)
+
+        def q_fn(params, obs):
+            return apply_mlp(params, obs)
+
+        def update(params, target_params, opt_state, batch):
+            def loss_fn(params):
+                q = q_fn(params, batch[OBS])
+                acts = batch[ACTIONS].astype(jnp.int32)
+                q_sel = q[jnp.arange(acts.shape[0]), acts]
+                # double-DQN: online net picks argmax, target net evaluates
+                next_online = q_fn(params, batch[NEXT_OBS])
+                next_target = q_fn(target_params, batch[NEXT_OBS])
+                next_a = jnp.argmax(next_online, axis=-1)
+                next_q = next_target[jnp.arange(acts.shape[0]), next_a]
+                target = batch[REWARDS] + gamma * (
+                    1.0 - batch[DONES]) * next_q
+                td = q_sel - jax.lax.stop_gradient(target)
+                weights = batch.get("weights")
+                sq = td ** 2 if weights is None else weights * td ** 2
+                return jnp.mean(sq), jnp.abs(td)
+
+            (loss, td_abs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td_abs
+
+        self._q = jax.jit(q_fn)
+        self._update = jax.jit(update)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))
+        actions = q.argmax(axis=-1)
+        if explore:
+            frac = min(1.0, self.steps / max(self.epsilon_timesteps, 1))
+            self.epsilon = 1.0 + frac * (self.final_epsilon - 1.0)
+            mask = np.random.rand(len(actions)) < self.epsilon
+            actions = np.where(
+                mask, np.random.randint(self.num_actions, size=len(actions)),
+                actions)
+            self.steps += len(actions)
+        return actions, None, None
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)}
+        if "weights" in batch:  # importance weights from prioritized replay
+            dev["weights"] = jnp.asarray(
+                np.asarray(batch["weights"], dtype=np.float32))
+        self.params, self.opt_state, loss, td_abs = self._update(
+            self.params, self.target_params, self.opt_state, dev)
+        self.last_td_error = np.asarray(td_abs)  # per-row |td| for priorities
+        return {"loss": float(loss),
+                "mean_td_error": float(self.last_td_error.mean()),
+                "epsilon": self.epsilon}
+
+    def update_target(self) -> None:
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target_params,
+                               "steps": self.steps})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target_params = jax.device_put(weights["target"])
+        self.steps = weights.get("steps", self.steps)

@@ -1,0 +1,48 @@
+"""WorkerSet: local learner + remote rollout actors
+(reference: rllib/evaluation/worker_set.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+from .rollout_worker import RolloutWorker
+
+
+class WorkerSet:
+    def __init__(self, env_spec: Any, policy_cls, config: Dict[str, Any],
+                 num_workers: int):
+        # The local worker holds the canonical ("learner") policy state.
+        self._local = RolloutWorker(env_spec, policy_cls, config,
+                                    worker_index=0)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.get("num_cpus_per_worker", 1))(RolloutWorker)
+        self._remote = [
+            remote_cls.remote(env_spec, policy_cls, config, i + 1)
+            for i in range(num_workers)
+        ]
+
+    def local_worker(self) -> RolloutWorker:
+        return self._local
+
+    def remote_workers(self) -> List:
+        return list(self._remote)
+
+    def sync_weights(self) -> None:
+        """Broadcast learner weights to all rollout workers. The weights ref
+        is put once and shared (reference worker_set.sync_weights)."""
+        if not self._remote:
+            return
+        weights = ray_tpu.put(self._local.get_weights())
+        ray_tpu.get([w.set_weights.remote(weights) for w in self._remote])
+
+    def foreach_worker(self, fn: Callable) -> List:
+        out = [fn(self._local)]
+        out.extend(ray_tpu.get([w.apply.remote(fn) for w in self._remote]))
+        return out
+
+    def stop(self) -> None:
+        for w in self._remote:
+            ray_tpu.kill(w)
+        self._remote = []
