@@ -38,6 +38,17 @@ from .exceptions import (  # noqa: F401
 )
 from .object_ref import ObjectRef  # noqa: F401
 from .remote_function import remote  # noqa: F401
+from .actor import Checkpointable, exit_actor  # noqa: F401
+from .profiling import profile  # noqa: F401
+from . import state  # noqa: F401
+
+
+def register_custom_serializer(cls, *, serializer, deserializer) -> None:
+    """Install a custom (de)serializer for a type
+    (reference: worker.py:1397 register_custom_serializer)."""
+    from ._private.serialization import get_context
+
+    get_context().register_custom_serializer(cls, serializer, deserializer)
 
 __all__ = [
     "init",
@@ -54,6 +65,11 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "timeline",
+    "profile",
+    "state",
+    "exit_actor",
+    "Checkpointable",
+    "register_custom_serializer",
     "ObjectRef",
     "RayTpuError",
     "TaskError",

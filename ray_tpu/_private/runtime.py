@@ -132,6 +132,9 @@ class LocalActor:
         self.is_asyncio = is_asyncio
         self.queue: "deque[Tuple[int, TaskSpec]]" = deque()
         self.next_seq = 0
+        self.restarts_left = 0  # set from creation spec in start()
+        self.checkpoints: deque = deque(maxlen=20)  # Checkpointable blobs
+        self._exit_requested = False
         self.pending_out_of_order: Dict[int, TaskSpec] = {}
         self.cv = threading.Condition()
         self.num_executing = 0
@@ -143,6 +146,7 @@ class LocalActor:
 
     def start(self, creation_spec: TaskSpec, cls: type, args, kwargs):
         self._creation = (creation_spec, cls, args, kwargs)
+        self.restarts_left = creation_spec.max_restarts
         self.thread.start()
 
     def submit(self, seq_no: int, spec: TaskSpec):
@@ -164,8 +168,15 @@ class LocalActor:
             self.cv.notify_all()
         self._wake_loop()
 
-    def kill(self, no_restart: bool = True):
+    def kill(self, no_restart: bool = True) -> bool:
+        """Kill the actor; returns True if it is restarting instead of dying.
+
+        Restart semantics follow the reference (max_restarts,
+        core_worker.cc:1156 + gcs_actor_manager): queued calls fail during the
+        restart, later calls hit the fresh instance; -1 = infinite restarts.
+        """
         with self.cv:
+            already_dead = self.dead
             self.dead = True
             pending = [spec for _, spec in self.queue]
             pending.extend(self.pending_out_of_order.values())
@@ -175,6 +186,32 @@ class LocalActor:
         for spec in pending:
             self._fail_spec(spec, ActorDiedError(self.actor_id))
         self._wake_loop()
+        if (no_restart or already_dead or self.creation_error is not None
+                or self.restarts_left == 0):
+            return False
+        if self.restarts_left > 0:
+            self.restarts_left -= 1
+        self._restart()
+        return True
+
+    def _restart(self) -> None:
+        old_thread = self.thread
+        old_loop = self.loop
+        if old_thread.is_alive() and old_thread is not threading.current_thread():
+            old_thread.join(timeout=5.0)
+        if old_loop is not None and not old_loop.is_closed():
+            old_loop.close()
+        with self.cv:
+            self.instance = None
+            self.loop = None
+            self.inner_pool = None
+            self.created.clear()
+            self._exit_requested = False
+            self.dead = False
+        self.thread = threading.Thread(
+            target=self._run, name=f"actor-{self.actor_id.hex()[:8]}",
+            daemon=True)
+        self.thread.start()
 
     def _fail_spec(self, spec: TaskSpec, error: BaseException):
         for oid in spec.return_ids():
@@ -188,6 +225,10 @@ class LocalActor:
         try:
             resolved_args, resolved_kwargs = self.runtime._resolve_args(args, kwargs)
             self.instance = cls(*resolved_args, **resolved_kwargs)
+            if self.checkpoints and hasattr(self.instance, "load_checkpoint"):
+                # Restart of a Checkpointable actor: resume from the newest
+                # checkpoint (reference actor.py:972 + node_manager.h:525).
+                self.instance.load_checkpoint(self.checkpoints[-1])
             self.runtime.store.put(
                 creation_spec.return_ids()[0], StoredObject(value=self.actor_id)
             )
@@ -266,12 +307,36 @@ class LocalActor:
             self.loop.call_soon_threadsafe(self._wake.set)
 
     def _execute_method(self, spec: TaskSpec):
+        from ..exceptions import ActorExitError
+
         _LOCAL.ctx = WorkerContext(spec.job_id, spec.task_id)
-        self.runtime._execute_callable(
-            spec, lambda a, k: getattr(self.instance, spec.function.qualname)(*a, **k)
-        )
+
+        def call(a, k):
+            try:
+                return getattr(self.instance, spec.function.qualname)(*a, **k)
+            except ActorExitError:
+                self._exit_requested = True
+                return None
+
+        self.runtime._execute_callable(spec, call)
+        self._post_method_hooks()
+
+    def _post_method_hooks(self):
+        if self._exit_requested:
+            self.runtime.kill_actor(self.actor_id, no_restart=True)
+            return
+        inst = self.instance
+        if (inst is not None and hasattr(inst, "should_checkpoint")
+                and hasattr(inst, "save_checkpoint")):
+            try:
+                if inst.should_checkpoint(None):
+                    self.checkpoints.append(inst.save_checkpoint())
+            except Exception:  # noqa: BLE001 - checkpointing is best-effort
+                pass
 
     async def _execute_method_async(self, spec: TaskSpec):
+        from ..exceptions import ActorExitError
+
         method = getattr(self.instance, spec.function.qualname)
         t0 = time.monotonic()
         try:
@@ -280,6 +345,11 @@ class LocalActor:
             if asyncio.iscoroutine(result):
                 result = await result
             self.runtime._store_returns(spec, result)
+            self._post_method_hooks()
+        except ActorExitError:
+            self.runtime._store_returns(spec, None)
+            self._exit_requested = True
+            self._post_method_hooks()
         except BaseException as e:  # noqa: BLE001
             self.runtime._store_error(spec, TaskError(spec.function.repr_name, e))
         finally:
@@ -559,7 +629,9 @@ class LocalRuntime:
             actor = self._actors.get(actor_id)
         if actor is None:
             return
-        actor.kill(no_restart)
+        restarting = actor.kill(no_restart)
+        if restarting:
+            return  # actor keeps its resources, name, and handle validity
         self._release_actor_resources(actor)  # idempotent on repeated kill()
         with self._lock:
             if actor.name:
@@ -668,6 +740,27 @@ class LocalRuntime:
                 }
                 for aid, a in self._actors.items()
             }
+
+    def set_resource(self, name: str, capacity: float) -> None:
+        """Create/update/delete a custom resource at runtime (reference:
+        python/ray/experimental/dynamic_resources.py via raylet)."""
+        fixed = int(round(capacity * 1000))
+        with self._resource_cv:
+            old_total = self.node.total.custom.get(name, 0)
+            delta = fixed - old_total
+            new_total = dict(self.node.total.custom)
+            new_avail = dict(self.node.available.custom)
+            if fixed == 0:
+                new_total.pop(name, None)
+                new_avail.pop(name, None)
+            else:
+                new_total[name] = fixed
+                new_avail[name] = new_avail.get(name, 0) + delta
+            self.node.total = ResourceSet(self.node.total.predefined,
+                                          new_total)
+            self.node.available = ResourceSet(self.node.available.predefined,
+                                              new_avail)
+            self._resource_cv.notify_all()
 
     def next_task_id(self) -> TaskID:
         ctx = ensure_context(self)

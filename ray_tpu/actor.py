@@ -18,6 +18,37 @@ from ._private.worker import global_worker
 from .object_ref import ObjectRef
 
 
+def exit_actor() -> None:
+    """Terminate the current actor from inside one of its methods
+    (reference: actor.py:920). The in-flight call returns None; queued and
+    subsequent calls fail with ActorDiedError; no restart is attempted."""
+    from .exceptions import ActorExitError
+
+    raise ActorExitError()
+
+
+class Checkpointable:
+    """Opt-in actor checkpointing (reference: actor.py:972 Checkpointable ABC).
+
+    An actor class (created with ``max_restarts != 0``) that subclasses this
+    gets: after every method call, ``should_checkpoint(ctx)`` is consulted and
+    ``save_checkpoint()``'s blob is retained (last 20, matching the
+    reference's keep-last-20 default); after a restart, ``load_checkpoint``
+    receives the newest blob before serving calls. Simplified vs the
+    reference: blobs live in the runtime, not a user-managed store, so there
+    is no checkpoint_expired/checkpoint-id protocol.
+    """
+
+    def should_checkpoint(self, checkpoint_context) -> bool:
+        return True
+
+    def save_checkpoint(self):
+        raise NotImplementedError
+
+    def load_checkpoint(self, checkpoint) -> None:
+        raise NotImplementedError
+
+
 class ActorMethod:
     """Stub for one actor method (reference actor.py:51)."""
 

@@ -419,6 +419,30 @@ class GcsServer:
                 return {"ok": False, "error": "unknown function"}
             return {"ok": True, "blob": blob}
 
+        @s.handler("set_resource")
+        async def set_resource(msg, conn):
+            # Dynamic custom resource on one node (default: first alive).
+            # Reference: experimental/dynamic_resources.py -> raylet.
+            name, capacity = msg["name"], float(msg["capacity"])
+            target = msg.get("node_id")
+            for nid in self._node_order:
+                node = self.nodes[nid]
+                if not node.alive:
+                    continue
+                if target is not None and nid != target:
+                    continue
+                old = node.resources.get(name, 0.0)
+                if capacity == 0:
+                    node.resources.pop(name, None)
+                    node.available.pop(name, None)
+                else:
+                    node.resources[name] = capacity
+                    node.available[name] = (
+                        node.available.get(name, 0.0) + capacity - old)
+                self._place_event.set()
+                return {"ok": True, "node_id": nid}
+            return {"ok": False, "error": "no matching alive node"}
+
         @s.handler("kv_put")
         async def kv_put(msg, conn):
             self.kv[msg["key"]] = msg["value"]

@@ -105,6 +105,12 @@ class TaskCancelledError(RayTpuError):
         return (type(self), (self.task_id,))
 
 
+class ActorExitError(BaseException):
+    """Control-flow exception raised by ``exit_actor()`` — intentionally a
+    BaseException so user ``except Exception`` blocks can't swallow it
+    (reference: actor.py:920 exit_actor raises via SystemExit)."""
+
+
 class RuntimeEnvError(RayTpuError):
     pass
 

@@ -1,0 +1,83 @@
+"""Global state API (reference: python/ray/state.py GlobalState).
+
+Snapshot queries over the running system: nodes, actors, objects, resources,
+and the memory summary that backs the ``ray memory`` CLI view.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ._private.worker import global_worker
+
+
+def _core():
+    worker = global_worker()
+    worker.check_connected()
+    return worker.core
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _core().nodes()
+
+
+def actors() -> Dict[str, Dict[str, Any]]:
+    """actor_id hex -> {ActorID, State, Name} (reference state.py actors)."""
+    return _core().actors()
+
+
+def objects() -> Dict[str, Dict[str, Any]]:
+    """object_id hex -> {size, has_error} for every stored object."""
+    core = _core()
+    store = getattr(core, "store", None)
+    if store is None:
+        return {}
+    out = {}
+    with store._lock:
+        for oid, obj in store._objects.items():
+            out[oid.hex()] = {
+                "size_bytes": obj.nbytes,
+                "has_error": obj.error is not None,
+            }
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _core().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return _core().available_resources()
+
+
+def object_store_stats() -> Dict[str, int]:
+    core = _core()
+    store = getattr(core, "store", None)
+    if store is None:
+        return {}
+    return store.stats()
+
+
+def memory_summary() -> str:
+    """Human-readable object-store summary (reference: `ray memory`,
+    scripts.py:1084 + memory.py)."""
+    objs = objects()
+    stats = object_store_stats()
+    lines = [
+        "=== Object store summary ===",
+        f"objects: {len(objs)}",
+        f"used_bytes: {stats.get('used_bytes', 0)}",
+        f"max_bytes: {stats.get('max_bytes', 0) or 'unlimited'}",
+        "",
+        f"{'OBJECT_ID':<44} {'SIZE':>12}  ERROR",
+    ]
+    for oid, info in sorted(objs.items(),
+                            key=lambda kv: -kv[1]["size_bytes"])[:50]:
+        lines.append(
+            f"{oid:<44} {info['size_bytes']:>12}  {info['has_error']}")
+    return "\n".join(lines)
+
+
+def jobs() -> List[Dict[str, Any]]:
+    core = _core()
+    return [{"job_id": core.job_id.hex(), "is_dead": False}]
