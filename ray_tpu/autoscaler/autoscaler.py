@@ -1,0 +1,116 @@
+"""StandardAutoscaler (reference: python/ray/autoscaler/autoscaler.py:32).
+
+Each ``update()``: prune dead nodes, terminate idle workers past the idle
+timeout and any beyond max_workers, then launch workers for utilization
+pressure and unplaceable pending demands (bin-packed). Same decision
+structure as the reference, without the ssh/updater machinery (nodes here are
+processes or cloud TPU VMs behind the provider).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from .load_metrics import LoadMetrics
+from .node_provider import (
+    NodeProvider, STATUS_UP_TO_DATE, TAG_NODE_KIND, TAG_NODE_STATUS,
+)
+from .resource_demand_scheduler import get_nodes_to_launch
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONFIG = {
+    "min_workers": 0,
+    "max_workers": 8,
+    "target_utilization_fraction": 0.8,
+    "idle_timeout_minutes": 5.0,
+    "max_launch_batch": 4,
+    "heartbeat_timeout_s": 30.0,
+    "worker_resources": {"CPU": 2.0},
+    "worker_node_config": {},
+}
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, load_metrics: LoadMetrics,
+                 config: Optional[Dict[str, Any]] = None):
+        self.provider = provider
+        self.load_metrics = load_metrics
+        self.config = {**DEFAULT_CONFIG, **(config or {})}
+        self.last_idle_since: Dict[str, float] = {}
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    def workers(self) -> List[str]:
+        return self.provider.non_terminated_nodes(
+            {TAG_NODE_KIND: "worker"})
+
+    def update(self) -> None:
+        cfg = self.config
+        self.load_metrics.prune_inactive(cfg["heartbeat_timeout_s"])
+        workers = self.workers()
+
+        # 1. enforce max_workers (newest first, matching the reference).
+        while len(workers) > cfg["max_workers"]:
+            victim = workers.pop()
+            self._terminate(victim, "max_workers")
+
+        # 2. terminate idle nodes past the timeout (but keep min_workers).
+        idle_cutoff = cfg["idle_timeout_minutes"] * 60.0
+        idle_ips = set(self.load_metrics.idle_ips(idle_cutoff))
+        now = time.monotonic()
+        for node_id in list(workers):
+            if len(workers) <= cfg["min_workers"]:
+                break
+            ip = self.provider.internal_ip(node_id)
+            if ip in idle_ips:
+                since = self.last_idle_since.setdefault(node_id, now)
+                if now - since > idle_cutoff:
+                    workers.remove(node_id)
+                    self._terminate(node_id, "idle")
+            else:
+                self.last_idle_since.pop(node_id, None)
+
+        # 3. scale up: min_workers floor, utilization pressure, pending demands.
+        target = cfg["min_workers"]
+        util = self.load_metrics.utilization()
+        if util > cfg["target_utilization_fraction"]:
+            # grow proportionally to overshoot (reference's target-frac rule)
+            cur = max(self.load_metrics.num_nodes(), 1)
+            target = max(target, math.ceil(
+                cur * util / cfg["target_utilization_fraction"]) - 1)
+        demands = self.load_metrics.pending_demands
+        if demands:
+            free = list(self.load_metrics.dynamic_resources.values())
+            extra = get_nodes_to_launch(
+                demands, free, cfg["worker_resources"],
+                max_new_nodes=cfg["max_workers"] - len(workers))
+            target = max(target, len(workers) + extra)
+
+        target = min(target, cfg["max_workers"])
+        if target > len(workers):
+            count = min(target - len(workers), cfg["max_launch_batch"])
+            self._launch(count)
+
+    def _launch(self, count: int) -> None:
+        logger.info("autoscaler: launching %d workers", count)
+        self.provider.create_node(
+            self.config["worker_node_config"],
+            {TAG_NODE_KIND: "worker", TAG_NODE_STATUS: STATUS_UP_TO_DATE},
+            count)
+        self.num_launches += count
+
+    def _terminate(self, node_id: str, reason: str) -> None:
+        logger.info("autoscaler: terminating %s (%s)", node_id, reason)
+        self.provider.terminate_node(node_id)
+        self.last_idle_since.pop(node_id, None)
+        self.num_terminations += 1
+
+    def summary(self) -> str:
+        return (f"Autoscaler: {len(self.workers())} workers "
+                f"(launched {self.num_launches}, "
+                f"terminated {self.num_terminations}); "
+                f"{self.load_metrics.summary()}")

@@ -1,0 +1,77 @@
+"""LoadMetrics: cluster load snapshot from heartbeats
+(reference: python/ray/autoscaler/load_metrics.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class LoadMetrics:
+    def __init__(self):
+        self.static_resources: Dict[str, Dict[str, float]] = {}   # ip -> total
+        self.dynamic_resources: Dict[str, Dict[str, float]] = {}  # ip -> avail
+        self.last_heartbeat: Dict[str, float] = {}
+        self.pending_demands: List[Dict[str, float]] = []  # unplaceable tasks
+
+    def update(self, ip: str, static: Dict[str, float],
+               dynamic: Dict[str, float]) -> None:
+        self.static_resources[ip] = dict(static)
+        self.dynamic_resources[ip] = dict(dynamic)
+        self.last_heartbeat[ip] = time.monotonic()
+
+    def mark_dead(self, ip: str) -> None:
+        self.static_resources.pop(ip, None)
+        self.dynamic_resources.pop(ip, None)
+        self.last_heartbeat.pop(ip, None)
+
+    def set_pending_demands(self, demands: List[Dict[str, float]]) -> None:
+        self.pending_demands = list(demands)
+
+    def prune_inactive(self, timeout_s: float) -> None:
+        now = time.monotonic()
+        for ip in [ip for ip, t in self.last_heartbeat.items()
+                   if now - t > timeout_s]:
+            self.mark_dead(ip)
+
+    # ---- aggregates (reference load_metrics.py get_resource_usage) ----
+
+    def num_nodes(self) -> int:
+        return len(self.static_resources)
+
+    def utilization(self) -> float:
+        """Max over resource kinds of used/total (the reference's
+        approach: scale on the most contended resource)."""
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for res in self.static_resources.values():
+            for k, v in res.items():
+                total[k] = total.get(k, 0.0) + v
+        for res in self.dynamic_resources.values():
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0.0) + v
+        frac = 0.0
+        for k, tot in total.items():
+            if tot <= 0:
+                continue
+            used = tot - avail.get(k, 0.0)
+            frac = max(frac, used / tot)
+        return frac
+
+    def idle_ips(self, idle_timeout_s: float,
+                 busy_threshold: float = 1e-9) -> List[str]:
+        """Nodes whose resources are fully available (nothing running)."""
+        out = []
+        for ip, total in self.static_resources.items():
+            avail = self.dynamic_resources.get(ip, {})
+            busy = any(
+                total.get(k, 0.0) - avail.get(k, 0.0) > busy_threshold
+                for k in total)
+            if not busy:
+                out.append(ip)
+        return out
+
+    def summary(self) -> str:
+        return (f"LoadMetrics: {self.num_nodes()} nodes, "
+                f"utilization={self.utilization():.2f}, "
+                f"pending={len(self.pending_demands)}")

@@ -1,0 +1,164 @@
+"""NodeProvider abstraction (reference: python/ray/autoscaler/node_provider.py).
+
+The reference ships aws/gcp/azure/k8s/local providers behind one interface;
+here the interface plus two concrete ones: MockProvider (unit tests, exactly
+like the reference's test MockProvider) and SubprocessProvider (real
+controller processes on this host — the TPU-pod-slice analogue where "a node"
+is a host process owning devices).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+TAG_NODE_KIND = "node-kind"  # head | worker
+TAG_NODE_STATUS = "node-status"
+STATUS_UP_TO_DATE = "up-to-date"
+STATUS_UNINITIALIZED = "uninitialized"
+
+
+class NodeProvider:
+    """Minimal lifecycle interface (reference node_provider.py:70)."""
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        self.provider_config = provider_config
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def is_terminated(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict[str, Any], tags: Dict[str, str],
+                    count: int) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def internal_ip(self, node_id: str) -> str:
+        return node_id
+
+
+class MockProvider(NodeProvider):
+    """In-memory provider (reference: test_autoscaler.py MockProvider)."""
+
+    def __init__(self, provider_config: Optional[Dict] = None):
+        super().__init__(provider_config or {})
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+        self.fail_creates = False
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            out = []
+            for nid, n in self.nodes.items():
+                if n["terminated"]:
+                    continue
+                if all(n["tags"].get(k) == v for k, v in tag_filters.items()):
+                    out.append(nid)
+            return out
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self.nodes and not self.nodes[node_id]["terminated"]
+
+    def is_terminated(self, node_id: str) -> bool:
+        return not self.is_running(node_id)
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.nodes[node_id]["tags"])
+
+    def create_node(self, node_config, tags, count) -> None:
+        if self.fail_creates:
+            raise RuntimeError("injected create failure")
+        with self._lock:
+            for _ in range(count):
+                nid = str(self._next_id)
+                self._next_id += 1
+                self.nodes[nid] = {
+                    "tags": dict(tags), "config": dict(node_config),
+                    "terminated": False, "created_at": time.monotonic(),
+                }
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            if node_id in self.nodes:
+                self.nodes[node_id]["terminated"] = True
+
+
+class SubprocessProvider(NodeProvider):
+    """Workers are `python -m ray_tpu.cluster.launch node` processes joined to
+    a running GCS — scaling a one-host dev cluster up/down for real."""
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        super().__init__(provider_config)
+        self.gcs_address = provider_config["gcs_address"]
+        self.resources = provider_config.get(
+            "worker_resources", {"CPU": 2})
+        self.num_workers = provider_config.get("workers_per_node", 2)
+        self._lock = threading.Lock()
+        self._procs: Dict[str, Any] = {}
+        self._tags: Dict[str, Dict[str, str]] = {}
+        self._next = 0
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            return [
+                nid for nid, p in self._procs.items()
+                if p.poll() is None and all(
+                    self._tags[nid].get(k) == v
+                    for k, v in tag_filters.items())
+            ]
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            p = self._procs.get(node_id)
+            return p is not None and p.poll() is None
+
+    def is_terminated(self, node_id: str) -> bool:
+        return not self.is_running(node_id)
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._tags.get(node_id, {}))
+
+    def create_node(self, node_config, tags, count) -> None:
+        import json as _json
+        import subprocess
+        import sys
+
+        resources = node_config.get("resources", self.resources)
+        for _ in range(count):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
+                 "--gcs", self.gcs_address,
+                 "--resources", _json.dumps(resources),
+                 "--num-workers", str(self.num_workers)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            with self._lock:
+                nid = f"worker-{self._next}"
+                self._next += 1
+                self._procs[nid] = proc
+                self._tags[nid] = dict(tags)
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            proc = self._procs.get(node_id)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()

@@ -1,0 +1,53 @@
+"""Bin-packing preview: how many nodes to launch for pending demands
+(reference: python/ray/autoscaler/resource_demand_scheduler.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+def _fits(demand: Dict[str, float], free: Dict[str, float]) -> bool:
+    return all(free.get(k, 0.0) >= v for k, v in demand.items())
+
+
+def _consume(demand: Dict[str, float], free: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        free[k] = free.get(k, 0.0) - v
+
+
+def get_nodes_to_launch(
+    pending_demands: List[Dict[str, float]],
+    existing_free: List[Dict[str, float]],
+    node_type_resources: Dict[str, float],
+    max_new_nodes: int,
+) -> int:
+    """First-fit-decreasing pack of pending demands onto existing free
+    capacity, then onto hypothetical new nodes; returns new-node count."""
+    free = [dict(f) for f in existing_free]
+    demands = sorted(pending_demands,
+                     key=lambda d: -sum(d.values()))
+    new_nodes: List[Dict[str, float]] = []
+    for demand in demands:
+        placed = False
+        for f in free:
+            if _fits(demand, f):
+                _consume(demand, f)
+                placed = True
+                break
+        if placed:
+            continue
+        for f in new_nodes:
+            if _fits(demand, f):
+                _consume(demand, f)
+                placed = True
+                break
+        if placed:
+            continue
+        if len(new_nodes) >= max_new_nodes:
+            continue  # unservable within limits this round
+        if not _fits(demand, dict(node_type_resources)):
+            continue  # demand can never fit one node; skip (infeasible)
+        fresh = dict(node_type_resources)
+        _consume(demand, fresh)
+        new_nodes.append(fresh)
+    return len(new_nodes)

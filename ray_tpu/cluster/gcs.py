@@ -54,6 +54,7 @@ class GcsServer:
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = {}
         # placement queue: (demand ResourceSet, locality node_id|None, future)
         self._pending_place: List[Tuple[ResourceSet, Optional[str], asyncio.Future]] = []
+        self._unplaceable: Dict[Any, Dict[str, float]] = {}  # autoscaler feed
         self._place_event = asyncio.Event()
         self._seed = 0
         self._tasks: List[asyncio.Task] = []
@@ -282,18 +283,25 @@ class GcsServer:
                 demand = ResourceSet.from_dict(msg["resources"])
                 locality = msg.get("locality")
                 deadline = time.monotonic() + msg.get("timeout", 30.0)
-                while True:
-                    fut = asyncio.get_event_loop().create_future()
-                    self._pending_place.append((demand, locality, fut))
-                    self._place_event.set()
-                    node_id = await fut
-                    if node_id is not None:
-                        return {"ok": True, "node_id": node_id,
-                                "address": self.nodes[node_id].address}
-                    if time.monotonic() > deadline:
-                        return {"ok": False,
-                                "error": f"no feasible node for {demand.to_dict()}"}
-                    await asyncio.sleep(0.02)
+                token = object()
+                try:
+                    while True:
+                        fut = asyncio.get_event_loop().create_future()
+                        self._pending_place.append((demand, locality, fut))
+                        self._place_event.set()
+                        node_id = await fut
+                        if node_id is not None:
+                            return {"ok": True, "node_id": node_id,
+                                    "address": self.nodes[node_id].address}
+                        # Not placeable right now: visible to the autoscaler
+                        # as a pending demand until placed or timed out.
+                        self._unplaceable[token] = demand.to_dict()
+                        if time.monotonic() > deadline:
+                            return {"ok": False,
+                                    "error": f"no feasible node for {demand.to_dict()}"}
+                        await asyncio.sleep(0.02)
+                finally:
+                    self._unplaceable.pop(token, None)
 
             self._detach(msg, conn, work())
             return None
@@ -418,6 +426,20 @@ class GcsServer:
             if blob is None:
                 return {"ok": False, "error": "unknown function"}
             return {"ok": True, "blob": blob}
+
+        @s.handler("list_objects")
+        async def list_objects(msg, conn):
+            out = {}
+            for oid, info in list(self.objects.items())[:msg.get("limit", 1000)]:
+                out[oid.hex() if isinstance(oid, bytes) else str(oid)] = {
+                    "locations": list(info.get("locations", [])),
+                    "size": info.get("size", 0),
+                }
+            return {"ok": True, "objects": out}
+
+        @s.handler("pending_demands")
+        async def pending_demands(msg, conn):
+            return {"ok": True, "demands": list(self._unplaceable.values())}
 
         @s.handler("set_resource")
         async def set_resource(msg, conn):

@@ -1,0 +1,73 @@
+"""Monitor: head-node daemon driving the autoscaler
+(reference: python/ray/monitor.py Monitor :21).
+
+Polls the GCS for node membership/resources and unplaceable placement
+demands, feeds LoadMetrics, and calls StandardAutoscaler.update() each tick.
+The reference consumes the heartbeat pubsub stream; polling the same tables
+gives identical information on our asyncio GCS.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .autoscaler import LoadMetrics, StandardAutoscaler
+from .autoscaler.node_provider import NodeProvider
+from .cluster.protocol import RpcClient
+
+
+class Monitor:
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 autoscaler_config: Optional[Dict[str, Any]] = None,
+                 update_interval_s: float = 1.0):
+        host, port = gcs_address.rsplit(":", 1)
+        self.gcs = RpcClient(host, int(port))
+        self.load_metrics = LoadMetrics()
+        self.autoscaler = StandardAutoscaler(
+            provider, self.load_metrics, autoscaler_config)
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_updates = 0
+
+    def poll_once(self) -> None:
+        nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
+        seen = set()
+        for n in nodes:
+            if not n["Alive"]:
+                self.load_metrics.mark_dead(n["NodeID"])
+                continue
+            seen.add(n["NodeID"])
+            self.load_metrics.update(
+                n["NodeID"], n["Resources"], n["Available"])
+        for ip in list(self.load_metrics.static_resources):
+            if ip not in seen:
+                self.load_metrics.mark_dead(ip)
+        demands = self.gcs.call({"type": "pending_demands"})["demands"]
+        self.load_metrics.set_pending_demands(demands)
+
+    def update(self) -> None:
+        self.poll_once()
+        self.autoscaler.update()
+        self.num_updates += 1
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except (ConnectionError, OSError):
+                break  # GCS gone: head is shutting down
+            self._stop.wait(self.update_interval_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name="monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.gcs.close()

@@ -1,0 +1,257 @@
+"""CLI (reference: python/ray/scripts/scripts.py — ray start/stop/status/
+memory/timeline/microbenchmark/kill_random_node).
+
+argparse instead of click (not baked into the image). Session state (head
+process pid, GCS address, worker pids) lives in a JSON session file so
+``stop``/``status`` can find the cluster started by ``start``.
+
+    python -m ray_tpu.scripts.cli start --head --num-workers 4
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli stop
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+SESSION_FILE = os.environ.get(
+    "RAY_TPU_SESSION_FILE", "/tmp/ray_tpu_session.json")
+
+
+def _load_session() -> Dict:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _save_session(state: Dict) -> None:
+    with open(SESSION_FILE, "w") as f:
+        json.dump(state, f)
+
+
+def _gcs_client(address: Optional[str]):
+    from ray_tpu.cluster.protocol import RpcClient
+
+    if address is None:
+        address = _load_session().get("address")
+    if address is None:
+        raise SystemExit("no running cluster (and no --address given)")
+    host, port = address.rsplit(":", 1)
+    return RpcClient(host, int(port))
+
+
+# ---------------------------------------------------------------- commands
+
+def cmd_start(args) -> None:
+    resources = json.loads(args.resources) if args.resources else {"CPU": 4}
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
+               "--port", str(args.port),
+               "--resources", json.dumps(resources),
+               "--num-workers", str(args.num_workers)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        # wait for the gcs_started event line
+        deadline = time.monotonic() + 60
+        port = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise SystemExit("head process died during startup")
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if event.get("event") == "gcs_started":
+                port = event["port"]
+                break
+        if port is None:
+            proc.kill()
+            raise SystemExit("timed out waiting for GCS startup")
+        address = f"127.0.0.1:{port}"
+        _save_session({"address": address, "head_pid": proc.pid,
+                       "worker_pids": []})
+        print(f"started head: address={address} pid={proc.pid}")
+        print(f"connect with ray_tpu.init(address={address!r})")
+        return
+
+    if not args.address:
+        raise SystemExit("--address required to start a worker node")
+    cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
+           "--gcs", args.address,
+           "--resources", json.dumps(resources),
+           "--num-workers", str(args.num_workers)]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    state = _load_session()
+    state.setdefault("worker_pids", []).append(proc.pid)
+    _save_session(state)
+    print(f"started worker node pid={proc.pid} -> {args.address}")
+
+
+def cmd_stop(args) -> None:
+    state = _load_session()
+    stopped = 0
+    for pid in state.get("worker_pids", []) + (
+            [state["head_pid"]] if "head_pid" in state else []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    # grace period, then hard kill
+    time.sleep(1.0)
+    for pid in state.get("worker_pids", []) + (
+            [state["head_pid"]] if "head_pid" in state else []):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    try:
+        os.unlink(SESSION_FILE)
+    except OSError:
+        pass
+    print(f"stopped {stopped} processes")
+
+
+def cmd_status(args) -> None:
+    gcs = _gcs_client(args.address)
+    try:
+        nodes = gcs.call({"type": "list_nodes"})["nodes"]
+        res = gcs.call({"type": "cluster_resources"})
+        print(f"nodes: {sum(n['Alive'] for n in nodes)} alive / {len(nodes)}")
+        for n in nodes:
+            state = "ALIVE" if n["Alive"] else "DEAD"
+            print(f"  {n['NodeID'][:12]} {state:<6} {n['Resources']}")
+        print(f"total resources:     {res['total']}")
+        print(f"available resources: {res['available']}")
+    finally:
+        gcs.close()
+
+
+def cmd_memory(args) -> None:
+    gcs = _gcs_client(args.address)
+    try:
+        objs = gcs.call({"type": "list_objects", "limit": args.limit})["objects"]
+        print(f"{len(objs)} objects in the cluster object table")
+        print(f"{'OBJECT_ID':<44} {'SIZE':>12}  LOCATIONS")
+        for oid, info in sorted(objs.items(), key=lambda kv: -kv[1]["size"]):
+            locs = ",".join(str(l)[:12] for l in info["locations"])
+            print(f"{oid:<44} {info['size']:>12}  {locs}")
+    finally:
+        gcs.close()
+
+
+def cmd_kill_random_node(args) -> None:
+    gcs = _gcs_client(args.address)
+    try:
+        nodes = [n for n in gcs.call({"type": "list_nodes"})["nodes"]
+                 if n["Alive"]]
+        if len(nodes) <= 1:
+            raise SystemExit("refusing: would kill the only alive node")
+        victim = random.choice(nodes[1:])  # never the head's first node
+        gcs.call({"type": "report_node_dead", "node_id": victim["NodeID"]})
+        print(f"marked node dead: {victim['NodeID'][:12]}")
+    finally:
+        gcs.close()
+
+
+def cmd_timeline(args) -> None:
+    print("timeline export runs in the driver process:\n"
+          "  import ray_tpu; ray_tpu.init(); ...\n"
+          f"  ray_tpu.timeline(filename={args.output!r})\n"
+          "then open the JSON in chrome://tracing or perfetto.")
+
+
+def cmd_microbenchmark(args) -> None:
+    """In-process perf microbenchmarks (reference: ray microbenchmark /
+    ray_perf.py). Prints ops/s per pattern."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=os.cpu_count() or 4)
+
+    def timeit(name, fn, n, unit="ops/s"):
+        fn()  # warmup
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        print(f"{name:<40} {n / dt:>12,.0f} {unit}")
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    timeit("tasks sync (1k serial round-trips)",
+           lambda: [ray_tpu.get(noop.remote()) for _ in range(1000)], 1000)
+    timeit("tasks async (10k batched)",
+           lambda: ray_tpu.get([noop.remote() for _ in range(10000)]), 10000)
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    timeit("actor calls sync (1k serial)",
+           lambda: [ray_tpu.get(a.m.remote()) for _ in range(1000)], 1000)
+    timeit("actor calls async (10k pipelined)",
+           lambda: ray_tpu.get([a.m.remote() for _ in range(10000)]), 10000)
+
+    blob = np.zeros(1024 * 1024, dtype=np.uint8)
+    timeit("put 1MiB x100 (GB/s)",
+           lambda: [ray_tpu.put(blob) for _ in range(100)],
+           100 / 1024, unit="GB/s")
+    ray_tpu.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address (worker mode)")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--resources", help='JSON, e.g. \'{"CPU": 8}\'')
+    sp.add_argument("--num-workers", type=int, default=2,
+                    help="worker processes per node")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the session's cluster")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in [("status", cmd_status), ("memory", cmd_memory),
+                     ("kill_random_node", cmd_kill_random_node)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        if name == "memory":
+            sp.add_argument("--limit", type=int, default=1000)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("timeline")
+    sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbenchmark")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

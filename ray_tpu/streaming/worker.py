@@ -1,0 +1,209 @@
+"""JobWorker actor + credit-based channel protocol
+(reference: streaming/python/runtime/worker.py + streaming/src/channel.h,
+data_writer/data_reader, flow_control).
+
+One actor per operator instance. Data moves downstream in batches via
+``push(channel, seq, items)`` actor calls; each channel has a credit budget
+(max unacked batches, the reference's ring-buffer capacity). A sender with no
+credits blocks on its oldest in-flight ack — that's the backpressure path.
+EOF markers propagate when all of an instance's input channels are exhausted;
+stateful operators (reduce) flush on EOF.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+from .graph import BROADCAST, FORWARD, KEY_HASH, REBALANCE, JobGraph
+
+BATCH_SIZE = 256
+CHANNEL_CREDITS = 4  # max unacked batches per channel before sender blocks
+
+
+def _stable_hash(key: Any) -> int:
+    """Process-stable key hash (Python's hash() is salted per process, which
+    would break cross-process key routing)."""
+    import zlib
+
+    if isinstance(key, bytes):
+        data = key
+    elif isinstance(key, str):
+        data = key.encode()
+    else:
+        data = repr(key).encode()
+    return zlib.crc32(data)
+
+
+class _OutChannel:
+    """Sender side of one edge instance pair (reference: ProducerChannel)."""
+
+    def __init__(self, dst_handle, channel_id: str):
+        self.dst = dst_handle
+        self.channel_id = channel_id
+        self.seq = 0
+        self.inflight: deque = deque()  # ack ObjectRefs
+
+    def send(self, items: List[Any]) -> None:
+        if len(self.inflight) >= CHANNEL_CREDITS:
+            # Out of credits: block on the oldest ack (backpressure).
+            ray_tpu.get(self.inflight.popleft())
+        self.inflight.append(
+            self.dst.push.remote(self.channel_id, self.seq, items))
+        self.seq += 1
+
+    def send_eof(self) -> None:
+        self.flush()
+        ray_tpu.get(self.dst.push_eof.remote(self.channel_id))
+
+    def flush(self) -> None:
+        while self.inflight:
+            ray_tpu.get(self.inflight.popleft())
+
+
+class JobWorker:
+    """One operator instance (reference: runtime/worker.py JobWorker)."""
+
+    def __init__(self, op_kind: str, fn_blob, instance_index: int,
+                 num_instances: int):
+        import cloudpickle
+
+        self.kind = op_kind
+        self.fn: Optional[Callable] = (
+            cloudpickle.loads(fn_blob) if fn_blob is not None else None)
+        self.index = instance_index
+        self.num_instances = num_instances
+        self._lock = threading.Lock()
+        # input channels
+        self._expected_inputs: set = set()
+        self._eof_inputs: set = set()
+        # output routing: list of (partition, [instance _OutChannel...])
+        self._outputs: List[Tuple[str, List[_OutChannel]]] = []
+        self._rr = 0
+        # operator state
+        self._reduce_state: Dict[Any, Any] = {}
+        self._sink_results: List[Any] = []
+        self._out_buffers: Dict[int, List[Any]] = defaultdict(list)
+        self.records_in = 0
+        self.records_out = 0
+
+    # ---- wiring (called by the driver before the run) ----
+
+    def add_output(self, partition: str, dst_handles: List[Any],
+                   channel_prefix: str) -> None:
+        chans = [
+            _OutChannel(h, f"{channel_prefix}:{self.index}->{j}")
+            for j, h in enumerate(dst_handles)
+        ]
+        self._outputs.append((partition, chans))
+
+    def expect_input(self, channel_id: str) -> None:
+        self._expected_inputs.add(channel_id)
+
+    # ---- data plane ----
+
+    def push(self, channel_id: str, seq: int, items: List[Any]) -> int:
+        """Receive one batch; process synchronously (the actor's ordered
+        queue is the inbound buffer; credits bound its depth)."""
+        with self._lock:
+            self._process(items)
+        return seq  # ack
+
+    def push_eof(self, channel_id: str) -> bool:
+        with self._lock:
+            self._eof_inputs.add(channel_id)
+            if self._eof_inputs >= self._expected_inputs:
+                self._on_all_inputs_done()
+        return True
+
+    def inject(self, items: List[Any]) -> None:
+        """Source path: driver feeds the source instances directly."""
+        with self._lock:
+            self._process(items)
+
+    def finish(self) -> None:
+        """Source EOF from the driver."""
+        with self._lock:
+            self._on_all_inputs_done()
+
+    # ---- operator semantics ----
+
+    def _process(self, items: List[Any]) -> None:
+        self.records_in += len(items)
+        kind, fn = self.kind, self.fn
+        if kind in ("source", "key_by"):
+            out = items if kind == "source" else [(fn(x), x) for x in items]
+            self._emit(out)
+        elif kind == "map":
+            self._emit([fn(x) for x in items])
+        elif kind == "flat_map":
+            out: List[Any] = []
+            for x in items:
+                out.extend(fn(x))
+            self._emit(out)
+        elif kind == "filter":
+            self._emit([x for x in items if fn(x)])
+        elif kind == "reduce":
+            # items arrive as (key, value); state holds the running reduction
+            for key, value in items:
+                if key in self._reduce_state:
+                    self._reduce_state[key] = fn(self._reduce_state[key], value)
+                else:
+                    self._reduce_state[key] = value
+        elif kind == "sink":
+            for x in items:
+                if fn is not None:
+                    fn(x)
+                self._sink_results.append(x)
+        else:
+            raise ValueError(f"unknown operator kind {kind!r}")
+
+    def _on_all_inputs_done(self) -> None:
+        if self.kind == "reduce":
+            # flush final (key, aggregate) pairs downstream
+            self._emit(list(self._reduce_state.items()))
+            self._reduce_state = {}
+        self._flush_buffers()
+        for _, chans in self._outputs:
+            for ch in chans:
+                ch.send_eof()
+
+    def _emit(self, items: List[Any]) -> None:
+        if not items:
+            return
+        self.records_out += len(items)
+        for partition, chans in self._outputs:
+            n = len(chans)
+            if partition == BROADCAST:
+                for ch in chans:
+                    ch.send(list(items))
+                continue
+            if partition == KEY_HASH:
+                groups: Dict[int, List[Any]] = defaultdict(list)
+                for kv in items:
+                    groups[_stable_hash(kv[0]) % n].append(kv)
+                for j, group in groups.items():
+                    chans[j].send(group)
+                continue
+            # forward/rebalance: round-robin batches
+            chans[self._rr % n].send(list(items))
+            self._rr += 1
+
+    def _flush_buffers(self) -> None:
+        for _, chans in self._outputs:
+            for ch in chans:
+                ch.flush()
+
+    # ---- results / stats ----
+
+    def sink_results(self) -> List[Any]:
+        return list(self._sink_results)
+
+    def stats(self) -> Dict[str, int]:
+        return {"records_in": self.records_in, "records_out": self.records_out}
+
+    def ready(self) -> bool:
+        return True

@@ -1,0 +1,156 @@
+"""Autoscaler + monitor + CLI tests (models: reference test_autoscaler.py,
+test_resource_demand_scheduler.py — MockProvider, no cloud)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    LoadMetrics,
+    MockProvider,
+    StandardAutoscaler,
+    get_nodes_to_launch,
+)
+from ray_tpu.autoscaler.node_provider import TAG_NODE_KIND
+
+
+def _mk(min_workers=0, max_workers=8, **over):
+    provider = MockProvider()
+    lm = LoadMetrics()
+    config = {"min_workers": min_workers, "max_workers": max_workers,
+              "idle_timeout_minutes": 0.0005,  # 30ms for tests
+              "worker_resources": {"CPU": 2.0}, **over}
+    return provider, lm, StandardAutoscaler(provider, lm, config)
+
+
+def test_scale_up_to_min_workers():
+    provider, lm, scaler = _mk(min_workers=3)
+    scaler.update()
+    assert len(provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})) == 3
+
+
+def test_launch_batch_limit():
+    provider, lm, scaler = _mk(min_workers=6, max_launch_batch=2)
+    scaler.update()
+    assert len(scaler.workers()) == 2
+    scaler.update()
+    assert len(scaler.workers()) == 4
+    scaler.update()
+    assert len(scaler.workers()) == 6
+
+
+def test_scale_up_on_pending_demands():
+    provider, lm, scaler = _mk(max_workers=10)
+    # 5 pending 1-CPU tasks, no free capacity anywhere -> ceil(5/2)=3 nodes
+    lm.update("head", {"CPU": 4}, {"CPU": 0})
+    lm.set_pending_demands([{"CPU": 1}] * 5)
+    scaler.update()
+    assert len(scaler.workers()) == 3
+
+
+def test_max_workers_enforced():
+    provider, lm, scaler = _mk(max_workers=2)
+    provider.create_node({}, {TAG_NODE_KIND: "worker"}, 5)
+    scaler.update()
+    assert len(scaler.workers()) == 2
+
+
+def test_idle_nodes_terminated_after_timeout():
+    provider, lm, scaler = _mk(min_workers=0, max_workers=4)
+    provider.create_node({}, {TAG_NODE_KIND: "worker"}, 2)
+    workers = scaler.workers()
+    # both workers heartbeat fully idle
+    for nid in workers:
+        lm.update(nid, {"CPU": 2}, {"CPU": 2})
+    scaler.update()          # marks idle-since
+    time.sleep(0.05)         # exceed the 30ms idle timeout
+    scaler.update()
+    assert len(scaler.workers()) == 0
+
+
+def test_busy_nodes_not_terminated():
+    provider, lm, scaler = _mk(min_workers=0, max_workers=4)
+    provider.create_node({}, {TAG_NODE_KIND: "worker"}, 1)
+    nid = scaler.workers()[0]
+    lm.update(nid, {"CPU": 2}, {"CPU": 0.5})  # busy
+    scaler.update()
+    time.sleep(0.05)
+    scaler.update()
+    assert len(scaler.workers()) == 1
+
+
+def test_utilization_pressure_scales_up():
+    provider, lm, scaler = _mk(max_workers=8,
+                               target_utilization_fraction=0.8)
+    lm.update("n0", {"CPU": 4}, {"CPU": 0})  # 100% used
+    lm.update("n1", {"CPU": 4}, {"CPU": 0})
+    scaler.update()
+    assert len(scaler.workers()) >= 1
+
+
+def test_bin_packing():
+    # 3x {CPU:2} demands, nodes of {CPU:4} -> 2 new nodes
+    n = get_nodes_to_launch([{"CPU": 2}] * 3, [], {"CPU": 4},
+                            max_new_nodes=10)
+    assert n == 2
+    # existing free capacity absorbs some
+    n = get_nodes_to_launch([{"CPU": 2}] * 3, [{"CPU": 4}], {"CPU": 4},
+                            max_new_nodes=10)
+    assert n == 1
+    # infeasible-on-any-node demands are skipped
+    n = get_nodes_to_launch([{"CPU": 64}], [], {"CPU": 4}, max_new_nodes=10)
+    assert n == 0
+    # max cap respected
+    n = get_nodes_to_launch([{"CPU": 4}] * 10, [], {"CPU": 4},
+                            max_new_nodes=3)
+    assert n == 3
+
+
+# ---------- monitor against a real mini-cluster ----------
+
+@pytest.mark.slow
+def test_monitor_with_real_cluster():
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.monitor import Monitor
+
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        provider = MockProvider()
+        mon = Monitor(cluster.address, provider,
+                      {"min_workers": 2, "max_workers": 4})
+        mon.update()
+        assert mon.load_metrics.num_nodes() >= 1
+        # min_workers drove mock launches
+        assert len(mon.autoscaler.workers()) == 2
+        mon.stop()
+    finally:
+        cluster.shutdown()
+
+
+# ---------- CLI ----------
+
+@pytest.mark.slow
+def test_cli_start_status_stop(tmp_path):
+    env = dict(**__import__("os").environ)
+    env["RAY_TPU_SESSION_FILE"] = str(tmp_path / "session.json")
+    base = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+
+    out = subprocess.run(
+        base + ["start", "--head", "--num-workers", "1",
+                "--resources", '{"CPU": 2}'],
+        capture_output=True, text=True, env=env, timeout=90)
+    assert out.returncode == 0, out.stderr
+    assert "started head" in out.stdout
+
+    out = subprocess.run(base + ["status"], capture_output=True, text=True,
+                         env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "alive" in out.stdout and "CPU" in out.stdout
+
+    out = subprocess.run(base + ["stop"], capture_output=True, text=True,
+                         env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "stopped" in out.stdout
