@@ -37,6 +37,8 @@ class Config:
     object_store_memory: int = 2 * 1024**3
     object_transfer_chunk_bytes: int = 1024 * 1024  # ref ray_config_def.h:242
     free_objects_batch_size: int = 100
+    # Owner-side refcount GC (reference: core_worker/reference_count.h:33)
+    ref_counting_enabled: bool = True
     # --- tasks / actors ---
     max_retries_default: int = 4  # ref doc/source/fault-tolerance.rst:12
     actor_max_restarts_default: int = 0

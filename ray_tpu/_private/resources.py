@@ -87,9 +87,12 @@ class ResourceSet:
         """Feasibility test: self (demand) fits in other (available).
 
         Exactly the reference's ``ResourceSet::IsSubset`` used in the placement
-        loop (``scheduling_policy.cc:75``), in fixed-point.
+        loop (``scheduling_policy.cc:75``), in fixed-point. Pure-python tuple
+        compare: this sits in the dispatch hot loop where a 4-wide numpy
+        ufunc launch costs more than the comparison itself.
         """
-        if (self.predefined > other.predefined).any():
+        a, b = self.key()[0], other.key()[0]
+        if (a[0] > b[0] or a[1] > b[1] or a[2] > b[2] or a[3] > b[3]):
             return False
         return all(other.custom.get(k, 0) >= v for k, v in self.custom.items())
 
@@ -141,10 +144,12 @@ class NodeResources:
         return True
 
     def release(self, demand: ResourceSet) -> None:
-        self.available = self.available.add(demand)
-        # Clamp: a release should never exceed total (defensive vs. double release).
-        np.minimum(self.available.predefined, self.total.predefined,
-                   out=self.available.predefined)
+        released = self.available.add(demand)
+        # Clamp: a release should never exceed total (defensive vs. double
+        # release). New object, not in-place: ResourceSet caches its key().
+        self.available = ResourceSet(
+            np.minimum(released.predefined, self.total.predefined),
+            released.custom)
 
     def __repr__(self):
         return f"NodeResources(total={self.total}, available={self.available})"

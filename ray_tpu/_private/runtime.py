@@ -30,7 +30,10 @@ import threading
 import time
 import traceback
 from collections import deque
+import logging
 from concurrent.futures import Future, ThreadPoolExecutor
+
+logger = logging.getLogger(__name__)
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..exceptions import (
@@ -48,6 +51,7 @@ from .serialization import get_context as get_serialization_context
 from .task_spec import TaskSpec, TaskType
 
 _LOCAL = threading.local()
+_ADMITTED = object()  # PendingTask.future sentinel: handed to the pool
 
 
 class WorkerContext:
@@ -216,6 +220,7 @@ class LocalActor:
     def _fail_spec(self, spec: TaskSpec, error: BaseException):
         for oid in spec.return_ids():
             self.runtime.store.put(oid, StoredObject(error=error))
+        self.runtime._unpin_args(spec.dependencies())
 
     # -- dispatch loop --------------------------------------------------------
     def _run(self):
@@ -353,10 +358,76 @@ class LocalActor:
         except BaseException as e:  # noqa: BLE001
             self.runtime._store_error(spec, TaskError(spec.function.repr_name, e))
         finally:
+            self.runtime._unpin_args(spec.dependencies())
             self.runtime.events.record(
                 "actor_task", spec.function.repr_name, t0, time.monotonic(),
                 actor_id=self.actor_id.hex(),
             )
+
+
+class _TaskPool:
+    """Growable thread pool with exact idle accounting.
+
+    stdlib ThreadPoolExecutor spawns a new thread on nearly every submit
+    (its idle check races with completions), which at 10k+ task rates melts
+    into thread-creation overhead. This pool spawns only when no worker is
+    actually idle — the same grow-on-demand policy as the reference's
+    WorkerPool (worker_pool.h:45) — and retires workers after an idle
+    timeout. max_threads stays high only as a deadlock backstop for tasks
+    that block on ray.get of sub-task results.
+    """
+
+    def __init__(self, max_threads: int = 4096, idle_timeout_s: float = 30.0,
+                 name: str = "task"):
+        self._max = max_threads
+        self._idle_timeout = idle_timeout_s
+        self._name = name
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._idle = 0
+        self._threads = 0
+        self._spawned_total = 0
+        self._shutdown = False
+
+    def submit(self, fn: Callable, *args) -> None:
+        with self._cv:
+            if self._shutdown:
+                return
+            self._q.append((fn, args))
+            if self._idle > 0:
+                self._cv.notify()
+            elif self._threads < self._max:
+                self._threads += 1
+                self._spawned_total += 1
+                threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"{self._name}-{self._spawned_total}").start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._shutdown:
+                    self._idle += 1
+                    signaled = self._cv.wait(timeout=self._idle_timeout)
+                    self._idle -= 1
+                    if not signaled and not self._q:
+                        self._threads -= 1  # idle timeout: retire
+                        return
+                if self._shutdown and not self._q:
+                    self._threads -= 1
+                    return
+                fn, args = self._q.popleft()
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 - never kill the worker
+                logger.exception("task pool fn raised")
+
+    def shutdown(self, wait: bool = False, cancel_futures: bool = True) -> None:
+        with self._cv:
+            self._shutdown = True
+            if cancel_futures:
+                self._q.clear()
+            self._cv.notify_all()
 
 
 class LocalRuntime:
@@ -375,25 +446,85 @@ class LocalRuntime:
 
         self._lock = threading.Lock()
         self._resource_cv = threading.Condition(self._lock)
-        self._ready: deque = deque()  # PendingTask, deps resolved, awaiting resources
+        # Ready tasks indexed by SchedulingClass (= ResourceSet.key()), the
+        # reference's ReadyQueue structure (scheduling_queue.h:123,148): one
+        # feasibility check admits/skips a whole class, and dispatch
+        # round-robins classes for fairness.
+        self._ready: Dict[Tuple, deque] = {}
         self._pending: Dict[TaskID, PendingTask] = {}
         self._actors: Dict[ActorID, LocalActor] = {}
         self._named_actors: Dict[str, ActorID] = {}
         self._actor_seq: Dict[ActorID, itertools.count] = {}
-        self._pool = ThreadPoolExecutor(max_workers=4096, thread_name_prefix="task")
+        self._pool = _TaskPool(max_threads=4096, name="task")
         # Counter namespace for user-thread contexts; starts high so it never
         # collides with the driver thread's own task counters.
         self._thread_scope_counter = itertools.count(1 << 31)
         self._shutdown = False
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0, "tasks_failed": 0}
 
+        # Reference counting (reference: core_worker/reference_count.h:33).
+        # Local python refs = live ObjectRef instances; pins = in-flight task
+        # arguments ("submitted task references"). An object is deleted when
+        # both hit zero. Owner-only model: everything is in-process, so the
+        # borrowed-ref WaitForRefRemoved protocol collapses away.
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._arg_pins: Dict[ObjectID, int] = {}
+
         _LOCAL.ctx = WorkerContext(self.job_id, self.driver_task_id)
+
+    # -------------------------------------------------------------- refcount
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+            if self._arg_pins.get(oid, 0) > 0:
+                return
+        if self.config.ref_counting_enabled:
+            self.store.delete([oid])
+
+    def _pin_args(self, oids) -> None:
+        with self._ref_lock:
+            for oid in oids:
+                self._arg_pins[oid] = self._arg_pins.get(oid, 0) + 1
+
+    def _unpin_args(self, oids) -> None:
+        to_delete = []
+        with self._ref_lock:
+            for oid in oids:
+                n = self._arg_pins.get(oid, 0) - 1
+                if n > 0:
+                    self._arg_pins[oid] = n
+                    continue
+                self._arg_pins.pop(oid, None)
+                if self._local_refs.get(oid, 0) == 0:
+                    to_delete.append(oid)
+        if to_delete and self.config.ref_counting_enabled:
+            self.store.delete(to_delete)
+
+    def reference_counts(self) -> Dict[str, Dict[str, int]]:
+        """Debug view (feeds the reference's `ray memory`-style accounting)."""
+        with self._ref_lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for oid, n in self._local_refs.items():
+                out.setdefault(oid.hex(), {})["local_refs"] = n
+            for oid, n in self._arg_pins.items():
+                out.setdefault(oid.hex(), {})["task_arg_pins"] = n
+            return out
 
     # ------------------------------------------------------------------ tasks
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         pending = PendingTask(spec, fn, retries_left=spec.max_retries)
         deps = spec.dependencies()
+        self._pin_args(deps)
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("runtime is shut down")
@@ -416,33 +547,33 @@ class LocalRuntime:
 
     def _enqueue_ready(self, pending: PendingTask):
         with self._lock:
-            self._ready.append(pending)
+            klass = pending.spec.resources.key()
+            dq = self._ready.get(klass)
+            if dq is None:
+                dq = self._ready[klass] = deque()
+            dq.append(pending)
         self._dispatch()
 
     def _dispatch(self):
         """Admit as many ready tasks as resources allow (ref DispatchTasks)."""
         to_run: List[PendingTask] = []
         with self._lock:
-            scanned = 0
-            # Scan (bounded) for feasible tasks; avoids head-of-line blocking by
-            # one large task, like the reference's per-class round robin.
-            while self._ready and scanned < 128:
-                n = len(self._ready)
-                admitted = False
-                for _ in range(n):
-                    p = self._ready.popleft()
+            for klass in list(self._ready.keys()):
+                dq = self._ready.get(klass)
+                while dq:
+                    p = dq[0]
                     if p.cancelled:
+                        dq.popleft()
                         continue
-                    if self.node.acquire(p.spec.resources):
-                        to_run.append(p)
-                        admitted = True
-                    else:
-                        self._ready.append(p)
-                        scanned += 1
-                if not admitted:
-                    break
+                    if not self.node.acquire(p.spec.resources):
+                        break  # class infeasible right now; try next class
+                    dq.popleft()
+                    to_run.append(p)
+                if not dq:
+                    del self._ready[klass]
         for p in to_run:
-            p.future = self._pool.submit(self._run_task, p)
+            p.future = _ADMITTED
+            self._pool.submit(self._run_task, p)
 
     def _run_task(self, pending: PendingTask):
         spec = pending.spec
@@ -474,6 +605,7 @@ class LocalRuntime:
             result = call(args, kwargs)
             self._store_returns(spec, result)
             self.stats["tasks_finished"] += 1
+            self._unpin_args(spec.dependencies())
         except BaseException as e:  # noqa: BLE001 - task errors are data
             # Retry semantics match the reference (task_manager.cc): only
             # *system* failures (worker crash / node death) consume
@@ -493,6 +625,7 @@ class LocalRuntime:
             else:
                 err = TaskError(spec.function.repr_name, e)
             self._store_error(spec, err)
+            self._unpin_args(spec.dependencies())
         finally:
             self.events.record(
                 "task", spec.function.repr_name, t0, time.monotonic(),
@@ -548,6 +681,7 @@ class LocalRuntime:
         oids = spec.return_ids()
         if len(oids) == 1:
             self.store.put(oids[0], StoredObject(value=result, nbytes=_sizeof(result)))
+            self._gc_if_unreferenced(spec, oids)
             return
         if not isinstance(result, tuple) or len(result) != len(oids):
             raise ValueError(
@@ -556,10 +690,27 @@ class LocalRuntime:
             )
         for oid, value in zip(oids, result):
             self.store.put(oid, StoredObject(value=value, nbytes=_sizeof(value)))
+        self._gc_if_unreferenced(spec, oids)
 
     def _store_error(self, spec: TaskSpec, error: BaseException):
-        for oid in spec.return_ids():
+        oids = spec.return_ids()
+        for oid in oids:
             self.store.put(oid, StoredObject(error=error))
+        self._gc_if_unreferenced(spec, oids)
+
+    def _gc_if_unreferenced(self, spec: TaskSpec, oids) -> None:
+        """Free return objects whose refs all died before the task finished
+        (the reference's owner deletes such returns on completion too)."""
+        if not self.config.ref_counting_enabled or spec.is_actor_creation:
+            return  # creation markers have no user-visible ObjectRef
+        dead = []
+        with self._ref_lock:
+            for oid in oids:
+                if (self._local_refs.get(oid, 0) == 0
+                        and self._arg_pins.get(oid, 0) == 0):
+                    dead.append(oid)
+        if dead:
+            self.store.delete(dead)
 
     # ----------------------------------------------------------------- actors
     def _release_actor_resources(self, actor: "LocalActor"):
@@ -602,12 +753,14 @@ class LocalRuntime:
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        self._pin_args(spec.dependencies())
         with self._lock:
             actor = self._actors.get(spec.actor_id)
             seq = self._actor_seq.get(spec.actor_id)
         if actor is None:
             for oid in spec.return_ids():
                 self.store.put(oid, StoredObject(error=ActorDiedError(spec.actor_id)))
+            self._unpin_args(spec.dependencies())
             return refs
         actor.submit(next(seq), spec)
         return refs
@@ -714,6 +867,7 @@ class LocalRuntime:
                 pending.cancelled = True
         if pending is not None and pending.future is None:
             self._store_error(pending.spec, TaskCancelledError(task_id))
+            self._unpin_args(pending.spec.dependencies())
 
     # ------------------------------------------------------------------ state
     def cluster_resources(self) -> Dict[str, float]:

@@ -14,11 +14,29 @@ from ._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("id", "_owner")
+    __slots__ = ("id", "_owner", "_counted_core")
 
     def __init__(self, object_id: ObjectID, owner: Optional[bytes] = None):
         self.id = object_id
         self._owner = owner
+        # Register with the owner's reference counter so the object can be
+        # freed when the last handle dies (reference: reference_count.h:33
+        # AddLocalReference in the ObjectRef ctor path).
+        self._counted_core = None
+        from ._private.worker import global_worker
+
+        worker = global_worker()
+        if worker.connected and hasattr(worker.core, "add_local_ref"):
+            worker.core.add_local_ref(self.id)
+            self._counted_core = worker.core
+
+    def __del__(self):
+        core = self._counted_core
+        if core is not None:
+            try:
+                core.remove_local_ref(self.id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
 
     def binary(self) -> bytes:
         return self.id.binary()
