@@ -180,17 +180,34 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     worker = global_worker()
     worker.check_connected()
     events = []
-    for kind, name, start, end, extra in list(worker.core.events.events):
-        events.append({
-            "cat": kind,
-            "name": name,
-            "ph": "X",
-            "ts": start * 1e6,
-            "dur": (end - start) * 1e6,
-            "pid": extra.get("actor_id", "driver"),
-            "tid": extra.get("task_id", "0"),
-            "args": extra,
-        })
+    if hasattr(worker.core, "cluster_profile_events"):
+        # Cluster mode: all spans (driver's included — flushed here) live in
+        # the GCS profile table (reference: state.py chrome_tracing_dump
+        # reads GCS-side profile events the same way).
+        worker.core.flush_events()
+        for ev in worker.core.cluster_profile_events():
+            events.append({
+                "cat": ev["cat"],
+                "name": ev["name"],
+                "ph": "X",
+                "ts": ev["start"] * 1e6,
+                "dur": (ev["end"] - ev["start"]) * 1e6,
+                "pid": ev["extra"].get("actor_id", ev.get("origin", "worker")),
+                "tid": ev["extra"].get("task_id", "0"),
+                "args": ev["extra"],
+            })
+    else:
+        for kind, name, start, end, extra in list(worker.core.events.events):
+            events.append({
+                "cat": kind,
+                "name": name,
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": (end - start) * 1e6,
+                "pid": extra.get("actor_id", "driver"),
+                "tid": extra.get("task_id", "0"),
+                "args": extra,
+            })
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

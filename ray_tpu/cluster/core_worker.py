@@ -408,7 +408,35 @@ class ClusterCoreWorker:
             for aid, info in raw.items()
         }
 
+    def flush_events(self) -> int:
+        """Push locally recorded profile spans to the GCS profile table
+        (reference: core_worker/profiling.cc batched flush). Returns count."""
+        batch = []
+        while self.events.events:
+            try:
+                kind, name, start, end, extra = self.events.events.popleft()
+            except IndexError:
+                break
+            batch.append({
+                "cat": kind, "name": name, "start": start, "end": end,
+                "extra": {k: v for k, v in extra.items()
+                          if isinstance(v, (str, int, float, bool))},
+                "origin": self.role,
+            })
+            if len(batch) >= 10_000:
+                break
+        if batch:
+            try:
+                self.gcs.call({"type": "add_profile_data", "events": batch})
+            except (ConnectionError, OSError):
+                return 0
+        return len(batch)
+
+    def cluster_profile_events(self):
+        return self.gcs.call({"type": "get_profile_data"})["events"]
+
     def shutdown(self):
+        self.flush_events()
         for client in self._controllers.values():
             client.close()
         self.gcs.close()

@@ -55,6 +55,9 @@ class GcsServer:
         # placement queue: (demand ResourceSet, locality node_id|None, future)
         self._pending_place: List[Tuple[ResourceSet, Optional[str], asyncio.Future]] = []
         self._unplaceable: Dict[Any, Dict[str, float]] = {}  # autoscaler feed
+        from collections import deque as _deque
+
+        self.profile_events: Any = _deque(maxlen=200_000)  # chrome-trace spans
         self._place_event = asyncio.Event()
         self._seed = 0
         self._tasks: List[asyncio.Task] = []
@@ -426,6 +429,17 @@ class GcsServer:
             if blob is None:
                 return {"ok": False, "error": "unknown function"}
             return {"ok": True, "blob": blob}
+
+        @s.handler("add_profile_data")
+        async def add_profile_data(msg, conn):
+            # Batched span flush from a worker/driver (reference:
+            # StatsGcsService.AddProfileData, gcs_service.proto:394).
+            self.profile_events.extend(msg["events"])
+            return {"ok": True}
+
+        @s.handler("get_profile_data")
+        async def get_profile_data(msg, conn):
+            return {"ok": True, "events": list(self.profile_events)}
 
         @s.handler("list_objects")
         async def list_objects(msg, conn):

@@ -67,6 +67,21 @@ def main():
 
     controller.call({"type": "register_worker", "pid": os.getpid()})
 
+    # Periodic profile-span flush to the GCS (reference: profiling.cc's
+    # batched AddProfileData timer).
+    def flush_loop():
+        import time as _time
+
+        while True:
+            _time.sleep(2.0)
+            try:
+                core.flush_events()
+            except Exception:  # noqa: BLE001 - shutdown race
+                return
+
+    threading.Thread(target=flush_loop, daemon=True,
+                     name="profile-flush").start()
+
     ser = get_context()
     fn_cache: Dict[bytes, Any] = {}
     actor_instance: Optional[Any] = None

@@ -1,0 +1,1 @@
+from .dashboard import Dashboard, start_dashboard  # noqa: F401

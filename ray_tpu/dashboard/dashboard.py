@@ -1,0 +1,133 @@
+"""Dashboard-lite (reference: python/ray/dashboard/ — aiohttp + JS client,
+here a stdlib HTTP server + a single self-contained HTML page).
+
+JSON API: /api/nodes /api/actors /api/objects /api/resources /api/tasks
+HTML: / renders the same data with auto-refresh.
+
+Works against whatever runtime the driver is connected to (local or cluster):
+data comes from the same state accessors as ``ray_tpu.state``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
+h1 { color: #7fc; } h2 { color: #9cf; margin-top: 1.2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #444; padding: 4px 10px; text-align: left; }
+th { background: #222; }
+.num { text-align: right; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+async function refresh() {
+  const [nodes, actors, objects, resources, tasks] = await Promise.all(
+    ["nodes","actors","objects","resources","tasks"].map(
+      p => fetch("/api/" + p).then(r => r.json())));
+  let h = "<h2>resources</h2><table><tr><th>kind</th><th>total</th><th>available</th></tr>";
+  for (const k of Object.keys(resources.total))
+    h += `<tr><td>${k}</td><td class=num>${resources.total[k]}</td>` +
+         `<td class=num>${resources.available[k] ?? 0}</td></tr>`;
+  h += "</table><h2>tasks</h2><table><tr><th>submitted</th><th>finished</th><th>failed</th></tr>" +
+       `<tr><td class=num>${tasks.tasks_submitted ?? "-"}</td>` +
+       `<td class=num>${tasks.tasks_finished ?? "-"}</td>` +
+       `<td class=num>${tasks.tasks_failed ?? "-"}</td></tr></table>`;
+  h += "<h2>nodes</h2><table><tr><th>id</th><th>alive</th><th>resources</th></tr>";
+  for (const n of nodes)
+    h += `<tr><td>${(n.NodeID||"").slice(0,12)}</td><td>${n.Alive}</td>` +
+         `<td>${JSON.stringify(n.Resources)}</td></tr>`;
+  h += "</table><h2>actors</h2><table><tr><th>id</th><th>state</th><th>name</th></tr>";
+  for (const [id, a] of Object.entries(actors))
+    h += `<tr><td>${id.slice(0,12)}</td><td>${a.State||a.state}</td>` +
+         `<td>${a.Name||a.name||""}</td></tr>`;
+  h += `</table><h2>objects (${Object.keys(objects).length})</h2>` +
+       "<table><tr><th>id</th><th>bytes</th><th>error</th></tr>";
+  for (const [id, o] of Object.entries(objects).slice(0, 50))
+    h += `<tr><td>${id.slice(0,16)}</td><td class=num>${o.size_bytes ?? o.size}</td>` +
+         `<td>${o.has_error ?? ""}</td></tr>`;
+  h += "</table>";
+  document.getElementById("content").innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+def _collect(endpoint: str):
+    from .. import state
+    from .._private.worker import global_worker
+
+    if endpoint == "nodes":
+        return state.nodes()
+    if endpoint == "actors":
+        return state.actors()
+    if endpoint == "objects":
+        return state.objects()
+    if endpoint == "resources":
+        return {"total": state.cluster_resources(),
+                "available": state.available_resources()}
+    if endpoint == "tasks":
+        core = global_worker().core
+        return dict(getattr(core, "stats", {}) or {})
+    raise KeyError(endpoint)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path in ("/", "/index.html"):
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                elif path.startswith("/api/"):
+                    try:
+                        body = json.dumps(_collect(path[5:])).encode()
+                        ctype = "application/json"
+                    except KeyError:
+                        self.send_error(404)
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        body = json.dumps({"error": str(e)}).encode()
+                        ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, name="dashboard", daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    return Dashboard(host, port)

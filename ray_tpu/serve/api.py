@@ -32,8 +32,10 @@ def init(http_host: Optional[str] = None,
     try:
         _master = ray_tpu.get_actor(MASTER_NAME)
     except Exception:
-        _master = ray_tpu.remote(num_cpus=0)(ServeMaster).options(
-            name=MASTER_NAME).remote(http_host, http_port)
+        # Infinite restarts: a crashed control plane recovers from its
+        # checkpoint (load_checkpoint) while replicas keep serving.
+        _master = ray_tpu.remote(num_cpus=0, max_restarts=-1)(
+            ServeMaster).options(name=MASTER_NAME).remote(http_host, http_port)
         # Force construction so later calls can't race a half-built master.
         ray_tpu.get(_master.get_router.remote())
 

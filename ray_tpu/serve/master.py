@@ -18,13 +18,24 @@ from .router import Router
 
 MASTER_NAME = "__serve_master__"
 ROUTER_NAME = "__serve_router__"
+PROXY_NAME = "__serve_http_proxy__"
 
 
-class ServeMaster:
+class ServeMaster(ray_tpu.Checkpointable):
+    """Control plane. Checkpointable + restartable: the master is created
+    with max_restarts=-1; after a crash-restart it reattaches to the (still
+    live) router/proxy/replica actors and restores its registry from the
+    newest checkpoint (reference: master.py writes the same state to a
+    GCS-backed kv_store for exactly this recovery)."""
+
     def __init__(self, http_host: Optional[str] = None,
                  http_port: Optional[int] = None):
-        self.router = ray_tpu.remote(num_cpus=0)(Router).options(
-            name=ROUTER_NAME).remote()
+        # Idempotent child creation: on restart the named actors exist.
+        try:
+            self.router = ray_tpu.get_actor(ROUTER_NAME)
+        except Exception:
+            self.router = ray_tpu.remote(num_cpus=0)(Router).options(
+                name=ROUTER_NAME).remote()
         # endpoint -> {"route": str|None, "methods": [..]}
         self.endpoints: Dict[str, Dict[str, Any]] = {}
         # backend -> {"config": dict, "func_or_class": obj, "init_args": tuple}
@@ -35,9 +46,44 @@ class ServeMaster:
         if http_port is not None:
             from .http_proxy import HTTPProxyActor
 
-            self.http_proxy = ray_tpu.remote(num_cpus=0)(HTTPProxyActor).remote(
-                http_host or "127.0.0.1", http_port)
+            try:
+                self.http_proxy = ray_tpu.get_actor(PROXY_NAME)
+            except Exception:
+                self.http_proxy = ray_tpu.remote(num_cpus=0)(
+                    HTTPProxyActor).options(name=PROXY_NAME).remote(
+                        http_host or "127.0.0.1", http_port)
             ray_tpu.get(self.http_proxy.ready.remote())
+
+    # ---- crash recovery (Checkpointable contract) ----
+
+    def save_checkpoint(self):
+        return {
+            "endpoints": {k: dict(v) for k, v in self.endpoints.items()},
+            "backends": {
+                tag: {"config": e["config"].to_dict(),
+                      "func_or_class": e["func_or_class"],
+                      "init_args": e["init_args"]}
+                for tag, e in self.backends.items()
+            },
+            "replicas": {k: list(v) for k, v in self.replicas.items()},
+            "traffic": {k: dict(v) for k, v in self.traffic.items()},
+        }
+
+    def load_checkpoint(self, checkpoint) -> None:
+        self.endpoints = checkpoint["endpoints"]
+        self.backends = {
+            tag: {"config": BackendConfig.from_dict(e["config"]),
+                  "func_or_class": e["func_or_class"],
+                  "init_args": e["init_args"]}
+            for tag, e in checkpoint["backends"].items()
+        }
+        self.replicas = checkpoint["replicas"]
+        self.traffic = checkpoint["traffic"]
+        # Reconcile the data plane with restored intent.
+        for tag in self.backends:
+            self._sync_router(tag)
+        for ep, traffic in self.traffic.items():
+            ray_tpu.get(self.router.set_traffic.remote(ep, traffic))
 
     def get_router(self):
         return [self.router]
