@@ -23,7 +23,12 @@ _cache: dict = {}
 def _needs_build(src: str, out: str) -> bool:
     if not os.path.exists(out):
         return True
-    return os.path.getmtime(src) > os.path.getmtime(out)
+    # Sources #include each other (transfer.cc pulls in shm_store.cc), so
+    # any newer .cc in the dir invalidates the build.
+    newest = max(
+        os.path.getmtime(os.path.join(_SRC_DIR, f))
+        for f in os.listdir(_SRC_DIR) if f.endswith(".cc"))
+    return newest > os.path.getmtime(out)
 
 
 def load_native_library(name: str) -> Optional[ctypes.CDLL]:

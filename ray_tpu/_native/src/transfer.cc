@@ -1,0 +1,348 @@
+// Object-transfer data plane — the ObjectManager equivalent.
+//
+// Reference counterpart: src/ray/object_manager/ (object_manager.cc chunked
+// Push/Pull over a dedicated gRPC service, object_buffer_pool). Re-designed
+// for this runtime: a per-node TCP server thread that streams object bytes
+// STRAIGHT OUT OF the shared-memory arena (no copy into Python, no pickle
+// framing), and a client that receives STRAIGHT INTO a newly created arena
+// slot on the destination node. The Python control plane only exchanges
+// object locations; bulk bytes never cross the GIL.
+//
+// Wire protocol (all little-endian):
+//   GET : c->s [op=1:1][id:24]            s->c [status:1][size:8][payload]
+//   PUT : c->s [op=2:1][id:24][size:8][payload]   s->c [status:1]
+// A connection handles sequential requests until EOF.
+
+#include "shm_store.cc"  // same TU: Handle layout + tps_* internals
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+
+namespace {
+
+constexpr uint8_t kOpGet = 1;
+constexpr uint8_t kOpPut = 2;
+constexpr int kChunk = 1 << 20;  // 1MB send granularity (ref ray_config_def.h:242)
+
+bool send_all(int fd, const uint8_t* buf, uint64_t n) {
+  while (n > 0) {
+    ssize_t w = send(fd, buf, n > kChunk ? kChunk : n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    buf += w;
+    n -= static_cast<uint64_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, uint8_t* buf, uint64_t n) {
+  while (n > 0) {
+    ssize_t r = recv(fd, buf, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    buf += r;
+    n -= static_cast<uint64_t>(r);
+  }
+  return true;
+}
+
+struct ServerCtx {
+  void* store;
+  int listen_fd;
+  int port;
+  pthread_t thread;
+  std::atomic<bool> stop{false};
+};
+
+struct ConnArgs {
+  ServerCtx* ctx;
+  int fd;
+};
+
+void handle_get(ServerCtx* ctx, int fd, const uint8_t* id) {
+  uint64_t off = 0, size = 0;
+  int rc = tps_get(ctx->store, id, &off, &size);
+  uint8_t status = rc == kOk ? 0 : 1;
+  uint8_t head[9];
+  head[0] = status;
+  uint64_t sz = rc == kOk ? size : 0;
+  std::memcpy(head + 1, &sz, 8);
+  if (!send_all(fd, head, 9)) {
+    if (rc == kOk) tps_release(ctx->store, id);
+    return;
+  }
+  if (rc == kOk) {
+    auto* h = static_cast<Handle*>(ctx->store);
+    send_all(fd, h->base + off, size);  // zero-copy out of the arena
+    tps_release(ctx->store, id);
+  }
+}
+
+void handle_put(ServerCtx* ctx, int fd, const uint8_t* id) {
+  uint64_t size = 0;
+  if (!recv_all(fd, reinterpret_cast<uint8_t*>(&size), 8)) return;
+  uint64_t off = 0;
+  int rc = tps_create_obj(ctx->store, id, size, &off);
+  uint8_t status;
+  if (rc == kOk) {
+    auto* h = static_cast<Handle*>(ctx->store);
+    if (recv_all(fd, h->base + off, size)) {  // straight into the arena
+      tps_seal(ctx->store, id);
+      status = 0;
+    } else {
+      tps_abort(ctx->store, id);
+      return;  // connection broken anyway
+    }
+  } else if (rc == kAlreadyExists) {
+    // Idempotent: drain payload, report success (objects are immutable).
+    uint8_t sink[4096];
+    uint64_t left = size;
+    while (left > 0) {
+      uint64_t take = left > sizeof(sink) ? sizeof(sink) : left;
+      if (!recv_all(fd, sink, take)) return;
+      left -= take;
+    }
+    status = 0;
+  } else {
+    status = 2;  // OOM etc; sender sees failure, payload abandoned
+  }
+  send_all(fd, &status, 1);
+}
+
+void* conn_loop(void* arg) {
+  auto* ca = static_cast<ConnArgs*>(arg);
+  int one = 1;
+  setsockopt(ca->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint8_t req[1 + kIdLen];
+  while (!ca->ctx->stop.load(std::memory_order_relaxed)) {
+    if (!recv_all(ca->fd, req, sizeof(req))) break;
+    if (req[0] == kOpGet) {
+      handle_get(ca->ctx, ca->fd, req + 1);
+    } else if (req[0] == kOpPut) {
+      handle_put(ca->ctx, ca->fd, req + 1);
+    } else {
+      break;
+    }
+  }
+  close(ca->fd);
+  delete ca;
+  return nullptr;
+}
+
+void* accept_loop(void* arg) {
+  auto* ctx = static_cast<ServerCtx*>(arg);
+  while (!ctx->stop.load(std::memory_order_relaxed)) {
+    int fd = accept(ctx->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listen socket closed by tts_serve_stop
+    }
+    auto* ca = new ConnArgs{ctx, fd};
+    pthread_t t;
+    if (pthread_create(&t, nullptr, conn_loop, ca) == 0) {
+      pthread_detach(t);
+    } else {
+      close(fd);
+      delete ca;
+    }
+  }
+  return nullptr;
+}
+
+int connect_to(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts the transfer server for an open store handle. port=0 picks a free
+// port. Returns a ServerCtx* (opaque) or null.
+void* tts_serve_start(void* store_handle, int port) {
+  if (store_handle == nullptr) return nullptr;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* ctx = new ServerCtx();
+  ctx->store = store_handle;
+  ctx->listen_fd = fd;
+  ctx->port = ntohs(addr.sin_port);
+  if (pthread_create(&ctx->thread, nullptr, accept_loop, ctx) != 0) {
+    close(fd);
+    delete ctx;
+    return nullptr;
+  }
+  return ctx;
+}
+
+int tts_serve_port(void* sctx) {
+  return sctx ? static_cast<ServerCtx*>(sctx)->port : -1;
+}
+
+void tts_serve_stop(void* sctx) {
+  if (sctx == nullptr) return;
+  auto* ctx = static_cast<ServerCtx*>(sctx);
+  ctx->stop.store(true);
+  shutdown(ctx->listen_fd, SHUT_RDWR);
+  close(ctx->listen_fd);
+  pthread_join(ctx->thread, nullptr);
+  delete ctx;
+}
+
+// Opens a persistent data-plane connection (the server handles sequential
+// requests per connection). Returns fd >= 0 or -1.
+int tts_connect(const char* host, int port) { return connect_to(host, port); }
+
+void tts_disconnect(int fd) {
+  if (fd >= 0) close(fd);
+}
+
+// Fetch over an existing connection. Same return codes as tts_fetch, plus
+// -5 = connection broken (caller should reconnect).
+int tts_fetch_fd(int fd, const uint8_t* id, void* store_handle) {
+  if (tps_contains(store_handle, id) == 1) return 0;
+  uint8_t req[1 + kIdLen];
+  req[0] = kOpGet;
+  std::memcpy(req + 1, id, kIdLen);
+  uint8_t head[9];
+  if (!send_all(fd, req, sizeof(req)) || !recv_all(fd, head, 9)) return -5;
+  uint64_t size;
+  std::memcpy(&size, head + 1, 8);
+  if (head[0] != 0) return -1;
+  uint64_t off = 0;
+  int rc = tps_create_obj(store_handle, id, size, &off);
+  if (rc == kAlreadyExists || rc != kOk) {
+    // raced another fetcher / local store full: must still drain the stream
+    // to keep the connection request-aligned.
+    uint8_t sink[65536];
+    uint64_t left = size;
+    while (left > 0) {
+      uint64_t take = left > sizeof(sink) ? sizeof(sink) : left;
+      if (!recv_all(fd, sink, take)) return -5;
+      left -= take;
+    }
+    return rc == kAlreadyExists ? 0 : -3;
+  }
+  auto* h = static_cast<Handle*>(store_handle);
+  if (!recv_all(fd, h->base + off, size)) {
+    tps_abort(store_handle, id);
+    return -5;
+  }
+  tps_seal(store_handle, id);
+  return 0;
+}
+
+// Fetches object `id` from host:port directly into the local arena.
+// Returns 0 on success, -1 remote miss, -2 connect failure, -3 local store
+// full, -4 protocol error, -5 connection broken. Safe to call concurrently.
+int tts_fetch(const char* host, int port, const uint8_t* id,
+              void* store_handle) {
+  if (tps_contains(store_handle, id) == 1) return 0;
+  int fd = connect_to(host, port);
+  if (fd < 0) return -2;
+  int result = tts_fetch_fd(fd, id, store_handle);
+  close(fd);
+  return result;
+}
+
+// Fetches into a malloc'd buffer (for processes with no local arena).
+// On success returns size (>=0) and sets *out (caller frees via
+// tts_buf_free); negative = error codes as tts_fetch.
+int64_t tts_fetch_buf(const char* host, int port, const uint8_t* id,
+                      uint8_t** out) {
+  *out = nullptr;
+  int fd = connect_to(host, port);
+  if (fd < 0) return -2;
+  uint8_t req[1 + kIdLen];
+  req[0] = kOpGet;
+  std::memcpy(req + 1, id, kIdLen);
+  uint8_t head[9];
+  int64_t result = -4;
+  if (send_all(fd, req, sizeof(req)) && recv_all(fd, head, 9)) {
+    uint64_t size;
+    std::memcpy(&size, head + 1, 8);
+    if (head[0] != 0) {
+      result = -1;
+    } else {
+      auto* buf = static_cast<uint8_t*>(malloc(size ? size : 1));
+      if (buf == nullptr) {
+        result = -3;
+      } else if (recv_all(fd, buf, size)) {
+        *out = buf;
+        result = static_cast<int64_t>(size);
+      } else {
+        free(buf);
+        result = -4;
+      }
+    }
+  }
+  close(fd);
+  return result;
+}
+
+void tts_buf_free(uint8_t* p) { free(p); }
+
+// Pushes a local arena object to a remote node (the reference's Push path).
+// Returns 0 ok, -1 not local, -2 connect failure, -4 protocol/remote error.
+int tts_push(const char* host, int port, const uint8_t* id,
+             void* store_handle) {
+  uint64_t off = 0, size = 0;
+  if (tps_get(store_handle, id, &off, &size) != kOk) return -1;
+  int result = -4;
+  int fd = connect_to(host, port);
+  if (fd >= 0) {
+    uint8_t req[1 + kIdLen + 8];
+    req[0] = kOpPut;
+    std::memcpy(req + 1, id, kIdLen);
+    std::memcpy(req + 1 + kIdLen, &size, 8);
+    auto* h = static_cast<Handle*>(store_handle);
+    uint8_t status = 1;
+    if (send_all(fd, req, sizeof(req)) &&
+        send_all(fd, h->base + off, size) && recv_all(fd, &status, 1) &&
+        status == 0) {
+      result = 0;
+    }
+    close(fd);
+  } else {
+    result = -2;
+  }
+  tps_release(store_handle, id);
+  return result;
+}
+
+}  // extern "C"

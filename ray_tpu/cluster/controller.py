@@ -63,6 +63,19 @@ class NodeController:
         self.store_name = f"rtps-{self.node_id[:12]}"
         self.store = create_store(self.store_name, config.object_store_memory)
         self._overflow: Dict[bytes, bytes] = {}  # blobs too big for the arena
+        # Native data plane (reference: ObjectManager's dedicated transfer
+        # service): a C++ thread streaming arena bytes peer-to-peer. Absent
+        # (port 0) when the arena fell back to the Python store.
+        self.transfer_server = None
+        self.transfer_port = 0
+        try:
+            from .._native.transfer import TransferServer
+
+            self.transfer_server = TransferServer(self.store_name)
+            self.transfer_port = self.transfer_server.port
+        except Exception:  # noqa: BLE001 - python-store fallback path
+            self.transfer_server = None
+            self.transfer_port = 0
         # The arena outlives SIGKILL'd processes (/dev/shm persists); make
         # every normal exit path unlink it, even when stop() never runs
         # (e.g. the head's colocated controller thread dying with the
@@ -102,6 +115,7 @@ class NodeController:
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
             "store_name": self.store_name,
+            "transfer_port": self.transfer_port,
         })
         for _ in range(self.num_workers):
             self._spawn_worker()
@@ -119,6 +133,8 @@ class NodeController:
         await self.server.stop()
         if self._gcs:
             self._gcs.close()
+        if self.transfer_server is not None:
+            self.transfer_server.stop()
         self.store.close()
 
     def _spawn_worker(self) -> WorkerHandle:
@@ -213,6 +229,26 @@ class NodeController:
             blob = self._overflow.get(oid)
         return blob
 
+    def _transfer_client(self):
+        """Lazy native data-plane client bound to this node's arena."""
+        if getattr(self, "_transfer_cli", None) is None:
+            if self.transfer_server is None:
+                self._transfer_cli = None
+                return None
+            try:
+                from .._native.transfer import TransferClient
+
+                self._transfer_cli = TransferClient(self.store_name)
+            except Exception:  # noqa: BLE001
+                self._transfer_cli = None
+        return self._transfer_cli
+
+    def _announce_blob(self, oid: bytes) -> None:
+        """Register a blob that landed in the arena via the native plane."""
+        blob = self.store.get_bytes(oid)
+        if blob is not None:
+            self._register_object(oid, len(blob))
+
     async def _store_get(self, oid: bytes, timeout: float = 60.0) -> bytes:
         """Local get; fetches from a remote node if needed (Pull path)."""
         blob = self._local_blob(oid)
@@ -227,10 +263,23 @@ class NodeController:
             blob = self._local_blob(oid)
             if blob is not None:
                 return blob
-            for addr in resp.get("addresses", []):
+            transfer = resp.get("transfer_addresses", [])
+            for i, addr in enumerate(resp.get("addresses", [])):
                 addr = tuple(addr)
                 if addr == self.address:
                     continue
+                # Fast path: native data plane straight into our arena
+                # (bytes never enter Python). Fall back to RPC on any miss.
+                taddr = transfer[i] if i < len(transfer) else None
+                if (taddr and taddr[1] and self._transfer_client() is not None):
+                    ok = await asyncio.to_thread(
+                        self._transfer_client().fetch_into_store,
+                        taddr[0], int(taddr[1]), oid)
+                    if ok:
+                        blob = self._local_blob(oid)
+                        if blob is not None:
+                            self._announce_blob(oid)
+                            return blob
                 try:
                     peer = self._peer(addr)
                     fetched = await asyncio.to_thread(

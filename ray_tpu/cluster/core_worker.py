@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import os
 import pickle
 import threading
 import time
@@ -63,6 +64,8 @@ class ClusterCoreWorker:
         # it from their controller's env; drivers attach lazily — shm
         # existence doubles as the same-host check).
         self.local_store = None
+        self._transfer_cli: Any = None  # None=unprobed, False=unavailable
+        self._transfer_has_store = False
 
     # ---------------------------------------------------------------- helpers
     def _controller(self, addr: Tuple[str, int]) -> RpcClient:
@@ -300,6 +303,39 @@ class ClusterCoreWorker:
         self.put_blob(oid.binary(), blob)
         return ObjectRef(oid)
 
+    def _transfer_client(self):
+        """Lazy native data-plane client (reference: object manager Pull).
+        Bound to this host's arena when one is attached, else buffer mode."""
+        if self._transfer_cli is False:  # probed and unavailable
+            return None
+        if self._transfer_cli is None:
+            try:
+                from .._native.transfer import TransferClient
+
+                store_name = os.environ.get("RAY_TPU_STORE_NAME") or None
+                if store_name is None and self.local_store is not None:
+                    store_name = getattr(self.local_store, "name", None)
+                self._transfer_cli = TransferClient(store_name)
+                self._transfer_has_store = store_name is not None
+            except Exception:  # noqa: BLE001
+                self._transfer_cli = False
+                return None
+        return self._transfer_cli
+
+    def _native_fetch(self, taddr, oid: bytes) -> Optional[bytes]:
+        cli = self._transfer_client()
+        if cli is None or not taddr or not taddr[1]:
+            return None
+        host, port = taddr[0], int(taddr[1])
+        try:
+            if self._transfer_has_store and self.local_store is not None:
+                if cli.fetch_into_store(host, port, oid):
+                    return self.local_store.get_bytes(oid)
+                return None
+            return cli.fetch_bytes(host, port, oid)
+        except Exception:  # noqa: BLE001
+            return None
+
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
         if self.local_store is not None:
             blob = self.local_store.get_bytes(oid)
@@ -317,7 +353,15 @@ class ClusterCoreWorker:
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": step,
             }, timeout=step + 30.0)
-            for addr in resp.get("addresses", []):
+            transfer = resp.get("transfer_addresses", [])
+            for i, addr in enumerate(resp.get("addresses", [])):
+                # Native plane first: bulk bytes move C-to-C, GIL released.
+                blob = self._native_fetch(
+                    transfer[i] if i < len(transfer) else None, oid)
+                if blob is not None:
+                    if not self._transfer_has_store:
+                        self._cache_blob(oid, blob)
+                    return blob
                 try:
                     fetched = self._controller(tuple(addr)).call(
                         {"type": "fetch_object", "object_id": oid}

@@ -24,11 +24,11 @@ from .protocol import Connection, RpcServer
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
-                 "alive", "index", "store_name")
+                 "alive", "index", "store_name", "transfer_port")
 
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float], index: int,
-                 store_name: str = ""):
+                 store_name: str = "", transfer_port: int = 0):
         self.node_id = node_id
         self.address = address
         self.resources = resources
@@ -37,6 +37,7 @@ class NodeEntry:
         self.alive = True
         self.index = index
         self.store_name = store_name
+        self.transfer_port = transfer_port
 
 
 class GcsServer:
@@ -244,7 +245,8 @@ class GcsServer:
             node_id = msg["node_id"]
             entry = NodeEntry(node_id, tuple(msg["address"]), msg["resources"],
                               index=len(self._node_order),
-                              store_name=msg.get("store_name", ""))
+                              store_name=msg.get("store_name", ""),
+                              transfer_port=msg.get("transfer_port", 0))
             self.nodes[node_id] = entry
             self._node_order.append(node_id)
             conn.meta["node_id"] = node_id
@@ -275,7 +277,8 @@ class GcsServer:
             return {"ok": True, "nodes": [
                 {"NodeID": n.node_id, "Alive": n.alive,
                  "Resources": n.resources, "Available": n.available,
-                 "Address": n.address, "StoreName": n.store_name}
+                 "Address": n.address, "StoreName": n.store_name,
+                 "TransferPort": n.transfer_port}
                 for n in self.nodes.values()
             ]}
 
@@ -340,9 +343,17 @@ class GcsServer:
                         return {"ok": True, "locations": [], "addresses": []}
                     entry = self.objects.get(oid)
                 locations = sorted(entry["locations"]) if entry else []
-                addrs = [list(self.nodes[n].address) for n in locations
+                alive = [n for n in locations
                          if n in self.nodes and self.nodes[n].alive]
-                return {"ok": True, "locations": locations, "addresses": addrs}
+                addrs = [list(self.nodes[n].address) for n in alive]
+                # Parallel list: the native data-plane endpoint per location
+                # ([host, transfer_port]; port 0 = no native plane there).
+                transfer = [
+                    [self.nodes[n].address[0], self.nodes[n].transfer_port]
+                    for n in alive
+                ]
+                return {"ok": True, "locations": locations,
+                        "addresses": addrs, "transfer_addresses": transfer}
 
             self._detach(msg, conn, work())
             return None
