@@ -9,6 +9,7 @@ runs in C with the GIL released — Python only initiates transfers.
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Optional, Tuple
 
 from .build import load_native_library
@@ -84,10 +85,21 @@ class TransferClient:
         self._lib = lib
         self._handle = None
         self._conns: dict = {}  # (host, port) -> fd, persistent
+        # One request/response in flight per connection: concurrent fetches
+        # to the same peer must not interleave on one socket.
+        self._conn_locks: dict = {}
+        self._meta_lock = threading.Lock()
         if store_name:
             self._handle = lib.tps_open(store_name.encode())
             if not self._handle:
                 raise RuntimeError(f"cannot open store {store_name!r}")
+
+    def _conn_lock(self, host: str, port: int):
+        with self._meta_lock:
+            lock = self._conn_locks.get((host, port))
+            if lock is None:
+                lock = self._conn_locks[(host, port)] = threading.Lock()
+            return lock
 
     def _conn(self, host: str, port: int) -> int:
         key = (host, port)
@@ -105,19 +117,21 @@ class TransferClient:
 
     def fetch_into_store(self, host: str, port: int, object_id: bytes) -> bool:
         """Pull a remote object into the local arena (sealed on arrival).
-        Reuses a persistent connection; reconnects once on a broken one."""
+        Reuses a persistent connection (serialized per peer); reconnects once
+        on a broken one."""
         if self._handle is None:
             raise RuntimeError("client has no local store")
         oid = _pad_id(object_id)
-        for _ in range(2):
-            fd = self._conn(host, port)
-            if fd < 0:
-                return False
-            rc = self._lib.tts_fetch_fd(fd, oid, self._handle)
-            if rc == -5:
-                self._drop_conn(host, port)
-                continue
-            return rc == 0
+        with self._conn_lock(host, port):
+            for _ in range(2):
+                fd = self._conn(host, port)
+                if fd < 0:
+                    return False
+                rc = self._lib.tts_fetch_fd(fd, oid, self._handle)
+                if rc == -5:
+                    self._drop_conn(host, port)
+                    continue
+                return rc == 0
         return False
 
     def fetch_bytes(self, host: str, port: int,

@@ -201,9 +201,11 @@ class LocalActor:
     def _restart(self) -> None:
         old_thread = self.thread
         old_loop = self.loop
-        if old_thread.is_alive() and old_thread is not threading.current_thread():
+        same_thread = old_thread is threading.current_thread()
+        if old_thread.is_alive() and not same_thread:
             old_thread.join(timeout=5.0)
-        if old_loop is not None and not old_loop.is_closed():
+        if (old_loop is not None and not old_loop.is_closed()
+                and not old_loop.is_running()):
             old_loop.close()
         with self.cv:
             self.instance = None
@@ -215,6 +217,8 @@ class LocalActor:
         self.thread = threading.Thread(
             target=self._run, name=f"actor-{self.actor_id.hex()[:8]}",
             daemon=True)
+        with self.cv:
+            self.cv.notify_all()  # wake a same-thread-restart's old loop
         self.thread.start()
 
     def _fail_spec(self, spec: TaskSpec, error: BaseException):
@@ -266,10 +270,17 @@ class LocalActor:
                 max_workers=self.max_concurrency,
                 thread_name_prefix=f"actor-{self.actor_id.hex()[:8]}-c",
             )
+        me = threading.current_thread()
         while True:
             with self.cv:
-                while not self.queue and not self.dead:
+                # `self.thread is not me` => a restart replaced this loop
+                # (possible when the restart was triggered from this very
+                # thread, e.g. a method calling kill on its own actor):
+                # retire so two dispatchers never run concurrently.
+                while not self.queue and not self.dead and self.thread is me:
                     self.cv.wait()
+                if self.thread is not me:
+                    break
                 if self.dead and not self.queue:
                     break
                 _, spec = self.queue.popleft()
@@ -284,9 +295,12 @@ class LocalActor:
         # Woken by submit()/kill() via call_soon_threadsafe on this event —
         # no idle polling.
         self._wake = asyncio.Event()
+        me = threading.current_thread()
         while True:
             spec = None
             with self.cv:
+                if self.thread is not me:
+                    break  # a restart replaced this loop; retire
                 if self.queue:
                     _, spec = self.queue.popleft()
                 elif self.dead:
@@ -394,14 +408,19 @@ class _TaskPool:
             if self._shutdown:
                 return
             self._q.append((fn, args))
-            if self._idle > 0:
-                self._cv.notify()
-            elif self._threads < self._max:
+            # Spawn when idle workers can't cover the backlog. `_idle` still
+            # counts workers that were notified but haven't woken, so compare
+            # against queue depth rather than testing idle > 0 — otherwise
+            # two quick submits can both be assigned to one worker and the
+            # second item waits behind the first (deadlock if item 1 blocks
+            # on item 2's result).
+            if self._idle < len(self._q) and self._threads < self._max:
                 self._threads += 1
                 self._spawned_total += 1
                 threading.Thread(
                     target=self._worker, daemon=True,
                     name=f"{self._name}-{self._spawned_total}").start()
+            self._cv.notify()
 
     def _worker(self) -> None:
         while True:
@@ -583,6 +602,7 @@ class LocalRuntime:
         try:
             if pending.cancelled:
                 self._store_error(spec, TaskCancelledError(spec.task_id))
+                self._unpin_args(spec.dependencies())
                 return
             self._execute_callable(
                 spec, lambda a, k: pending.fn(*a, **k), pending=pending
@@ -897,7 +917,9 @@ class LocalRuntime:
 
     def set_resource(self, name: str, capacity: float) -> None:
         """Create/update/delete a custom resource at runtime (reference:
-        python/ray/experimental/dynamic_resources.py via raylet)."""
+        python/ray/experimental/dynamic_resources.py via raylet).
+        Re-runs dispatch: a queued task demanding the new resource must be
+        admitted now, not at the next unrelated completion."""
         fixed = int(round(capacity * 1000))
         with self._resource_cv:
             old_total = self.node.total.custom.get(name, 0)
@@ -915,6 +937,7 @@ class LocalRuntime:
             self.node.available = ResourceSet(self.node.available.predefined,
                                               new_avail)
             self._resource_cv.notify_all()
+        self._dispatch()
 
     def next_task_id(self) -> TaskID:
         ctx = ensure_context(self)

@@ -36,6 +36,9 @@ class DataStream:
 
     def _chain(self, kind: str, fn: Optional[Callable], parallelism: int,
                partition: str, keyed: bool = False) -> "DataStream":
+        # broadcast() overrides the partition of the NEXT edge regardless of
+        # which operator follows (map/filter/sink/...).
+        partition = getattr(self, "_force_partition", partition)
         op = self._ctx._add_op(kind, fn, parallelism)
         self._ctx.graph.add_edge(self._op_id, op.op_id, partition)
         return DataStream(self._ctx, op.op_id, keyed)
@@ -72,9 +75,7 @@ class DataStream:
 
     def sink(self, fn: Optional[Callable] = None,
              parallelism: int = 1) -> "DataStream":
-        partition = getattr(self, "_force_partition",
-                            self._default_partition())
-        s = self._chain("sink", fn, parallelism, partition)
+        s = self._chain("sink", fn, parallelism, self._default_partition())
         self._ctx._sinks.append(s._op_id)
         return s
 
@@ -123,13 +124,8 @@ class StreamingContext:
                 calls.append(sw.add_output.remote(
                     edge.partition, list(dst_ws), prefix))
                 for j in range(len(dst_ws)):
-                    chan = f"{prefix}:{i}->{j}"
-                    if edge.partition == BROADCAST:
-                        calls.append(dst_ws[j].expect_input.remote(chan))
-                    elif edge.partition == KEY_HASH:
-                        calls.append(dst_ws[j].expect_input.remote(chan))
-                    else:
-                        calls.append(dst_ws[j].expect_input.remote(chan))
+                    calls.append(
+                        dst_ws[j].expect_input.remote(f"{prefix}:{i}->{j}"))
             ray_tpu.get(calls)
 
     def submit(self) -> List[Any]:

@@ -1,0 +1,143 @@
+"""Regression tests for review findings (task-pool deadlock, dynamic-resource
+dispatch, cancel pin leak, self-kill restart, broadcast partition chaining,
+actor creation-arg GC safety)."""
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+def test_nested_blocking_tasks_no_pool_deadlock(local_ray):
+    # A task that submits a subtask and blocks on it needs a second pool
+    # thread even when submissions land back-to-back.
+    @ray_tpu.remote(num_cpus=0)
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote(num_cpus=0)
+    def parent(depth):
+        if depth == 0:
+            return 0
+        return ray_tpu.get(parent.remote(depth - 1)) + 1
+
+    assert ray_tpu.get(parent.remote(30), timeout=60) == 30
+    assert ray_tpu.get([leaf.remote(i) for i in range(100)], timeout=60) == \
+        list(range(1, 101))
+
+
+def test_set_resource_unblocks_queued_task(local_ray):
+    from ray_tpu.experimental import set_resource
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def needs_gadget():
+        return "ran"
+
+    ref = needs_gadget.remote()  # infeasible: no gadget resource yet
+    time.sleep(0.2)
+    set_resource("gadget", 1)
+    assert ray_tpu.get(ref, timeout=10) == "ran"
+    set_resource("gadget", 0)
+
+
+def test_cancel_admitted_task_unpins_args(local_ray):
+    import threading
+
+    import numpy as np
+
+    release = threading.Event()
+
+    @ray_tpu.remote
+    def hold(x):
+        release.wait(10)
+        return 1
+
+    data = ray_tpu.put(np.zeros(1000))
+    oid_hex = data.hex()
+    # cancel before admission (queue a second task so first is admitted,
+    # cancel the queued one): simplest deterministic path — cancel a task
+    # whose deps resolved but pool hasn't run it yet is racy, so exercise
+    # both cancel paths and assert no pin leaks either way.
+    r1 = hold.remote(data)
+    time.sleep(0.1)
+    ray_tpu.cancel(r1)
+    release.set()
+    try:
+        ray_tpu.get(r1, timeout=10)
+    except (ray_tpu.TaskCancelledError, ray_tpu.TaskError):
+        pass
+    time.sleep(0.2)
+    del data, r1
+    gc.collect()
+    time.sleep(0.1)
+    gc.collect()
+    assert oid_hex not in state.objects()  # pin released, object freed
+
+
+def test_actor_self_kill_restart_single_dispatcher(local_ray):
+    @ray_tpu.remote(max_restarts=2)
+    class SelfRestarter:
+        def __init__(self):
+            self.generation_marker = time.monotonic()
+
+        def restart_me(self, me):
+            ray_tpu.kill(me, no_restart=False)
+            return "restarting"
+
+        def marker(self):
+            return self.generation_marker
+
+        def ident(self):
+            import threading
+
+            return threading.get_ident()
+
+    a = SelfRestarter.remote()
+    m0 = ray_tpu.get(a.marker.remote())
+    assert ray_tpu.get(a.restart_me.remote(a)) == "restarting"
+    time.sleep(0.3)
+    m1 = ray_tpu.get(a.marker.remote(), timeout=10)
+    assert m1 != m0  # fresh instance
+    # all methods execute on exactly one dispatcher thread
+    idents = set(ray_tpu.get([a.ident.remote() for _ in range(20)]))
+    assert len(idents) == 1
+
+
+def test_actor_creation_args_survive_ref_drop(local_ray):
+    import numpy as np
+
+    @ray_tpu.remote(max_restarts=1)
+    class Holder:
+        def __init__(self, arr):
+            self.total = float(np.sum(arr))
+
+        def total_of(self):
+            return self.total
+
+    big = ray_tpu.put(np.ones(10000))
+    h = Holder.remote(big)
+    del big  # the actor's _creation tuple must keep the arg alive
+    gc.collect()
+    assert ray_tpu.get(h.total_of.remote()) == 10000.0
+    ray_tpu.kill(h, no_restart=False)  # restart re-resolves creation args
+    time.sleep(0.3)
+    assert ray_tpu.get(h.total_of.remote(), timeout=10) == 10000.0
+
+
+def test_broadcast_then_map(local_ray):
+    from ray_tpu.streaming import StreamingContext
+
+    ctx = StreamingContext(batch_size=4)
+    (ctx.from_collection(range(5))
+        .broadcast()
+        .map(lambda x: x * 2, parallelism=3)
+        .sink())
+    results = ctx.submit()
+    try:
+        # broadcast before map: every map instance sees every record
+        assert sorted(results) == sorted([x * 2 for x in range(5)] * 3)
+    finally:
+        ctx.shutdown()
