@@ -7,14 +7,18 @@ replay), IMPALA-style async learner, ES.
 """
 
 from .agents import (  # noqa: F401
+    A2CTrainer,
     DDPPOTrainer,
     DQNTrainer,
     ESTrainer,
     ImpalaTrainer,
+    MARWILTrainer,
+    PGTrainer,
     PPOTrainer,
     Trainer,
     build_trainer,
 )
+from .offline import JsonReader, JsonWriter  # noqa: F401
 from .env import CartPole, Env, StatelessBandit, VectorEnv, make_env, register_env  # noqa: F401
 from .execution import (  # noqa: F401
     ConcatBatches,

@@ -3,3 +3,5 @@ from .ppo import PPOTrainer, DDPPOTrainer  # noqa: F401
 from .dqn import DQNTrainer  # noqa: F401
 from .impala import ImpalaTrainer  # noqa: F401
 from .es import ESTrainer  # noqa: F401
+from .pg import A2CTrainer, PGTrainer  # noqa: F401
+from .marwil import MARWILTrainer  # noqa: F401

@@ -1,0 +1,131 @@
+"""MARWIL / behavior cloning from offline data (reference: rllib/agents/marwil).
+
+Exponentially advantage-weighted imitation: loss = -E[exp(beta * A) * log
+pi(a|s)] with a learned value baseline. beta=0 degenerates to plain behavior
+cloning (the reference's BC mode). Trains purely from JsonReader batches — no
+environment interaction.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...tune.trainable import Trainable
+from ..models import apply_mlp, init_mlp
+from ..offline import JsonReader
+from ..sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+MARWIL_CONFIG: Dict[str, Any] = {
+    "input_path": None,       # JsonWriter directory (required)
+    "obs_dim": None,          # required (no env to infer from)
+    "num_actions": None,      # required
+    "beta": 1.0,              # 0 => plain behavior cloning
+    "vf_coeff": 1.0,
+    "lr": 1e-3,
+    "gamma": 0.99,
+    "train_batch_size": 256,
+    "updates_per_step": 8,
+    "hiddens": [64, 64],
+    "seed": 0,
+}
+
+
+class MARWILTrainer(Trainable):
+    def setup(self, config: Dict) -> None:
+        self.config = {**MARWIL_CONFIG, **config}
+        cfg = self.config
+        for req in ("input_path", "obs_dim", "num_actions"):
+            if cfg[req] is None:
+                raise ValueError(f"MARWIL: config[{req!r}] is required")
+        self.reader = JsonReader(cfg["input_path"], seed=cfg["seed"])
+        self._rows = self._with_returns(self.reader.all(), cfg["gamma"])
+        key = jax.random.PRNGKey(cfg["seed"])
+        k1, k2 = jax.random.split(key)
+        hid = cfg["hiddens"]
+        self.params = {
+            "pi": init_mlp(k1, [cfg["obs_dim"]] + hid + [cfg["num_actions"]]),
+            "vf": init_mlp(k2, [cfg["obs_dim"]] + hid + [1]),
+        }
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self.rng = np.random.RandomState(cfg["seed"])
+        beta, vf_coeff = cfg["beta"], cfg["vf_coeff"]
+
+        def update(params, opt_state, obs, actions, returns):
+            def loss_fn(params):
+                logits = apply_mlp(params["pi"], obs)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = logp_all[jnp.arange(actions.shape[0]),
+                                actions.astype(jnp.int32)]
+                vf = apply_mlp(params["vf"], obs)[..., 0]
+                adv = returns - jax.lax.stop_gradient(vf)
+                # normalized exponential advantage weights (clipped for
+                # stability, as the reference does)
+                if beta > 0:
+                    w = jnp.exp(jnp.clip(
+                        beta * (adv - adv.mean()) / (adv.std() + 1e-8),
+                        -5.0, 5.0))
+                else:
+                    w = jnp.ones_like(adv)
+                bc_loss = -jnp.mean(w * logp)
+                vf_loss = jnp.mean((vf - returns) ** 2)
+                return bc_loss + vf_coeff * vf_loss, (bc_loss, vf_loss)
+
+            (_, (bc, vf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, bc, vf
+
+        self._update = jax.jit(update)
+        self._greedy = jax.jit(
+            lambda params, obs: jnp.argmax(apply_mlp(params["pi"], obs), -1))
+
+    @staticmethod
+    def _with_returns(batch: SampleBatch, gamma: float) -> SampleBatch:
+        rewards = np.asarray(batch[REWARDS], dtype=np.float32)
+        dones = np.asarray(batch[DONES], dtype=np.float32)
+        returns = np.zeros_like(rewards)
+        acc = 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            acc = rewards[t] + gamma * acc * (1.0 - dones[t])
+            returns[t] = acc
+        batch["returns"] = returns
+        return batch
+
+    def step(self) -> Dict:
+        cfg = self.config
+        n = self._rows.count
+        bc = vf = 0.0
+        for _ in range(cfg["updates_per_step"]):
+            idx = self.rng.randint(0, n, size=min(cfg["train_batch_size"], n))
+            obs = jnp.asarray(np.asarray(self._rows[OBS])[idx],
+                              dtype=jnp.float32)
+            acts = jnp.asarray(np.asarray(self._rows[ACTIONS])[idx])
+            rets = jnp.asarray(self._rows["returns"][idx])
+            self.params, self.opt_state, bc, vf = self._update(
+                self.params, self.opt_state, obs, acts, rets)
+        return {"bc_loss": float(bc), "vf_loss": float(vf),
+                "num_samples": int(n)}
+
+    def compute_action(self, obs) -> int:
+        return int(self._greedy(
+            self.params, jnp.asarray(np.asarray(obs)[None],
+                                     dtype=jnp.float32))[0])
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        with open(os.path.join(checkpoint_dir, "marwil.pkl"), "wb") as f:
+            pickle.dump(jax.device_get(self.params), f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_path: str) -> None:
+        if os.path.isdir(checkpoint_path):
+            checkpoint_path = os.path.join(checkpoint_path, "marwil.pkl")
+        with open(checkpoint_path, "rb") as f:
+            self.params = jax.device_put(pickle.load(f))

@@ -1,0 +1,155 @@
+"""Vanilla policy gradient + A2C (reference: rllib/agents/pg, rllib/agents/a3c/a2c).
+
+Both are one-jitted-update policies over the same MLP actor(-critic):
+PG = REINFORCE with return targets; A2C adds the learned value baseline and
+a single synchronous update per sampled batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from ..models import apply_mlp, init_mlp
+from ..policy import Policy
+from ..sample_batch import (
+    ACTIONS, ADVANTAGES, DONES, LOGPS, OBS, REWARDS, SampleBatch,
+    VALUE_TARGETS, VF_PREDS, compute_gae,
+)
+from .trainer import Trainer
+
+
+class A2CPolicy(Policy):
+    """Actor-critic with one fused jitted update (no ratio clipping —
+    the batch is always on-policy)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict[str, Any]):
+        self.config = config
+        hid = config.get("hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        k1, k2, self._act_key = jax.random.split(key, 3)
+        self.params = {
+            "pi": init_mlp(k1, [obs_dim] + hid + [num_actions]),
+            "vf": init_mlp(k2, [obs_dim] + hid + [1]),
+        }
+        self.opt = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self.opt.init(self.params)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+        use_baseline = config.get("use_critic", True)
+
+        def sample_action(params, obs, key):
+            logits = apply_mlp(params["pi"], obs)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(obs.shape[0]), action]
+            value = apply_mlp(params["vf"], obs)[..., 0]
+            return action, logp, value
+
+        def greedy(params, obs):
+            return jnp.argmax(apply_mlp(params["pi"], obs), axis=-1)
+
+        def update(params, opt_state, batch):
+            def loss_fn(params):
+                logits = apply_mlp(params["pi"], batch[OBS])
+                logp_all = jax.nn.log_softmax(logits)
+                acts = batch[ACTIONS].astype(jnp.int32)
+                logp = logp_all[jnp.arange(acts.shape[0]), acts]
+                adv = batch[ADVANTAGES]
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                pg_loss = -jnp.mean(logp * adv)
+                vf = apply_mlp(params["vf"], batch[OBS])[..., 0]
+                vf_loss = jnp.mean((vf - batch[VALUE_TARGETS]) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+                total = pg_loss - ent_coeff * entropy
+                if use_baseline:
+                    total = total + vf_coeff * vf_loss
+                return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                               "entropy": entropy}
+
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, stats
+
+        self._sample = jax.jit(sample_action)
+        self._greedy = jax.jit(greedy)
+        self._value = jax.jit(
+            lambda params, obs: apply_mlp(params["vf"], obs)[..., 0])
+        self._update = jax.jit(update)
+
+    def compute_actions(self, obs, explore: bool = True):
+        obs = jnp.asarray(obs, dtype=jnp.float32)
+        if explore:
+            self._act_key, sub = jax.random.split(self._act_key)
+            a, logp, v = self._sample(self.params, obs, sub)
+            return np.asarray(a), np.asarray(logp), np.asarray(v)
+        return np.asarray(self._greedy(self.params, obs)), None, None
+
+    def value(self, obs):
+        return np.asarray(
+            self._value(self.params, jnp.asarray(obs, dtype=jnp.float32)))
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, ADVANTAGES, VALUE_TARGETS)}
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, dev)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+class _SyncTrainerMixin:
+    def _train_step(self) -> Dict:
+        remote = self.workers.remote_workers()
+        if remote:
+            batches = ray_tpu.get([w.sample.remote() for w in remote])
+        else:
+            batches = [self.workers.local_worker().sample()]
+        batch = SampleBatch.concat_samples(batches)
+        self._steps_sampled += batch.count
+        stats = self.workers.local_worker().learn_on_batch(batch)
+        self._steps_trained += batch.count
+        self.workers.sync_weights()
+        return stats
+
+
+class A2CTrainer(_SyncTrainerMixin, Trainer):
+    _policy_cls = A2CPolicy
+    _default_config = {
+        "rollout_fragment_length": 32,
+        "use_gae": True,
+        "use_critic": True,
+        "lambda": 1.0,
+        "entropy_coeff": 0.01,
+        "hiddens": [64, 64],
+    }
+    _name = "A2C"
+
+
+class PGTrainer(_SyncTrainerMixin, Trainer):
+    """REINFORCE: Monte-Carlo returns, no critic in the loss
+    (the value head still exists but gets zero weight)."""
+
+    _policy_cls = A2CPolicy
+    _default_config = {
+        "rollout_fragment_length": 32,
+        "use_gae": True,
+        "use_critic": False,
+        "lambda": 1.0,  # lambda=1 + zero critic ~ Monte-Carlo returns
+        "entropy_coeff": 0.0,
+        "hiddens": [64, 64],
+    }
+    _name = "PG"

@@ -31,6 +31,7 @@ from .schedulers import (  # noqa: F401
     TrialScheduler,
 )
 from .search import BasicVariantGenerator, SearchAlgorithm, generate_variants  # noqa: F401
+from .suggest import SuggestSearcher  # noqa: F401
 from .trainable import FunctionTrainable, Trainable, report, wrap_function  # noqa: F401
 from .trial import Trial  # noqa: F401
 from .trial_executor import RayTrialExecutor  # noqa: F401
@@ -39,6 +40,7 @@ from .tune import ExperimentAnalysis, register_trainable, run  # noqa: F401
 
 __all__ = [
     "run",
+    "SuggestSearcher",
     "report",
     "register_trainable",
     "Trainable",

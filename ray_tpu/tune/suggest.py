@@ -1,0 +1,123 @@
+"""Model-based search (reference: python/ray/tune/suggest/ — the reference
+wraps external optimizers (hyperopt/skopt/bayesopt/...); none are in this
+image, so SuggestSearcher is a self-contained sequential-model searcher with
+the same SearchAlgorithm interface: suggest -> observe -> suggest better.
+
+Surrogate: k-nearest-neighbour value estimate over [0,1]^d encodings with an
+exploration bonus for sparse regions — a cheap stand-in for a GP that needs
+no dependencies and behaves sensibly in <=20 dims.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from .sample import Domain
+from .search import SearchAlgorithm
+
+
+class SuggestSearcher(SearchAlgorithm):
+    def __init__(self, space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", num_samples: int = 16,
+                 max_concurrent: int = 4, num_candidates: int = 128,
+                 k: int = 3, explore_weight: float = 0.3,
+                 num_startup: int = 5, seed: int = 0,
+                 base_config: Optional[Dict[str, Any]] = None):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self._domains: Dict[str, Domain] = {}
+        self._static: Dict[str, Any] = {}
+        for name, dom in space.items():
+            if isinstance(dom, Domain):
+                self._domains[name] = dom
+            else:
+                self._static[name] = dom
+        if not self._domains:
+            raise ValueError("space contains no tunable Domain entries")
+        self._base = dict(base_config or {})
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._num_samples = num_samples
+        self._max_concurrent = max_concurrent
+        self._num_candidates = num_candidates
+        self._k = k
+        self._explore = explore_weight
+        self._num_startup = num_startup
+        self._rng = random.Random(seed)
+        self._suggested = 0
+        self._live: Dict[str, Dict[str, Any]] = {}   # trial tag -> config
+        self._observations: List[Tuple[List[float], float]] = []
+
+    # ---- SearchAlgorithm interface ----
+
+    def next_trial_config(self) -> Optional[Tuple[str, Dict]]:
+        if self._suggested >= self._num_samples:
+            return None
+        if len(self._live) >= self._max_concurrent:
+            return None
+        config = self._suggest()
+        tag = f"suggest_{self._suggested}"
+        self._suggested += 1
+        self._live[tag] = config
+        return tag, {**self._base, **self._static, **config}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        # The runner reports with the tag this searcher issued in
+        # next_trial_config (TrialRunner tracks it as trial.search_tag).
+        config = self._live.pop(trial_id, None)
+        if config is None or error or result is None:
+            return
+        if self._metric in result:
+            x = self._encode(config)
+            self._observations.append(
+                (x, self._sign * float(result[self._metric])))
+
+    def is_finished(self) -> bool:
+        return self._suggested >= self._num_samples and not self._live
+
+    # ---- internals ----
+
+    def _encode(self, config: Dict[str, Any]) -> List[float]:
+        return [self._domains[n].encode(config[n])
+                for n in sorted(self._domains)]
+
+    def _random_config(self) -> Dict[str, Any]:
+        return {n: d.sample(self._rng) for n, d in self._domains.items()}
+
+    def _suggest(self) -> Dict[str, Any]:
+        if len(self._observations) < self._num_startup:
+            return self._random_config()
+        candidates = [self._random_config()
+                      for _ in range(self._num_candidates)]
+        best, best_score = None, -math.inf
+        for cand in candidates:
+            x = self._encode(cand)
+            score = self._acquisition(x)
+            if score > best_score:
+                best, best_score = cand, score
+        return best
+
+    def _acquisition(self, x: List[float]) -> float:
+        dists = sorted(
+            (math.dist(x, ox), val) for ox, val in self._observations)
+        nearest = dists[: self._k]
+        # inverse-distance-weighted value estimate
+        num = den = 0.0
+        for d, val in nearest:
+            w = 1.0 / (d + 1e-6)
+            num += w * val
+            den += w
+        estimate = num / den
+        # exploration: reward distance from the nearest observation
+        return estimate + self._explore * nearest[0][0]
+
+
+def best_config(searcher: SuggestSearcher) -> Optional[Dict[str, Any]]:
+    """Decode nothing — convenience: the caller should read the analysis;
+    kept for API symmetry with reference suggest wrappers."""
+    if not searcher._observations:
+        return None
+    return max(searcher._observations, key=lambda o: o[1])[0]

@@ -64,7 +64,9 @@ class TrialRunner:
             if nxt is None:
                 return
             tag, cfg = nxt
-            self.add_trial(self._trial_creator(tag, cfg))
+            trial = self._trial_creator(tag, cfg)
+            trial.search_tag = tag  # searcher-issued id for on_trial_complete
+            self.add_trial(trial)
 
     def step(self) -> None:
         self._pull_from_search_alg()
@@ -123,19 +125,22 @@ class TrialRunner:
             self._executor.save(trial)
         self._scheduler.on_trial_complete(self, trial, result)
         if self._search_alg is not None:
-            self._search_alg.on_trial_complete(trial.trial_id, result)
+            self._search_alg.on_trial_complete(
+                getattr(trial, "search_tag", trial.trial_id), result)
         self._executor.stop_trial(trial, Trial.TERMINATED)
 
     def _process_failure(self, trial: Trial, exc: Exception) -> None:
         trial.num_failures += 1
         self._scheduler.on_trial_error(self, trial)
-        if self._search_alg is not None:
-            self._search_alg.on_trial_complete(trial.trial_id, error=True)
         if trial.num_failures <= trial.max_failures:
-            # Retry from the last checkpoint.
+            # Retry from the last checkpoint (searcher not notified: the
+            # trial is still live and may yet report a result).
             self._executor.stop_trial(trial, Trial.PENDING)
             self._executor.start_trial(trial)
         else:
+            if self._search_alg is not None:
+                self._search_alg.on_trial_complete(
+                    getattr(trial, "search_tag", trial.trial_id), error=True)
             self._executor.stop_trial(trial, Trial.ERROR, error_msg=str(exc))
             if self._fail_fast:
                 self._shutdown_all()

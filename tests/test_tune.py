@@ -409,3 +409,30 @@ def test_pbt_explore_missing_key_resamples():
                   resample_probability=0.0)
     assert out["lr"] == 0.5
     assert out["other"] == 1
+
+
+def test_suggest_searcher_adaptive(local_ray):
+    """SuggestSearcher feeds configs lazily and exploits observations
+    (model: reference suggest/ wrappers + test_suggest)."""
+    from ray_tpu import tune
+    from ray_tpu.tune.suggest import SuggestSearcher
+
+    def objective(config):
+        # optimum at x=0.7, y=choice 'b'
+        score = -(config["x"] - 0.7) ** 2
+        if config["y"] == "b":
+            score += 0.5
+        tune.report(score=score)
+
+    searcher = SuggestSearcher(
+        {"x": tune.uniform(0.0, 1.0), "y": tune.choice(["a", "b", "c"])},
+        metric="score", mode="max", num_samples=24, max_concurrent=3,
+        num_startup=6, seed=42)
+    analysis = tune.run(objective, search_alg=searcher, verbose=0)
+    assert len(analysis.trials) == 24
+    assert searcher.is_finished()
+    best = max(analysis.trials,
+               key=lambda t: t.last_result.get("score", -1e9))
+    # adaptive search should land close to the optimum
+    assert best.last_result["score"] > 0.40  # y='b' and |x-0.7| < ~0.3
+    assert best.config["y"] == "b"
