@@ -78,6 +78,10 @@ def _collect(endpoint: str):
     if endpoint == "tasks":
         core = global_worker().core
         return dict(getattr(core, "stats", {}) or {})
+    if endpoint == "metrics":
+        from ..metrics import collect_all
+
+        return collect_all()
     raise KeyError(endpoint)
 
 

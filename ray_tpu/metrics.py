@@ -1,0 +1,137 @@
+"""Metrics API (reference: src/ray/stats/metric.h — Gauge/Count/Sum/Histogram
+over OpenCensus; here a dependency-free registry exported through the
+dashboard and state API)."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_LOCK = threading.Lock()
+
+
+class Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        with _LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and type(existing) is not type(self):
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing.kind}")
+            _REGISTRY[name] = self
+
+    def _tags_key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        tags = tags or {}
+        return tuple((k, tags.get(k, "")) for k in self.tag_keys)
+
+    def collect(self) -> Dict:
+        raise NotImplementedError
+
+
+class Count(Metric):
+    """Monotonic counter (reference stats::Count)."""
+
+    kind = "count"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def record(self, value: float = 1.0,
+               tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tags_key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def collect(self) -> Dict:
+        with self._lock:
+            return {"kind": self.kind, "description": self.description,
+                    "values": {str(dict(k)): v
+                               for k, v in self._values.items()}}
+
+
+class Gauge(Metric):
+    """Last-value-wins (reference stats::Gauge)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, Tuple[float, float]] = {}
+
+    def record(self, value: float,
+               tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._tags_key(tags)] = (value, time.time())
+
+    def collect(self) -> Dict:
+        with self._lock:
+            return {"kind": self.kind, "description": self.description,
+                    "values": {str(dict(k)): v for k, (v, _)
+                               in self._values.items()}}
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference stats::Histogram)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [
+            1, 5, 10, 25, 50, 100, 250, 500, 1000]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def record(self, value: float,
+               tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tags_key(tags)
+        bucket = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[bucket] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def collect(self) -> Dict:
+        with self._lock:
+            out = {}
+            for key, counts in self._counts.items():
+                total = self._totals[key]
+                out[str(dict(key))] = {
+                    "count": total,
+                    "sum": self._sums[key],
+                    "mean": self._sums[key] / max(total, 1),
+                    "buckets": dict(zip(
+                        [str(b) for b in self.boundaries] + ["+inf"], counts)),
+                }
+            return {"kind": self.kind, "description": self.description,
+                    "values": out}
+
+
+# Sum is an alias pattern in the reference; a Count covers it.
+Sum = Count
+
+
+def collect_all() -> Dict[str, Dict]:
+    """Snapshot every registered metric (the dashboard's /api/metrics)."""
+    with _LOCK:
+        metrics = list(_REGISTRY.items())
+    return {name: m.collect() for name, m in metrics}
+
+
+def reset_all() -> None:
+    with _LOCK:
+        _REGISTRY.clear()

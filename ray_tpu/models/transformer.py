@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention
+from ..ops.fused import rms_norm, softmax_cross_entropy
 from ..parallel.ring_attention import ring_attention
 
 Params = Dict[str, Any]
@@ -107,9 +108,8 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
 
 
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    x32 = x.astype(jnp.float32)
-    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+    # Fused pallas kernel on TPU, XLA reference elsewhere (ops/fused.py).
+    return rms_norm(x, weight.astype(x.dtype), eps)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -191,9 +191,10 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - target_logit)
+    B, T, V = logits.shape
+    losses = softmax_cross_entropy(
+        logits.reshape(B * T, V), targets.reshape(B * T))
+    return jnp.mean(losses)
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,

@@ -1,3 +1,4 @@
 """TPU compute ops: pallas kernels with XLA fallbacks."""
 
 from .attention import attention_reference, flash_attention  # noqa: F401
+from .fused import rms_norm, softmax_cross_entropy  # noqa: F401

@@ -1,0 +1,100 @@
+"""Metrics registry + projects yaml (models: reference test_metrics.py,
+projects tests)."""
+
+import pytest
+
+from ray_tpu import metrics
+from ray_tpu.projects import ProjectError, load_project, resolve_command
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    metrics.reset_all()
+    yield
+    metrics.reset_all()
+
+
+def test_count_gauge_histogram():
+    c = metrics.Count("tasks_done", "done", tag_keys=("node",))
+    c.record(tags={"node": "a"})
+    c.record(2, tags={"node": "a"})
+    c.record(tags={"node": "b"})
+    g = metrics.Gauge("queue_len")
+    g.record(7)
+    g.record(3)
+    h = metrics.Histogram("latency_ms", boundaries=[10, 100])
+    for v in (5, 50, 500, 7):
+        h.record(v)
+
+    snap = metrics.collect_all()
+    assert snap["tasks_done"]["values"]["{'node': 'a'}"] == 3.0
+    assert snap["tasks_done"]["values"]["{'node': 'b'}"] == 1.0
+    assert snap["queue_len"]["values"]["{}"] == 3
+    hv = snap["latency_ms"]["values"]["{}"]
+    assert hv["count"] == 4
+    assert hv["buckets"]["10"] == 2   # 5, 7
+    assert hv["buckets"]["100"] == 1  # 50
+    assert hv["buckets"]["+inf"] == 1 # 500
+
+
+def test_metric_kind_conflict():
+    metrics.Count("x")
+    with pytest.raises(ValueError):
+        metrics.Gauge("x")
+
+
+def test_dashboard_metrics_endpoint(local_ray):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    metrics.Count("my_metric").record(5)
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(f"{dash.url}/api/metrics",
+                                    timeout=10) as r:
+            data = json.loads(r.read())
+        assert data["my_metric"]["values"]["{}"] == 5.0
+    finally:
+        dash.stop()
+
+
+PROJECT_YAML = """
+name: demo
+description: test project
+cluster:
+  num_workers: 2
+commands:
+  - name: train
+    command: "python train.py --lr {{lr}} --mode {{mode}}"
+    params:
+      - name: lr
+        default: 0.001
+      - name: mode
+        choices: [fast, full]
+"""
+
+
+def test_project_load_and_resolve(tmp_path):
+    f = tmp_path / "ray-tpu-project.yaml"
+    f.write_text(PROJECT_YAML)
+    project = load_project(str(tmp_path))
+    assert project["name"] == "demo"
+
+    argv = resolve_command(project, "train", {"mode": "fast"})
+    assert argv == ["python", "train.py", "--lr", "0.001", "--mode", "fast"]
+
+    with pytest.raises(ProjectError):
+        resolve_command(project, "train", {})  # mode required
+    with pytest.raises(ProjectError):
+        resolve_command(project, "train", {"mode": "nope"})
+    with pytest.raises(ProjectError):
+        resolve_command(project, "missing")
+
+
+def test_project_validation(tmp_path):
+    f = tmp_path / "bad.yaml"
+    f.write_text("description: no name\n")
+    with pytest.raises(ProjectError):
+        load_project(str(f))
