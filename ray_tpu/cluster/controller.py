@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .._private.config import Config
 from .._native import create_store
-from .protocol import Connection, RpcClient, RpcServer
+from .protocol import Connection, ResilientClient, RpcClient, RpcServer
 
 ERR_PREFIX = b"E"
 VAL_PREFIX = b"V"
@@ -110,7 +110,7 @@ class NodeController:
     async def start(self) -> int:
         port = await self.server.start()
         self.address = (self.server.host, port)
-        self._gcs = RpcClient(*self.gcs_addr)
+        self._gcs = ResilientClient(*self.gcs_addr)
         self._gcs.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,

@@ -30,7 +30,7 @@ from .._private.serialization import SerializedObject, get_context
 from .._private.task_spec import TaskSpec
 from ..exceptions import ActorDiedError, GetTimeoutError
 from ..object_ref import ObjectRef
-from .protocol import RpcClient
+from .protocol import ResilientClient, RpcClient
 
 ERR_PREFIX = b"E"
 VAL_PREFIX = b"V"
@@ -44,7 +44,7 @@ class ClusterCoreWorker:
 
         self.config = config or get_config()
         self.role = role
-        self.gcs = RpcClient(*gcs_addr)
+        self.gcs = ResilientClient(*gcs_addr)
         self.gcs_addr = gcs_addr
         self.job_id = JobID.from_int(int(time.time()) & 0x7FFFFFFF)
         self.driver_task_id = TaskID.for_driver_task(self.job_id)

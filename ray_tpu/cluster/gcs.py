@@ -41,9 +41,13 @@ class NodeEntry:
 
 
 class GcsServer:
-    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self.config = config
         self.server = RpcServer(host, port)
+        # Snapshot persistence (reference: GCS tables against persistent
+        # Redis, test_gcs_fault_tolerance.py): state survives a GCS restart.
+        self.persist_path = persist_path
         self.nodes: Dict[str, NodeEntry] = {}
         self._node_order: List[str] = []       # index -> node_id for the kernel
         self.actors: Dict[str, Dict[str, Any]] = {}
@@ -92,15 +96,81 @@ class GcsServer:
 
     # ------------------------------------------------------------------ setup
     async def start(self) -> int:
+        if self.persist_path:
+            self._load_snapshot()
         port = await self.server.start()
         self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
         self._tasks.append(asyncio.create_task(self._placement_loop()))
+        if self.persist_path:
+            self._tasks.append(asyncio.create_task(self._snapshot_loop()))
         return port
 
     async def stop(self):
         for t in self._tasks:
             t.cancel()
+        if self.persist_path:
+            self._write_snapshot()
         await self.server.stop()
+
+    # ------------------------------------------------------------ persistence
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": [
+                {"node_id": n.node_id, "address": list(n.address),
+                 "resources": n.resources, "available": n.available,
+                 "alive": n.alive, "store_name": n.store_name,
+                 "transfer_port": n.transfer_port}
+                for n in (self.nodes[nid] for nid in self._node_order)
+            ],
+            "actors": self.actors,
+            "named_actors": self.named_actors,
+            "objects": self.objects,
+            "functions": self.functions,
+            "kv": self.kv,
+        }
+
+    def _write_snapshot(self) -> None:
+        import os
+        import pickle as _pickle
+
+        tmp = f"{self.persist_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                _pickle.dump(self._snapshot_state(), f)
+            os.replace(tmp, self.persist_path)  # atomic
+        except OSError:
+            pass
+
+    def _load_snapshot(self) -> None:
+        import pickle as _pickle
+
+        try:
+            with open(self.persist_path, "rb") as f:
+                state = _pickle.load(f)
+        except (OSError, EOFError, _pickle.UnpicklingError):
+            return
+        for n in state.get("nodes", []):
+            entry = NodeEntry(
+                n["node_id"], tuple(n["address"]), n["resources"],
+                index=len(self._node_order), store_name=n["store_name"],
+                transfer_port=n.get("transfer_port", 0))
+            entry.available = n["available"]
+            entry.alive = n["alive"]
+            # Fresh heartbeat deadline: restored nodes must re-prove
+            # liveness, but get a full timeout window to do so.
+            self.nodes[n["node_id"]] = entry
+            self._node_order.append(n["node_id"])
+        self.actors = state.get("actors", {})
+        self.named_actors = state.get("named_actors", {})
+        self.objects = state.get("objects", {})
+        self.functions = state.get("functions", {})
+        self.kv = state.get("kv", {})
+
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            await asyncio.to_thread(self._write_snapshot)
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, data: Dict[str, Any]):

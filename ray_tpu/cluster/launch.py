@@ -41,12 +41,13 @@ def _force_cpu_jax():
 
 
 async def run_head(port: int, resources: dict, num_workers: int,
-                   with_node: bool = True, worker_env: dict | None = None):
+                   with_node: bool = True, worker_env: dict | None = None,
+                   persist: str | None = None):
     from ray_tpu._private.config import get_config
     from ray_tpu.cluster.gcs import GcsServer
 
     config = get_config()
-    gcs = GcsServer(config, port=port)
+    gcs = GcsServer(config, port=port, persist_path=persist)
     gcs_port = await gcs.start()
     print(json.dumps({"event": "gcs_started", "port": gcs_port}), flush=True)
     node_stop = None
@@ -116,6 +117,8 @@ def main():
     head.add_argument("--num-workers", type=int, default=2)
     head.add_argument("--no-node", action="store_true")
     head.add_argument("--worker-env", default="{}")
+    head.add_argument("--persist", default=None,
+                      help="snapshot file for GCS state (restart recovery)")
 
     node = sub.add_parser("node")
     node.add_argument("--gcs", required=True)
@@ -131,6 +134,7 @@ def main():
             asyncio.run(run_head(
                 args.port, json.loads(args.resources), args.num_workers,
                 with_node=not args.no_node, worker_env=worker_env,
+                persist=args.persist,
             ))
         else:
             host, port = args.gcs.rsplit(":", 1)

@@ -19,6 +19,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 _LEN = struct.Struct("<Q")
@@ -65,6 +66,7 @@ class RpcServer:
         self._handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._on_disconnect: Optional[Callable] = None
+        self._conns: set = set()
 
     def handler(self, msg_type: str):
         def deco(fn):
@@ -85,6 +87,7 @@ class RpcServer:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
         conn = Connection(reader, writer)
+        self._conns.add(conn)
         try:
             while True:
                 msg = await read_message(reader)
@@ -105,6 +108,7 @@ class RpcServer:
                     resp["rpc_id"] = msg["rpc_id"]
                     await conn.send(resp)
         finally:
+            self._conns.discard(conn)
             if self._on_disconnect is not None:
                 try:
                     res = self._on_disconnect(conn)
@@ -117,6 +121,13 @@ class RpcServer:
     async def stop(self):
         if self._server is not None:
             self._server.close()
+            # Force live client connections shut, else wait_closed() blocks
+            # until every client hangs up on its own.
+            for conn in list(self._conns):
+                try:
+                    conn.writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
             await self._server.wait_closed()
 
 
@@ -241,3 +252,66 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class ResilientClient:
+    """RpcClient that transparently reconnects across server restarts.
+
+    Used for GCS connections (reference: clients retry against the restarted
+    GCS in test_gcs_fault_tolerance.py). A call that hits a dead socket
+    re-dials until ``retry_window`` elapses; the GCS restores its tables from
+    its snapshot, so retried calls see consistent state.
+    """
+
+    def __init__(self, host: str, port: int,
+                 push_handler: Optional[Callable[[Dict], None]] = None,
+                 retry_window: float = 30.0):
+        self.addr = (host, port)
+        self._push_handler = push_handler
+        self._retry_window = retry_window
+        self._lock = threading.Lock()
+        self._client: Optional[RpcClient] = None
+        self._closed = False
+        self._ensure()
+
+    def _ensure(self) -> RpcClient:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError(f"client to {self.addr} closed")
+            if self._client is None or self._client._closed:
+                self._client = RpcClient(
+                    *self.addr, push_handler=self._push_handler)
+            return self._client
+
+    def _drop(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+    def call(self, msg: Dict[str, Any], timeout: Optional[float] = 60.0) -> Dict:
+        deadline = time.monotonic() + self._retry_window
+        while True:
+            try:
+                return self._ensure().call(msg, timeout=timeout)
+            except (ConnectionError, OSError):
+                self._drop()
+                if self._closed or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.25)
+
+    def send_oneway(self, msg: Dict[str, Any]) -> None:
+        try:
+            self._ensure().send_oneway(msg)
+        except (ConnectionError, OSError):
+            self._drop()
+            # one immediate retry; oneway messages are periodic (heartbeats)
+            # so a miss is recovered by the next tick anyway
+            try:
+                self._ensure().send_oneway(msg)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        self._closed = True
+        self._drop()
