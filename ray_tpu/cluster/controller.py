@@ -150,11 +150,47 @@ class NodeController:
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
              "--controller", f"{self.address[0]}:{self.address[1]}",
              "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"],
-            env=env,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1,
         )
         handle = WorkerHandle(proc)
         self.workers[proc.pid] = handle
+        self._start_log_pump(proc)
         return handle
+
+    def _start_log_pump(self, proc: subprocess.Popen) -> None:
+        """Forward the worker's stdout/stderr to the GCS logs channel so
+        drivers can print them (reference: log_monitor.py tailing per-proc
+        files + worker.py:960 print_logs)."""
+        import threading
+
+        def pump():
+            batch: List[str] = []
+            last_flush = time.monotonic()
+
+            def flush():
+                nonlocal batch, last_flush
+                if batch:
+                    try:
+                        self._gcs.send_oneway({
+                            "type": "publish_logs", "node_id": self.node_id,
+                            "pid": proc.pid, "lines": batch})
+                    except (ConnectionError, OSError):
+                        pass
+                    batch = []
+                last_flush = time.monotonic()
+
+            try:
+                for line in proc.stdout:
+                    batch.append(line.rstrip("\n"))
+                    if len(batch) >= 20 or time.monotonic() - last_flush > 0.1:
+                        flush()
+            except ValueError:  # closed pipe
+                pass
+            flush()
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"logpump-{proc.pid}").start()
 
     async def _heartbeat_loop(self):
         interval = self.config.heartbeat_interval_ms / 1000.0

@@ -66,6 +66,28 @@ class ClusterCoreWorker:
         self.local_store = None
         self._transfer_cli: Any = None  # None=unprobed, False=unavailable
         self._transfer_has_store = False
+        self._sub_client = None
+        if role == "driver":
+            self._subscribe_logs()
+
+    def _subscribe_logs(self) -> None:
+        """Stream worker stdout/stderr lines to this driver's console
+        (reference: worker.py:960 print_logs over redis pubsub)."""
+        import sys as _sys
+
+        def on_push(msg):
+            if msg.get("type") != "pubsub" or msg.get("channel") != "logs":
+                return
+            data = msg.get("data", {})
+            prefix = f"({data.get('node_id', '')[:8]} pid={data.get('pid')})"
+            for line in data.get("lines", []):
+                print(f"{prefix} {line}", file=_sys.stderr)
+
+        try:
+            self._sub_client = RpcClient(*self.gcs_addr, push_handler=on_push)
+            self._sub_client.call({"type": "subscribe", "channel": "logs"})
+        except (ConnectionError, OSError):
+            self._sub_client = None
 
     # ---------------------------------------------------------------- helpers
     def _controller(self, addr: Tuple[str, int]) -> RpcClient:
@@ -483,4 +505,6 @@ class ClusterCoreWorker:
         self.flush_events()
         for client in self._controllers.values():
             client.close()
+        if self._sub_client is not None:
+            self._sub_client.close()
         self.gcs.close()

@@ -511,6 +511,13 @@ class GcsServer:
                 return {"ok": False, "error": "unknown function"}
             return {"ok": True, "blob": blob}
 
+        @s.handler("publish_logs")
+        async def publish_logs(msg, conn):
+            await self.publish("logs", {
+                "node_id": msg["node_id"], "pid": msg["pid"],
+                "lines": msg["lines"]})
+            return None  # oneway
+
         @s.handler("add_profile_data")
         async def add_profile_data(msg, conn):
             # Batched span flush from a worker/driver (reference:

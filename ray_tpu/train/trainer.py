@@ -221,3 +221,50 @@ class TPUTrainer:
             except Exception:  # noqa: BLE001
                 pass
         self.workers = []
+
+    # ---------------------------------------------------------------- tune
+    @classmethod
+    def as_trainable(cls, init_fn, loss_fn, data_creator, **trainer_kwargs):
+        """A tune Trainable wrapping this trainer (reference:
+        torch_trainer.py:717 TorchTrainer.as_trainable). Tune config keys
+        matching constructor kwargs (learning_rate, num_workers, seed, ...)
+        override; the rest flow into the trainer's user config."""
+        import os
+
+        from ..tune.trainable import Trainable
+
+        ctor_keys = {"optimizer", "learning_rate", "num_workers", "seed",
+                     "max_retries", "num_cpus_per_worker"}
+
+        class TPUTrainerTrainable(Trainable):
+            def setup(self, config):
+                kwargs = dict(trainer_kwargs)
+                user_cfg = dict(kwargs.pop("config", {}) or {})
+                for k, v in (config or {}).items():
+                    if k.startswith("__"):
+                        continue
+                    if k in ctor_keys:
+                        kwargs[k] = v
+                    else:
+                        user_cfg[k] = v
+                self.trainer = cls(init_fn, loss_fn, data_creator,
+                                   config=user_cfg, **kwargs)
+
+            def step(self):
+                return self.trainer.train()
+
+            def save_checkpoint(self, checkpoint_dir):
+                self.trainer.save(os.path.join(checkpoint_dir, "trainer.pkl"))
+                return checkpoint_dir
+
+            def load_checkpoint(self, checkpoint_path):
+                if os.path.isdir(checkpoint_path):
+                    checkpoint_path = os.path.join(
+                        checkpoint_path, "trainer.pkl")
+                self.trainer.restore(checkpoint_path)
+
+            def cleanup(self):
+                self.trainer.shutdown()
+
+        TPUTrainerTrainable.__name__ = f"{cls.__name__}Trainable"
+        return TPUTrainerTrainable
