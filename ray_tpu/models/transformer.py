@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention
 from ..ops.fused import rms_norm, softmax_cross_entropy
-from ..parallel.ring_attention import ring_attention
+from ..parallel.pipeline import gpipe_sharded
+from ..parallel.ring_attention import ring_attention, ring_attention_sharded
 
 Params = Dict[str, Any]
 
@@ -54,6 +55,8 @@ class TransformerConfig:
         assert self.n_heads % tp == 0, "n_heads must divide tp"
         assert self.n_kv_heads % tp == 0, "n_kv_heads must divide tp"
         assert self.d_ff % tp == 0 and self.vocab_size % tp == 0
+        pp = mesh.shape.get("pp", 1)
+        assert self.n_layers % pp == 0, "n_layers must divide pp"
 
 
 def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
@@ -84,25 +87,33 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
     }
 
 
+# Per-layer partition specs, shared by param_shardings (GSPMD placement) and
+# forward_pipelined's shard_map in_specs so the two can never drift. Leading
+# dim is the scan-stacked layer axis, sharded over pp; megatron layout over
+# tp (column-parallel qkv/gate/up, row-parallel wo/w_down).
+_LAYER_PSPECS = {
+    "attn_norm": P("pp", None),
+    "wq": P("pp", None, "tp"),
+    "wk": P("pp", None, "tp"),
+    "wv": P("pp", None, "tp"),
+    "wo": P("pp", "tp", None),
+    "mlp_norm": P("pp", None),
+    "w_gate": P("pp", None, "tp"),
+    "w_up": P("pp", None, "tp"),
+    "w_down": P("pp", "tp", None),
+}
+
+
 def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
     """Megatron layout: attention/ffn column-then-row parallel over tp."""
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    layer = {
-        "attn_norm": ns(None, None),
-        "wq": ns(None, None, "tp"),
-        "wk": ns(None, None, "tp"),
-        "wv": ns(None, None, "tp"),
-        "wo": ns(None, "tp", None),
-        "mlp_norm": ns(None, None),
-        "w_gate": ns(None, None, "tp"),
-        "w_up": ns(None, None, "tp"),
-        "w_down": ns(None, "tp", None),
-    }
     return {
         "embed": ns("tp", None),
-        "layers": layer,
+        "layers": {
+            k: NamedSharding(mesh, v) for k, v in _LAYER_PSPECS.items()
+        },
         "final_norm": ns(None),
     }
 
@@ -185,12 +196,107 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     return logits
 
 
+def _block_manual(layer: Params, x: jax.Array, cfg: TransformerConfig,
+                  positions: jax.Array) -> jax.Array:
+    """One transformer block on per-device shards (manual SPMD).
+
+    Runs inside shard_map with every mesh axis manual: ``x`` is the local
+    [b, t_local, E] activation shard (replicated over tp), ``layer`` leaves
+    are this device's tp slices. Megatron pattern with explicit collectives:
+    column-parallel qkv/gate/up need no comm, row-parallel wo/w_down psum
+    over tp; attention is ring attention over sp.
+    """
+    dt = cfg.dtype
+    tp = jax.lax.axis_size("tp")
+    H_l, KH_l, Dh = cfg.n_heads // tp, cfg.n_kv_heads // tp, cfg.head_dim
+    B, T, E = x.shape
+
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, T, H_l, Dh)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, T, KH_l, Dh)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, T, KH_l, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if jax.lax.axis_size("sp") > 1:
+        attn = ring_attention_sharded(q, k, v, axis_name="sp", causal=True)
+    else:
+        # Sequence axis is whole on this device: use the blockwise flash
+        # kernel rather than ring attention's full [T, T] score fold.
+        attn = flash_attention(q, k, v, causal=True)
+    attn = attn.reshape(B, T, H_l * Dh)
+    x = x + jax.lax.psum(attn @ layer["wo"].astype(dt), "tp")
+
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    return x + jax.lax.psum((gate * up) @ layer["w_down"].astype(dt), "tp")
+
+
+def forward_pipelined(params: Params, tokens: jax.Array,
+                      cfg: TransformerConfig, mesh: Mesh, *,
+                      num_microbatches: int) -> jax.Array:
+    """Forward with the block stack run as a GPipe pipeline over ``pp``.
+
+    Embed and head stay outside the pipelined region under GSPMD; the block
+    stack runs in one shard_map over the full mesh — pp stages via
+    gpipe_sharded, tp via explicit psum, sp via ring attention — composing
+    all four axes in a single XLA program (net-new vs the reference, which
+    has no pipeline parallelism: SURVEY.md §2.3).
+    """
+    B, T = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]          # [B, T, E]
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp", "sp", None))
+    )
+
+    def body(layers, x_local):
+        b, t, E = x_local.shape
+        M = num_microbatches
+        mb = x_local.reshape(M, b // M, t, E)
+        positions = jax.lax.axis_index("sp") * t + jnp.arange(t)
+
+        def stage_fn(stage_layers, x_mb):
+            def one(xc, layer):
+                return _block_manual(layer, xc, cfg, positions), None
+
+            y, _ = jax.lax.scan(one, x_mb, stage_layers)
+            return y
+
+        out = gpipe_sharded(stage_fn, layers, mb, axis_name="pp")
+        return out.reshape(b, t, E)
+
+    x = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(_LAYER_PSPECS, P("dp", "sp", None)),
+        out_specs=P("dp", "sp", None),
+        check_vma=False,
+    )(params["layers"], x)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["embed"].astype(cfg.dtype).T        # [B, T, V]
+    return jax.lax.with_sharding_constraint(
+        logits, NamedSharding(mesh, P("dp", "sp", "tp"))
+    )
+
+
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None) -> jax.Array:
-    """Next-token cross entropy; batch = {"tokens": [B, T+1]}."""
+            mesh: Optional[Mesh] = None, *,
+            num_microbatches: int = 0) -> jax.Array:
+    """Next-token cross entropy; batch = {"tokens": [B, T+1]}.
+
+    When the mesh has pp > 1 the block stack runs pipelined
+    (``forward_pipelined``) with ``num_microbatches`` splits (default 2*pp).
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        M = num_microbatches or 2 * pp
+        logits = forward_pipelined(
+            params, inputs, cfg, mesh, num_microbatches=M
+        ).astype(jnp.float32)
+    else:
+        logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
     B, T, V = logits.shape
     losses = softmax_cross_entropy(
         logits.reshape(B * T, V), targets.reshape(B * T))
@@ -198,7 +304,8 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    learning_rate: float = 3e-4):
+                    learning_rate: float = 3e-4,
+                    num_microbatches: int = 0):
     """Returns (init_opt_state, train_step) with adamw; jit with shardings
     is applied by the caller (see __graft_entry__.py / ray_tpu.train)."""
     import optax
@@ -209,7 +316,9 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
         return tx.init(params)
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, batch, cfg, mesh, num_microbatches=num_microbatches
+        )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

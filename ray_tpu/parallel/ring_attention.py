@@ -61,8 +61,9 @@ def ring_attention_sharded(
     sp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
 
-    k = _repeat_kv(k, H)
-    v = _repeat_kv(v, H)
+    # GQA: rotate the raw KH-head K/V around the ring and repeat to H heads
+    # only inside the local fold — H/KH x less ICI traffic than repeating
+    # before the ring.
     q32 = q.astype(jnp.float32)
 
     o0 = jnp.zeros((B, T, H, D), jnp.float32)
@@ -70,20 +71,30 @@ def ring_attention_sharded(
     l0 = jnp.zeros((B, H, T), jnp.float32)
     q_off = my * T
 
-    def step(carry, i):
-        k_blk, v_blk, o, m, l = carry
+    def fold(k_blk, v_blk, i, o, m, l):
         src = (my - i) % sp                      # origin shard of current block
         k_off = src * T
-        o, m, l = _block_step(
-            q32, k_blk, v_blk, q_off, k_off, o, m, l, causal=causal, scale=scale
+        return _block_step(
+            q32, _repeat_kv(k_blk, H), _repeat_kv(v_blk, H),
+            q_off, k_off, o, m, l, causal=causal, scale=scale,
         )
+
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, i):
+        k_blk, v_blk, o, m, l = carry
+        o, m, l = fold(k_blk, v_blk, i, o, m, l)
         # rotate KV to the next device (j -> j+1 around the ring)
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, o, m, l), None
 
-    (k, v, o, m, l), _ = jax.lax.scan(step, (k, v, o0, m0, l0), jnp.arange(sp))
+    # sp-1 rotations; the last arriving block is folded without a wasted
+    # final ppermute.
+    (k, v, o, m, l), _ = jax.lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(sp - 1)
+    )
+    o, m, l = fold(k, v, sp - 1, o, m, l)
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

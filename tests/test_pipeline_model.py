@@ -1,0 +1,118 @@
+"""Pipeline-parallel flagship model: pp>=2 equivalence with the scan path.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); these tests
+validate the net-new GPipe composition — dp x pp x sp x tp in one shard_map —
+against the single-device layer-scan forward, including backward/optimizer
+(train-step) equivalence at pp=2.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_shardings,
+)
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _cfg():
+    # f32 so cross-mesh comparisons are tight.
+    return TransformerConfig(
+        vocab_size=128,
+        d_model=64,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+
+
+def _batch(cfg, B=4, T=32):
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    return {"tokens": tokens}
+
+
+@pytest.fixture(scope="module")
+def cfg_params_batch():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params, _batch(cfg)
+
+
+def _sharded(params, cfg, mesh):
+    return jax.device_put(params, param_shardings(cfg, mesh))
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        MeshSpec(dp=1, pp=2, sp=1, tp=1),
+        MeshSpec(dp=2, pp=2, sp=1, tp=2),
+        MeshSpec(dp=1, pp=2, sp=2, tp=2),
+        MeshSpec(dp=1, pp=4, sp=1, tp=2),
+    ],
+)
+def test_pipelined_loss_matches_scan(cfg_params_batch, spec):
+    cfg, params, batch = cfg_params_batch
+    ref = float(jax.jit(lambda p, b: loss_fn(p, b, cfg))(params, batch))
+
+    devices = jax.devices()[: spec.size]
+    mesh = make_mesh(spec, devices)
+    cfg.validate_for_mesh(mesh)
+    p = _sharded(params, cfg, mesh)
+    got = float(
+        jax.jit(
+            lambda p, b: loss_fn(p, b, cfg, mesh, num_microbatches=2)
+        )(p, batch)
+    )
+    assert got == pytest.approx(ref, abs=2e-4), (spec, got, ref)
+
+
+def test_pipelined_train_step_matches_pp1(cfg_params_batch):
+    """3 adamw steps at pp=2 track the single-device run step for step."""
+    cfg, params, batch = cfg_params_batch
+
+    def run(mesh, n_mb):
+        p = params if mesh is None else _sharded(params, cfg, mesh)
+        init_opt, train_step = make_train_step(
+            cfg, mesh, num_microbatches=n_mb
+        )
+        opt = init_opt(p)
+        step = jax.jit(train_step)
+        losses = []
+        for _ in range(3):
+            p, opt, loss = step(p, opt, batch)
+            losses.append(float(loss))
+        return losses
+
+    ref = run(None, 0)
+    mesh = make_mesh(MeshSpec(dp=2, pp=2, sp=1, tp=2), jax.devices()[:8])
+    got = run(mesh, 2)
+    np.testing.assert_allclose(got, ref, atol=5e-4)
+    assert got[-1] < got[0], "loss should decrease"
+
+
+def test_microbatch_count_invariance(cfg_params_batch):
+    """Pipelined loss is independent of the microbatch split."""
+    cfg, params, batch = cfg_params_batch
+    mesh = make_mesh(MeshSpec(dp=1, pp=2, sp=1, tp=1), jax.devices()[:2])
+    p = _sharded(params, cfg, mesh)
+    vals = [
+        float(
+            jax.jit(
+                lambda p, b, m=m: loss_fn(p, b, cfg, mesh, num_microbatches=m)
+            )(p, batch)
+        )
+        for m in (1, 2, 4)
+    ]
+    np.testing.assert_allclose(vals, vals[0] * np.ones(3), atol=1e-5)
