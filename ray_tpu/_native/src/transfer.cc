@@ -88,6 +88,20 @@ void handle_get(ServerCtx* ctx, int fd, const uint8_t* id) {
   }
 }
 
+
+// Drain `size` payload bytes so the connection stays request-aligned when a
+// body cannot be stored (duplicate object, OOM, raced fetcher).
+static bool drain_payload(int fd, uint64_t size) {
+  uint8_t sink[65536];
+  uint64_t left = size;
+  while (left > 0) {
+    uint64_t take = left > sizeof(sink) ? sizeof(sink) : left;
+    if (!recv_all(fd, sink, take)) return false;
+    left -= take;
+  }
+  return true;
+}
+
 void handle_put(ServerCtx* ctx, int fd, const uint8_t* id) {
   uint64_t size = 0;
   if (!recv_all(fd, reinterpret_cast<uint8_t*>(&size), 8)) return;
@@ -105,16 +119,13 @@ void handle_put(ServerCtx* ctx, int fd, const uint8_t* id) {
     }
   } else if (rc == kAlreadyExists) {
     // Idempotent: drain payload, report success (objects are immutable).
-    uint8_t sink[4096];
-    uint64_t left = size;
-    while (left > 0) {
-      uint64_t take = left > sizeof(sink) ? sizeof(sink) : left;
-      if (!recv_all(fd, sink, take)) return;
-      left -= take;
-    }
+    if (!drain_payload(fd, size)) return;
     status = 0;
   } else {
-    status = 2;  // OOM etc; sender sees failure, payload abandoned
+    // OOM etc: drain the payload so a persistent connection stays framed
+    // (the next bytes must be a request header, not leftover payload).
+    if (!drain_payload(fd, size)) return;
+    status = 2;  // sender sees failure
   }
   send_all(fd, &status, 1);
 }
@@ -249,13 +260,7 @@ int tts_fetch_fd(int fd, const uint8_t* id, void* store_handle) {
   if (rc == kAlreadyExists || rc != kOk) {
     // raced another fetcher / local store full: must still drain the stream
     // to keep the connection request-aligned.
-    uint8_t sink[65536];
-    uint64_t left = size;
-    while (left > 0) {
-      uint64_t take = left > sizeof(sink) ? sizeof(sink) : left;
-      if (!recv_all(fd, sink, take)) return -5;
-      left -= take;
-    }
+    if (!drain_payload(fd, size)) return -5;
     return rc == kAlreadyExists ? 0 : -3;
   }
   auto* h = static_cast<Handle*>(store_handle);

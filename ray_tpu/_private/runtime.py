@@ -590,8 +590,11 @@ class LocalRuntime:
                     to_run.append(p)
                 if not dq:
                     del self._ready[klass]
+            # Mark admission under the lock: cancel() checks future under
+            # the same lock, so store-error/unpin can never run twice.
+            for p in to_run:
+                p.future = _ADMITTED
         for p in to_run:
-            p.future = _ADMITTED
             self._pool.submit(self._run_task, p)
 
     def _run_task(self, pending: PendingTask):
@@ -883,9 +886,13 @@ class LocalRuntime:
         task_id = ref.id.task_id()
         with self._lock:
             pending = self._pending.get(task_id)
-            if pending is not None:
-                pending.cancelled = True
-        if pending is not None and pending.future is None:
+            if pending is None or pending.cancelled:
+                return  # unknown, finished, or already cancelled
+            pending.cancelled = True
+            # Admission (future = _ADMITTED) happens under this lock in
+            # _dispatch; once admitted, _run_task owns the error/unpin.
+            not_admitted = pending.future is None
+        if not_admitted:
             self._store_error(pending.spec, TaskCancelledError(task_id))
             self._unpin_args(pending.spec.dependencies())
 

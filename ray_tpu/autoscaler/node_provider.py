@@ -140,16 +140,21 @@ class SubprocessProvider(NodeProvider):
 
         resources = node_config.get("resources", self.resources)
         for _ in range(count):
+            with self._lock:
+                nid = f"worker-{self._next}"
+                self._next += 1
+            # The node registers with this provider id as its GCS label, so
+            # LoadMetrics (keyed by label) and provider node ids line up and
+            # idle termination can match (ADVICE round 1).
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
                  "--gcs", self.gcs_address,
                  "--resources", _json.dumps(resources),
-                 "--num-workers", str(self.num_workers)],
+                 "--num-workers", str(self.num_workers),
+                 "--label", nid],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             )
             with self._lock:
-                nid = f"worker-{self._next}"
-                self._next += 1
                 self._procs[nid] = proc
                 self._tags[nid] = dict(tags)
 

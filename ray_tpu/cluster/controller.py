@@ -50,9 +50,11 @@ class NodeController:
     def __init__(self, config: Config, gcs_addr: Tuple[str, int],
                  resources: Dict[str, float], num_workers: int = 2,
                  host: str = "127.0.0.1", port: int = 0,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 label: str = ""):
         self.config = config
         self.node_id = uuid.uuid4().hex
+        self.label = label
         self.gcs_addr = gcs_addr
         self.resources = resources
         self.num_workers = num_workers
@@ -116,6 +118,7 @@ class NodeController:
             "address": list(self.address), "resources": self.resources,
             "store_name": self.store_name,
             "transfer_port": self.transfer_port,
+            "label": self.label,
         })
         for _ in range(self.num_workers):
             self._spawn_worker()

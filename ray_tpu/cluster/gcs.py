@@ -12,6 +12,7 @@ reference's per-node scheduling loops with one data-parallel decision.
 from __future__ import annotations
 
 import asyncio
+import pickle
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -24,11 +25,12 @@ from .protocol import Connection, RpcServer
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
-                 "alive", "index", "store_name", "transfer_port")
+                 "alive", "index", "store_name", "transfer_port", "label")
 
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float], index: int,
-                 store_name: str = "", transfer_port: int = 0):
+                 store_name: str = "", transfer_port: int = 0,
+                 label: str = ""):
         self.node_id = node_id
         self.address = address
         self.resources = resources
@@ -38,6 +40,9 @@ class NodeEntry:
         self.index = index
         self.store_name = store_name
         self.transfer_port = transfer_port
+        # Provider-assigned node id (autoscaler namespace); "" for nodes the
+        # autoscaler didn't launch.
+        self.label = label
 
 
 class GcsServer:
@@ -120,7 +125,7 @@ class GcsServer:
                 {"node_id": n.node_id, "address": list(n.address),
                  "resources": n.resources, "available": n.available,
                  "alive": n.alive, "store_name": n.store_name,
-                 "transfer_port": n.transfer_port}
+                 "transfer_port": n.transfer_port, "label": n.label}
                 for n in (self.nodes[nid] for nid in self._node_order)
             ],
             "actors": self.actors,
@@ -131,13 +136,27 @@ class GcsServer:
         }
 
     def _write_snapshot(self) -> None:
-        import os
-        import pickle as _pickle
+        # Runs on the event-loop thread: state must be serialized here, not
+        # in a worker thread, or concurrent mutation of the live dicts can
+        # fail the pickle mid-dump.
+        try:
+            payload = pickle.dumps(self._snapshot_state())
+        except Exception:  # noqa: BLE001
+            return
+        self._write_snapshot_bytes(payload)
 
-        tmp = f"{self.persist_path}.tmp.{os.getpid()}"
+    def _write_snapshot_bytes(self, payload: bytes) -> None:
+        import os
+        import threading
+
+        # Unique per writing thread: the shutdown snapshot (loop thread) can
+        # overlap an in-flight periodic write (to_thread worker); sharing a
+        # tmp name would interleave/clobber.
+        tmp = (f"{self.persist_path}.tmp.{os.getpid()}"
+               f".{threading.get_ident()}")
         try:
             with open(tmp, "wb") as f:
-                _pickle.dump(self._snapshot_state(), f)
+                f.write(payload)
             os.replace(tmp, self.persist_path)  # atomic
         except OSError:
             pass
@@ -154,7 +173,8 @@ class GcsServer:
             entry = NodeEntry(
                 n["node_id"], tuple(n["address"]), n["resources"],
                 index=len(self._node_order), store_name=n["store_name"],
-                transfer_port=n.get("transfer_port", 0))
+                transfer_port=n.get("transfer_port", 0),
+                label=n.get("label", ""))
             entry.available = n["available"]
             entry.alive = n["alive"]
             # Fresh heartbeat deadline: restored nodes must re-prove
@@ -170,7 +190,14 @@ class GcsServer:
     async def _snapshot_loop(self):
         while True:
             await asyncio.sleep(1.0)
-            await asyncio.to_thread(self._write_snapshot)
+            try:
+                # Serialize on the loop thread (consistent view of the live
+                # dicts), hand only the disk IO to a worker thread.
+                payload = pickle.dumps(self._snapshot_state())
+                await asyncio.to_thread(self._write_snapshot_bytes, payload)
+            except Exception:  # noqa: BLE001
+                # One failed snapshot must not end persistence for good.
+                continue
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, data: Dict[str, Any]):
@@ -316,7 +343,8 @@ class GcsServer:
             entry = NodeEntry(node_id, tuple(msg["address"]), msg["resources"],
                               index=len(self._node_order),
                               store_name=msg.get("store_name", ""),
-                              transfer_port=msg.get("transfer_port", 0))
+                              transfer_port=msg.get("transfer_port", 0),
+                              label=msg.get("label", ""))
             self.nodes[node_id] = entry
             self._node_order.append(node_id)
             conn.meta["node_id"] = node_id
@@ -348,7 +376,7 @@ class GcsServer:
                 {"NodeID": n.node_id, "Alive": n.alive,
                  "Resources": n.resources, "Available": n.available,
                  "Address": n.address, "StoreName": n.store_name,
-                 "TransferPort": n.transfer_port}
+                 "TransferPort": n.transfer_port, "Label": n.label}
                 for n in self.nodes.values()
             ]}
 

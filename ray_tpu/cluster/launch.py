@@ -80,14 +80,14 @@ async def run_head(port: int, resources: dict, num_workers: int,
 
 async def run_node(gcs_host: str, gcs_port: int, resources: dict,
                    num_workers: int, worker_env: dict | None = None,
-                   stop_signal=None):
+                   stop_signal=None, label: str = ""):
     from ray_tpu._private.config import get_config
     from ray_tpu.cluster.controller import NodeController
 
     config = get_config()
     node = NodeController(
         config, (gcs_host, gcs_port), resources, num_workers=num_workers,
-        worker_env=worker_env,
+        worker_env=worker_env, label=label,
     )
     port = await node.start()
     print(json.dumps({"event": "node_started", "port": port,
@@ -125,6 +125,8 @@ def main():
     node.add_argument("--resources", default='{"CPU": 4}')
     node.add_argument("--num-workers", type=int, default=2)
     node.add_argument("--worker-env", default="{}")
+    node.add_argument("--label", default="",
+                      help="provider node id for the autoscaler")
 
     args = parser.parse_args()
     worker_env = json.loads(args.worker_env)
@@ -140,7 +142,7 @@ def main():
             host, port = args.gcs.rsplit(":", 1)
             asyncio.run(run_node(
                 host, int(port), json.loads(args.resources),
-                args.num_workers, worker_env=worker_env,
+                args.num_workers, worker_env=worker_env, label=args.label,
             ))
     except KeyboardInterrupt:
         sys.exit(0)

@@ -243,7 +243,6 @@ def forward_pipelined(params: Params, tokens: jax.Array,
     all four axes in a single XLA program (net-new vs the reference, which
     has no pipeline parallelism: SURVEY.md §2.3).
     """
-    B, T = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]          # [B, T, E]
     x = jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P("dp", "sp", None))

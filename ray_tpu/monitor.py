@@ -36,12 +36,16 @@ class Monitor:
         nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
         seen = set()
         for n in nodes:
+            # Autoscaler-launched nodes carry their provider node id as the
+            # GCS label; keying LoadMetrics by it puts idle_ips() in the
+            # same namespace as provider.internal_ip() so idle termination
+            # actually matches. Head/manual nodes fall back to the NodeID.
+            key = n.get("Label") or n["NodeID"]
             if not n["Alive"]:
-                self.load_metrics.mark_dead(n["NodeID"])
+                self.load_metrics.mark_dead(key)
                 continue
-            seen.add(n["NodeID"])
-            self.load_metrics.update(
-                n["NodeID"], n["Resources"], n["Available"])
+            seen.add(key)
+            self.load_metrics.update(key, n["Resources"], n["Available"])
         for ip in list(self.load_metrics.static_resources):
             if ip not in seen:
                 self.load_metrics.mark_dead(ip)
