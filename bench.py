@@ -1,12 +1,20 @@
-"""North-star benchmark: batch placement kernel throughput.
+"""North-star benchmark: batch placement kernel throughput + dispatch latency.
 
-Workload (BASELINE.json): schedule a 100k-task random DAG onto 256 simulated
-nodes. The reference's closest published number is ~6,600 cluster-wide
-scheduled tasks/s (101-node stress test, stage 1 of
+Primary workload (BASELINE.json): schedule a 100k-task random DAG onto 256
+simulated nodes. The reference's closest published number is ~6,600
+cluster-wide scheduled tasks/s (101-node stress test, stage 1 of
 ``ci/regression_test/stress_tests/test_many_tasks.py``; see BASELINE.md).
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "p50_dispatch_latency_ms": N, ...}
+
+Also writes BENCH_DETAIL.json with every BASELINE.json config:
+  - 100k random DAG @ 256 nodes (primary)
+  - 10k no-op fan-out (microbenchmark stage-1 analogue)
+  - 50k linear chain (fully sequential; stresses per-round latency)
+  - 64k map -> 256 reduce with locality hints
+  - p50/p99 single-tick dispatch latency (what a task waits for placement)
 """
 
 import json
@@ -17,56 +25,166 @@ import jax
 import numpy as np
 
 from ray_tpu.scheduler import random_dag, schedule_dag, uniform_cluster
+from ray_tpu.scheduler.dag import fanout_dag
 
 BASELINE_TASKS_PER_SEC = 6600.0  # BASELINE.md stage 1 (~6.6k cluster-wide)
 
 
-def main():
-    num_tasks = 100_000
-    num_nodes = 256
-    chunk = 8192
+def _time_schedule(demand, parents, avail, *, chunk, locality=None, reps=5,
+                   max_rounds=0):
+    demand = jax.device_put(np.asarray(demand))
+    parents = jax.device_put(np.asarray(parents))
+    avail_d = jax.device_put(np.asarray(avail))
+    loc = None if locality is None else jax.device_put(np.asarray(locality))
 
-    # Classic uniform random DAG (parents drawn from all predecessors);
-    # critical-path depth ~60 at this size. The windowed variant
-    # (parent_window=1024, depth ~374) is a harder secondary config — see
-    # tests/test_scheduler.py.
-    demand_np, parents_np = random_dag(
-        num_tasks, max_parents=3, parent_window=num_tasks, seed=0
-    )
-    avail_np = uniform_cluster(num_nodes, cpu=16.0)
+    placement, rounds = schedule_dag(
+        demand, parents, avail_d, jax.random.PRNGKey(0), locality=loc,
+        chunk=chunk, max_rounds=max_rounds)
+    np.asarray(placement)  # warmup/compile barrier
 
-    demand = jax.device_put(np.asarray(demand_np))
-    parents = jax.device_put(np.asarray(parents_np))
-    avail = jax.device_put(np.asarray(avail_np))
-    key = jax.random.PRNGKey(0)
-
-    # Warmup/compile.
-    placement, rounds = schedule_dag(demand, parents, avail, key, chunk=chunk)
-    placement.block_until_ready()
-    n_placed = int((np.asarray(placement) >= 0).sum())
-    if n_placed != num_tasks:
-        print(f"WARNING: only {n_placed}/{num_tasks} tasks placed", file=sys.stderr)
-
-    reps = 5
     times = []
     for i in range(reps):
         k = jax.random.PRNGKey(i)
         t0 = time.perf_counter()
-        placement, rounds = schedule_dag(demand, parents, avail, k, chunk=chunk)
-        # Host transfer as the completion barrier (block_until_ready alone is
-        # not reliable on the axon platform).
-        np.asarray(placement)
+        placement, rounds = schedule_dag(
+            demand, parents, avail_d, k, locality=loc, chunk=chunk,
+            max_rounds=max_rounds)
+        # Host transfer as the completion barrier (block_until_ready alone
+        # is not reliable on the axon platform).
+        placement_np = np.asarray(placement)
         times.append(time.perf_counter() - t0)
+    return min(times), placement_np, int(np.asarray(rounds))
 
-    best = min(times)
-    tasks_per_sec = num_tasks / best
+
+def bench_random_dag():
+    num_tasks, num_nodes = 100_000, 256
+    demand, parents = random_dag(
+        num_tasks, max_parents=3, parent_window=num_tasks, seed=0)
+    avail = uniform_cluster(num_nodes, cpu=16.0)
+    best, placement, rounds = _time_schedule(
+        demand, parents, avail, chunk=8192)
+    placed = int((placement >= 0).sum())
+    if placed != num_tasks:
+        print(f"WARNING: only {placed}/{num_tasks} placed", file=sys.stderr)
+    return {"tasks_per_sec": round(num_tasks / best, 1),
+            "wall_s": round(best, 4), "rounds": rounds}
+
+
+def bench_fanout():
+    num_tasks, num_nodes = 10_000, 256
+    demand, parents = fanout_dag(num_tasks, cpu=1.0)
+    avail = uniform_cluster(num_nodes, cpu=16.0)
+    best, placement, rounds = _time_schedule(
+        demand, parents, avail, chunk=8192)
+    return {"tasks_per_sec": round(num_tasks / best, 1),
+            "wall_s": round(best, 4), "rounds": rounds}
+
+
+def bench_linear_chain():
+    """50k tasks, each depending on the previous one: zero parallelism, so
+    this measures pure per-round latency (one task places per round).
+
+    Run in 5k-task segments — a chain segment's head has no intra-segment
+    parent, so segments chain correctly — because a single 50k-round
+    while_loop program exceeds the remote-TPU watchdog."""
+    num_tasks, num_nodes, seg = 50_000, 256, 5_000
+    avail = uniform_cluster(num_nodes, cpu=16.0)[:, :1]
+    avail_d = jax.device_put(np.asarray(avail))
+    demand = jax.device_put(np.full((seg, 1), 1000, np.int32))
+    parents = jax.device_put(
+        (np.arange(seg, dtype=np.int32) - 1).reshape(-1, 1))
+
+    placement, _ = schedule_dag(
+        demand, parents, avail_d, jax.random.PRNGKey(0), chunk=8)
+    np.asarray(placement)  # warmup/compile
+
+    placed = 0
+    t0 = time.perf_counter()
+    for i in range(num_tasks // seg):
+        placement, _ = schedule_dag(
+            demand, parents, avail_d, jax.random.PRNGKey(i), chunk=8)
+        placed += int((np.asarray(placement) >= 0).sum())
+    wall = time.perf_counter() - t0
+    return {"tasks_per_sec": round(num_tasks / wall, 1),
+            "wall_s": round(wall, 4), "rounds": num_tasks,
+            "placed": placed,
+            "per_round_us": round(wall / num_tasks * 1e6, 2)}
+
+
+def bench_mapreduce_locality():
+    """64k map tasks then 256 reduce tasks; each reduce carries a locality
+    hint and depends on 250 maps (object-locality constraint analogue)."""
+    n_map, n_reduce, num_nodes = 64_000, 256, 256
+    fan_in = n_map // n_reduce
+    T = n_map + n_reduce
+    demand = np.full((T, 1), 1000, np.int32)
+    parents = np.full((T, fan_in), -1, np.int32)
+    for r in range(n_reduce):
+        parents[n_map + r] = np.arange(r * fan_in, (r + 1) * fan_in)
+    locality = np.full((T,), -1, np.int32)
+    locality[n_map:] = np.arange(n_reduce) % num_nodes
+    avail = uniform_cluster(num_nodes, cpu=300.0)[:, :1]
+    best, placement, rounds = _time_schedule(
+        demand, parents, avail, chunk=8192, locality=locality)
+    hit = float((placement[n_map:] == locality[n_map:]).mean())
+    return {"tasks_per_sec": round(T / best, 1),
+            "wall_s": round(best, 4), "rounds": rounds,
+            "locality_hit_rate": round(hit, 4)}
+
+
+def bench_dispatch_latency():
+    """Latency of one placement tick at a typical control-plane batch size:
+    the time a submitted task waits for its placement decision."""
+    from ray_tpu.scheduler.kernel import BatchScheduler
+
+    num_nodes, batch = 256, 1024
+    avail = uniform_cluster(num_nodes, cpu=16.0)
+    sched = BatchScheduler(np.asarray(avail), seed=0, chunk=batch)
+    demand = np.full((batch, avail.shape[1]), 1000, np.int32)
+    sched.place(demand)  # compile
+    lat = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        sched.place(demand)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return {"batch": batch,
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[-1] * 1e3, 3),  # max of 50 samples
+            "per_task_us_p50": round(lat[len(lat) // 2] / batch * 1e6, 3)}
+
+
+def main():
+    primary = bench_random_dag()
+    latency = bench_dispatch_latency()
+    detail = {
+        "backend": jax.default_backend(),
+        "kernel_100k_random_dag_256_nodes": primary,
+        "kernel_10k_noop_fanout": bench_fanout(),
+        "kernel_50k_linear_chain": bench_linear_chain(),
+        "kernel_64k_mapreduce_locality": bench_mapreduce_locality(),
+        "dispatch_latency_tick": latency,
+    }
+    try:
+        with open("BENCH_DETAIL.json", "w") as f:
+            json.dump(detail, f, indent=2)
+    except OSError:
+        pass
+    for name, d in detail.items():
+        if isinstance(d, dict):
+            print(f"# {name}: {d}", file=sys.stderr)
+
+    tasks_per_sec = primary["tasks_per_sec"]
     print(json.dumps({
         "metric": "scheduled_tasks_per_sec_100k_dag_256_nodes",
-        "value": round(tasks_per_sec, 1),
+        "value": tasks_per_sec,
         "unit": "tasks/s",
         "vs_baseline": round(tasks_per_sec / BASELINE_TASKS_PER_SEC, 2),
+        "p50_dispatch_latency_ms": latency["p50_ms"],
     }))
 
 
 if __name__ == "__main__":
     main()
+
+

@@ -44,6 +44,11 @@ class WorkerHandle:
         self.actor_id: Optional[bytes] = None
         self.current_task: Optional[Dict] = None
         self.ready = asyncio.Event()
+        self.killed_deliberately = False  # ray.kill: suppress restart
+        # Actor method calls in flight on this worker, keyed by first return
+        # id: on worker death every one of them must be failed (plain tasks
+        # use current_task — at most one at a time).
+        self.inflight: Dict[bytes, Dict] = {}
 
 
 class NodeController:
@@ -94,6 +99,8 @@ class NodeController:
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
+        self._cancelled: Set[bytes] = set()  # task_ids cancelled pre-dispatch
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._register_handlers()
 
     def _spawn_bg(self, coro) -> None:
@@ -112,7 +119,12 @@ class NodeController:
     async def start(self) -> int:
         port = await self.server.start()
         self.address = (self.server.host, port)
-        self._gcs = ResilientClient(*self.gcs_addr)
+        self._loop = asyncio.get_running_loop()
+        # The GCS pushes dispatches (assign_task/create_actor/cancel_task)
+        # over this same connection; the reader thread hops them onto the
+        # event loop (reference: raylet receiving leases over its GCS link).
+        self._gcs = ResilientClient(*self.gcs_addr,
+                                    push_handler=self._on_gcs_push)
         self._gcs.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
@@ -219,10 +231,16 @@ class NodeController:
                             f"worker died executing task (exit "
                             f"{w.proc.returncode})", crashed=True,
                         )
+                    for call in list(w.inflight.values()):
+                        await self._fail_actor_call(call)
+                    w.inflight.clear()
                     if w.actor_id is not None:
-                        self._gcs.call({
+                        # A crash report: the GCS transitions to RESTARTING
+                        # when max_restarts allows, DEAD otherwise.
+                        await asyncio.to_thread(self._gcs.call, {
                             "type": "update_actor",
                             "actor_id": w.actor_id, "state": "DEAD",
+                            "no_restart": w.killed_deliberately,
                         })
                     if not self._shutting_down:
                         self._spawn_worker()
@@ -299,6 +317,10 @@ class NodeController:
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": min(5.0, timeout),
             })
+            if resp.get("error_blob") is not None:
+                # The producing task failed terminally: the error blob is
+                # the object (consumers raise it on deserialize).
+                return resp["error_blob"]
             blob = self._local_blob(oid)
             if blob is not None:
                 return blob
@@ -362,16 +384,43 @@ class NodeController:
                 pass
 
     async def _fail_task(self, task: Dict, message: str, crashed: bool = False):
+        """Report a failed task to the GCS task table; the GCS decides
+        between resubmission (max_retries, reference task_manager.h:57) and
+        terminal failure. Only terminal failures store error blobs here."""
         import pickle
 
         from ..exceptions import ClusterUnavailableError, WorkerCrashedError
 
-        err = (WorkerCrashedError(message) if crashed
-               else ClusterUnavailableError(message))
-        blob = ERR_PREFIX + pickle.dumps(err)
+        will_retry = False
+        error_blob: Optional[bytes] = None
+        task_id = task.get("task_id")
+        self._cancelled.discard(task_id)  # terminal either way: don't leak
+        reported = False
+        if task_id is not None and self._gcs is not None:
+            try:
+                resp = await asyncio.to_thread(self._gcs.call, {
+                    "type": "task_failed", "task_id": task_id,
+                    "node_id": self.node_id,
+                    "resources": task.get("resources", {}),
+                    "error": message,
+                })
+                reported = True
+                will_retry = resp.get("will_retry", False)
+                error_blob = resp.get("error_blob")
+            except Exception:  # noqa: BLE001 - GCS unreachable: fail locally
+                pass
+        if reported:
+            task["released"] = True  # task_failed released the resources
+        else:
+            await self._release(task)
+        if will_retry:
+            return
+        if error_blob is None:
+            err = (WorkerCrashedError(message) if crashed
+                   else ClusterUnavailableError(message))
+            error_blob = ERR_PREFIX + pickle.dumps(err)
         for oid in task["return_ids"]:
-            await self._store_put(oid, blob)
-        await self._release(task)
+            await self._store_put(oid, error_blob)
 
     async def _release(self, task: Dict):
         if task.get("released"):
@@ -379,11 +428,41 @@ class NodeController:
         task["released"] = True
         try:
             self._gcs.send_oneway({
-                "type": "release_resources", "node_id": self.node_id,
+                "type": "task_done", "node_id": self.node_id,
+                "task_id": task.get("task_id"),
                 "resources": task.get("resources", {}),
             })
         except ConnectionError:
             pass
+
+    def _on_gcs_push(self, msg: Dict) -> None:
+        """Runs on the GCS client's reader thread: hop to the loop."""
+        if self._loop is None or self._loop.is_closed():
+            return
+        mtype = msg.get("type")
+        if mtype == "assign_task":
+            coro = self._run_task(_payload(msg))
+        elif mtype == "create_actor":
+            coro = self._create_actor(_payload(msg))
+        elif mtype == "cancel_task":
+            coro = self._cancel_task(msg["task_id"], msg.get("force", False))
+        elif mtype == "pubsub":
+            return
+        else:
+            return
+        self._loop.call_soon_threadsafe(lambda: self._spawn_bg(coro))
+
+    async def _cancel_task(self, task_id: bytes, force: bool) -> None:
+        """Cancel a GCS-dispatched task on this node: pre-dispatch tasks are
+        flagged (the dep-staging path checks), running ones lose their worker
+        (reference: CoreWorker::KillActor/CancelTask semantics — the interrupt
+        is process-level; the worker pool respawns)."""
+        self._cancelled.add(task_id)
+        for w in self.workers.values():
+            task = w.current_task
+            if task is not None and task.get("task_id") == task_id \
+                    and w.proc.poll() is None:
+                w.proc.kill()
 
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
@@ -411,6 +490,8 @@ class NodeController:
             pid = conn.meta.get("worker_pid")
             w = self.workers.get(pid)
             if w is not None:
+                for rid in msg.get("return_ids", []):
+                    w.inflight.pop(rid, None)
                 task = w.current_task
                 w.current_task = None
                 if w.actor_id is None:
@@ -488,6 +569,7 @@ class NodeController:
         async def kill_actor(msg, conn):
             worker = self._actor_worker(msg["actor_id"])
             if worker is not None:
+                worker.killed_deliberately = msg.get("no_restart", True)
                 worker.proc.terminate()
                 task = {"return_ids": [], "resources": msg.get("resources", {})}
                 await self._release(task)
@@ -508,12 +590,19 @@ class NodeController:
                     ]}
 
     async def _actor_dispatch_loop(self, actor_id: bytes, q: "asyncio.Queue"):
-        """Stage deps and forward actor calls strictly in arrival order."""
+        """Stage deps and forward actor calls strictly in arrival order.
+
+        When the local worker is gone the GCS actor table decides: a
+        RESTARTING actor is awaited, one that came back ALIVE on another
+        node has its queued calls forwarded there (restart spillover), and a
+        DEAD one fails the call."""
         while True:
             msg = await q.get()
             worker = self._actor_worker(actor_id)
             if worker is None:
-                await self._fail_actor_call(msg)
+                routed = await self._route_actor_call(actor_id, msg)
+                if not routed:
+                    await self._fail_actor_call(msg)
                 continue
             try:
                 for oid in msg.get("deps", []):
@@ -521,7 +610,46 @@ class NodeController:
             except Exception:  # noqa: BLE001 - dep fetch failed: fail the call
                 await self._fail_actor_call(msg)
                 continue
+            if msg.get("return_ids"):
+                worker.inflight[msg["return_ids"][0]] = msg
             await worker.conn.send(dict(msg, type="execute_actor_task"))
+
+    async def _route_actor_call(self, actor_id: bytes, msg: Dict) -> bool:
+        """No local worker for the actor: wait out a restart, then execute
+        locally or forward to its new home. Returns False when the actor is
+        truly dead."""
+        try:
+            info = await asyncio.to_thread(self._gcs.call, {
+                "type": "get_actor", "actor_id": actor_id, "timeout": 30.0,
+            }, 45.0)
+        except Exception:  # noqa: BLE001
+            return False
+        if info.get("state") != "ALIVE" or not info.get("address"):
+            return False
+        addr = tuple(info["address"])
+        if addr == self.address:
+            # Restarted here: the fresh worker registers momentarily.
+            for _ in range(100):
+                worker = self._actor_worker(actor_id)
+                if worker is not None:
+                    try:
+                        for oid in msg.get("deps", []):
+                            await self._store_get(oid)
+                    except Exception:  # noqa: BLE001
+                        return False
+                    if msg.get("return_ids"):
+                        worker.inflight[msg["return_ids"][0]] = msg
+                    await worker.conn.send(
+                        dict(msg, type="execute_actor_task"))
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+        try:
+            await asyncio.to_thread(
+                self._peer(addr).call, dict(msg, type="actor_call"))
+            return True
+        except Exception:  # noqa: BLE001
+            return False
 
     def _actor_worker(self, actor_id: bytes) -> Optional[WorkerHandle]:
         for w in self.workers.values():
@@ -546,6 +674,12 @@ class NodeController:
             worker = await self._pop_idle_worker()
         except Exception as e:  # noqa: BLE001
             await self._fail_task(task, f"dispatch failed: {e}")
+            return
+        if task.get("task_id") in self._cancelled:
+            self._cancelled.discard(task["task_id"])
+            await self._fail_task(task, "task cancelled before dispatch")
+            worker.idle = True
+            self._idle_event.set()
             return
         worker.current_task = task
         await worker.conn.send(dict(task, type="execute_task"))

@@ -186,12 +186,16 @@ class ClusterCoreWorker:
             f"could not deliver task after {attempts} placements: {last_err}")
 
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
+        """Submit to the GCS task table; the GCS owns placement, dispatch,
+        and retry from here (reference: owner TaskManager + raylet lease,
+        collapsed into the central service that already runs the placement
+        kernel)."""
         fn_id = self._export_fn(fn)
         args, kwargs, deps = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
         resources = spec.resources.to_dict()
-        self._place_and_send(resources, {
-            "type": "assign_task",
+        self.gcs.call({
+            "type": "submit_task",
             "task_id": spec.task_id.binary(),
             "name": spec.function.repr_name,
             "fn_id": fn_id, "args": args, "kwargs": kwargs,
@@ -204,11 +208,6 @@ class ClusterCoreWorker:
     def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
         actor_id = spec.actor_id
         methods = tuple(n for n in dir(cls) if not n.startswith("_"))
-        resp = self.gcs.call({
-            "type": "register_actor", "actor_id": actor_id.binary(),
-            "name": spec.name, "class_name": cls.__name__,
-            "module": cls.__module__, "methods": methods,
-        })
         fn_id = self._export_fn(cls)
         packed_args = []
         deps = []
@@ -227,15 +226,16 @@ class ClusterCoreWorker:
                 packed_kwargs[key] = self._pack_value(val)
         resources = spec.resources.to_dict()
         self._actor_resources[actor_id.binary()] = resources
-        placement = self._place_and_send(resources, {
+        self.gcs.call({
             "type": "create_actor", "actor_id": actor_id.binary(),
+            "name": spec.name, "class_name": cls.__name__,
+            "module": cls.__module__, "methods": methods,
             "fn_id": fn_id, "args": packed_args, "kwargs": packed_kwargs,
             "deps": deps,
             "return_ids": [spec.return_ids()[0].binary()],
             "resources": resources,
-            "name": spec.name,
+            "max_restarts": spec.max_restarts,
         })
-        self._actor_addr_cache[actor_id.binary()] = tuple(placement["address"])
         return actor_id
 
     def _actor_address(self, actor_id: bytes) -> Optional[Tuple[str, int]]:
@@ -251,21 +251,61 @@ class ClusterCoreWorker:
         actor_id = spec.actor_id.binary()
         args, kwargs, deps = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
-        addr = self._actor_address(actor_id)
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
-        if addr is None:
-            self._store_error_blobs(
-                return_ids, ActorDiedError(spec.actor_id.hex()[:12])
-            )
-            return refs
-        node = self._controller(addr)
-        node.call({
+        msg = {
             "type": "actor_call", "actor_id": actor_id,
             "method": spec.function.qualname,
             "args": args, "kwargs": kwargs, "deps": deps,
             "return_ids": return_ids,
             "name": spec.function.repr_name,
-        })
+        }
+        # Fast path: the cached address (no GCS round trip per call). Only
+        # on a miss/failure do we fall into the resolve loop below.
+        cached = self._actor_addr_cache.get(actor_id)
+        if cached is not None:
+            try:
+                self._controller(cached).call(msg)
+                return refs
+            except (ConnectionError, OSError, TimeoutError, RuntimeError):
+                self._actor_addr_cache.pop(actor_id, None)
+                self._controllers.pop(cached, None)
+        # The actor may be restarting or have moved nodes: resolve its
+        # address fresh per attempt (reference: handles learn the new
+        # address via the actor pubsub channel). An unreachable home node is
+        # reported dead so the GCS starts the restart instead of waiting out
+        # the heartbeat timeout.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                info = self.gcs.call({"type": "get_actor",
+                                      "actor_id": actor_id, "timeout": 30.0},
+                                     timeout=45.0)
+            except (ConnectionError, OSError, TimeoutError, RuntimeError):
+                break
+            state = info.get("state")
+            if state == "DEAD":
+                break
+            if state != "ALIVE" or not info.get("address"):
+                time.sleep(0.1)     # still PENDING/RESTARTING past the wait
+                continue
+            addr = tuple(info["address"])
+            self._actor_addr_cache[actor_id] = addr
+            try:
+                self._controller(addr).call(msg)
+                return refs
+            except (ConnectionError, OSError, TimeoutError):
+                self._actor_addr_cache.pop(actor_id, None)
+                self._controllers.pop(addr, None)
+                if info.get("node_id"):
+                    try:
+                        self.gcs.call({"type": "report_node_dead",
+                                       "node_id": info["node_id"]})
+                    except (ConnectionError, OSError):
+                        pass
+                time.sleep(0.2)
+        self._store_error_blobs(
+            return_ids, ActorDiedError(spec.actor_id.hex()[:12])
+        )
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -274,10 +314,12 @@ class ClusterCoreWorker:
         if addr is not None:
             self._controller(addr).call({
                 "type": "kill_actor", "actor_id": actor_id.binary(),
-                "resources": resources,
+                "resources": resources, "no_restart": no_restart,
             })
-        self.gcs.call({"type": "update_actor", "actor_id": actor_id.binary(),
-                       "state": "DEAD"})
+        if no_restart:
+            self.gcs.call({"type": "update_actor",
+                           "actor_id": actor_id.binary(),
+                           "state": "DEAD", "no_restart": True})
         self._actor_addr_cache.pop(actor_id.binary(), None)
 
     def get_actor(self, name: str) -> ActorID:
@@ -375,6 +417,10 @@ class ClusterCoreWorker:
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": step,
             }, timeout=step + 30.0)
+            if resp.get("error_blob") is not None:
+                # Terminal task failure recorded in the GCS task table
+                # (retries exhausted / cancelled): no node holds a copy.
+                return resp["error_blob"]
             transfer = resp.get("transfer_addresses", [])
             for i, addr in enumerate(resp.get("addresses", [])):
                 # Native plane first: bulk bytes move C-to-C, GIL released.
@@ -427,7 +473,7 @@ class ClusterCoreWorker:
                     "type": "get_object_locations", "object_id": oid,
                     "wait": False,
                 })
-                if resp.get("locations"):
+                if resp.get("locations") or resp.get("error_blob") is not None:
                     ready.add(oid)
             expired = deadline is not None and time.monotonic() >= deadline
             if len(ready) >= num_returns or expired:
@@ -454,7 +500,11 @@ class ClusterCoreWorker:
         return fut
 
     def cancel(self, ref: ObjectRef, force: bool = False):
-        pass  # cooperative cancel lands with the lineage/retry rework
+        """Cancel the task producing ``ref`` (reference:
+        core_worker.h:588-595): queued tasks fail immediately at the GCS,
+        dispatched ones are interrupted on their node."""
+        self.gcs.call({"type": "cancel_task",
+                       "object_id": ref.id.binary(), "force": force})
 
     # ------------------------------------------------------------------ state
     def cluster_resources(self) -> Dict[str, float]:

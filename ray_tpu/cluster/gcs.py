@@ -68,6 +68,17 @@ class GcsServer:
         from collections import deque as _deque
 
         self.profile_events: Any = _deque(maxlen=200_000)  # chrome-trace spans
+        # ---- GCS-owned task lifecycle (reference: owner-side TaskManager
+        # task_manager.h:57 + lineage; centralized here because placement
+        # already is). task_table: task_id -> record; lineage: object_id ->
+        # producing task_id; error_objects: terminal error blobs served
+        # straight from the directory.
+        self.task_table: Dict[bytes, Dict[str, Any]] = {}
+        self.lineage: Dict[bytes, bytes] = {}
+        self.error_objects: Dict[bytes, bytes] = {}
+        self._error_order: Any = _deque()
+        self._finished_order: Any = _deque()
+        self._node_conns: Dict[str, Connection] = {}
         self._place_event = asyncio.Event()
         self._seed = 0
         self._tasks: List[asyncio.Task] = []
@@ -104,6 +115,20 @@ class GcsServer:
         if self.persist_path:
             self._load_snapshot()
         port = await self.server.start()
+        # Tasks restored mid-flight re-enter the placement queue; DISPATCHED
+        # ones stay put — their node either reports done/failed or dies, and
+        # both paths re-drive them.
+        for rec in self.task_table.values():
+            if rec["state"] == "DISPATCHED":
+                node = self.nodes.get(rec["node_id"])
+                if node is None or not node.alive:
+                    # Snapshot caught the record mid-flight on a node that
+                    # is already gone: no death transition will ever fire
+                    # for it again, so re-drive now.
+                    rec["state"] = "PENDING"
+                    rec["node_id"] = None
+            if rec["state"] == "PENDING":
+                self._spawn(self._drive_task(rec))
         self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
         self._tasks.append(asyncio.create_task(self._placement_loop()))
         if self.persist_path:
@@ -133,6 +158,9 @@ class GcsServer:
             "objects": self.objects,
             "functions": self.functions,
             "kv": self.kv,
+            "task_table": self.task_table,
+            "lineage": self.lineage,
+            "error_objects": self.error_objects,
         }
 
     def _write_snapshot(self) -> None:
@@ -186,6 +214,14 @@ class GcsServer:
         self.objects = state.get("objects", {})
         self.functions = state.get("functions", {})
         self.kv = state.get("kv", {})
+        self.task_table = state.get("task_table", {})
+        self.lineage = state.get("lineage", {})
+        self.error_objects = state.get("error_objects", {})
+        for oid in self.error_objects:
+            self._error_order.append(oid)
+        for tid, rec in self.task_table.items():
+            if rec["state"] == "FINISHED":
+                self._finished_order.append(tid)
 
     async def _snapshot_loop(self):
         while True:
@@ -198,6 +234,231 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 # One failed snapshot must not end persistence for good.
                 continue
+
+    # ----------------------------------------------------- task lifecycle
+    def _spawn(self, coro) -> None:
+        task = asyncio.create_task(coro)
+        self._bg.add(task)
+
+        def done(t: asyncio.Task):
+            self._bg.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                import traceback
+
+                traceback.print_exception(t.exception())
+
+        task.add_done_callback(done)
+
+    def _enqueue_task(self, payload: Dict[str, Any], kind: str,
+                      retries: int) -> Dict[str, Any]:
+        """Record a task/actor-creation spec and start driving it to a node.
+
+        The record IS the lineage entry: while retained, any lost return
+        object can be re-created by re-dispatching the payload
+        (reference: lineage_cache.h:30, object_recovery_manager.h:35).
+        """
+        task_id = payload["task_id"]
+        rec = {
+            "task_id": task_id, "payload": payload, "kind": kind,
+            "resources": payload.get("resources", {}),
+            "retries_left": retries, "state": "PENDING",
+            "node_id": None, "cancelled": False,
+            "return_ids": list(payload.get("return_ids", [])),
+        }
+        self.task_table[task_id] = rec
+        for oid in rec["return_ids"]:
+            self.lineage[oid] = task_id
+            # A resubmitted/restarted producer supersedes any old error.
+            self.error_objects.pop(oid, None)
+        self._spawn(self._drive_task(rec))
+        return rec
+
+    def _dep_alive(self, oid: bytes) -> bool:
+        entry = self.objects.get(oid)
+        return bool(entry) and any(
+            n in self.nodes and self.nodes[n].alive
+            for n in entry["locations"]
+        )
+
+    async def _wait_deps(self, rec: Dict[str, Any]) -> bool:
+        """Hold the task un-placed until every dependency has a live copy,
+        recovering lost ones from lineage. Mirrors the reference's WAITING
+        queue: resources are never held while deps are missing — otherwise a
+        recovered consumer can occupy the slot its producer needs (deadlock).
+        Returns False when a dep failed terminally (error propagated)."""
+        for oid in rec["payload"].get("deps", []):
+            while not self._dep_alive(oid):
+                if rec["cancelled"]:
+                    self._fail_record(rec, self._cancel_error(rec))
+                    return False
+                blob = self.error_objects.get(oid)
+                if blob is not None:
+                    # Dependency failed: propagate its error to our returns.
+                    self._fail_record(rec, blob=blob)
+                    return False
+                self._maybe_recover_object(oid)
+                ev = asyncio.Event()
+                self._object_waiters.setdefault(oid, []).append(ev)
+                try:
+                    await asyncio.wait_for(ev.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+        return True
+
+    async def _drive_task(self, rec: Dict[str, Any]) -> None:
+        """Place the record with the batch kernel, then push the dispatch to
+        the granted node; infeasible records wait (feeding the autoscaler's
+        pending-demand view) and node failures re-place."""
+        demand = ResourceSet.from_dict(rec["resources"])
+        token = object()
+        try:
+            while True:
+                if rec["cancelled"]:
+                    self._fail_record(rec, self._cancel_error(rec))
+                    return
+                if not await self._wait_deps(rec):
+                    return
+                fut = asyncio.get_event_loop().create_future()
+                self._pending_place.append(
+                    (demand, rec["payload"].get("locality"), fut))
+                self._place_event.set()
+                nid = await fut
+                if nid is None:
+                    self._unplaceable[token] = demand.to_dict()
+                    await asyncio.sleep(0.02)
+                    continue
+                self._unplaceable.pop(token, None)
+                if rec["cancelled"]:
+                    # Cancelled while awaiting the grant: give the share
+                    # back; cancel_task already served the error.
+                    self._release(nid, rec["resources"])
+                    if rec["state"] != "FAILED":
+                        self._fail_record(rec, self._cancel_error(rec))
+                    return
+                rec["node_id"] = nid
+                rec["state"] = "DISPATCHED"
+                if await self._dispatch_to_node(nid, rec):
+                    return
+                # Node vanished between grant and send: put its share back
+                # and replace.
+                self._release(nid, rec["resources"])
+                rec["state"] = "PENDING"
+        finally:
+            self._unplaceable.pop(token, None)
+
+    async def _dispatch_to_node(self, node_id: str, rec: Dict[str, Any]) -> bool:
+        """Push the dispatch over the node's registered GCS connection."""
+        mtype = "assign_task" if rec["kind"] == "task" else "create_actor"
+        for _ in range(20):
+            conn = self._node_conns.get(node_id)
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return False
+            if conn is not None:
+                try:
+                    await conn.send(dict(rec["payload"], type=mtype))
+                    return True
+                except Exception:  # noqa: BLE001 - conn died; maybe rebound
+                    self._node_conns.pop(node_id, None)
+            # The controller re-dials on its next heartbeat; wait briefly.
+            await asyncio.sleep(0.05)
+        return False
+
+    def _cancel_error(self, rec: Dict[str, Any]):
+        from ..exceptions import TaskCancelledError
+
+        return TaskCancelledError(rec["task_id"].hex()[:16])
+
+    def _fail_record(self, rec: Dict[str, Any],
+                     err: Optional[BaseException] = None,
+                     blob: Optional[bytes] = None) -> None:
+        """Terminal failure: serve the error straight from the directory."""
+        rec["state"] = "FAILED"
+        if blob is None:
+            blob = b"E" + pickle.dumps(err)
+        for oid in rec["return_ids"]:
+            self.error_objects[oid] = blob
+            self._error_order.append(oid)
+            for ev in self._object_waiters.pop(oid, []):
+                ev.set()
+        while len(self._error_order) > 100_000:
+            self.error_objects.pop(self._error_order.popleft(), None)
+
+    def _finish_record(self, task_id: bytes) -> None:
+        rec = self.task_table.get(task_id)
+        if rec is None:
+            return
+        rec["state"] = "FINISHED"
+        if rec["kind"] == "actor":
+            # The creation record doubles as restart lineage; it is dropped
+            # when the actor goes terminally DEAD, not by the eviction cap.
+            return
+        self._finished_order.append(task_id)
+        # Bound lineage growth (reference: max_lineage_size
+        # ray_config_def.h:157): evict oldest finished records.
+        cap = getattr(self.config, "max_lineage_size", 20_000)
+        while len(self._finished_order) > cap:
+            old_tid = self._finished_order.popleft()
+            old = self.task_table.get(old_tid)
+            if old is None or old["state"] != "FINISHED":
+                continue
+            del self.task_table[old_tid]
+            for oid in old["return_ids"]:
+                if self.lineage.get(oid) == old_tid:
+                    del self.lineage[oid]
+
+    def _maybe_recover_object(self, oid: bytes) -> bool:
+        """A wanted object has no live copy: re-execute its producing task
+        from lineage (reference: ReconstructionPolicy + ObjectRecovery)."""
+        task_id = self.lineage.get(oid)
+        rec = self.task_table.get(task_id) if task_id else None
+        if rec is None or rec["cancelled"]:
+            return False
+        if rec["state"] == "FINISHED":
+            rec["state"] = "PENDING"
+            rec["node_id"] = None
+            self._spawn(self._drive_task(rec))
+            return True
+        # PENDING/DISPATCHED: already in flight; FAILED: error served.
+        return rec["state"] in ("PENDING", "DISPATCHED")
+
+    async def _actor_died(self, actor_id, info: Dict[str, Any],
+                          no_restart: bool) -> None:
+        """RESTARTING/DEAD transition (reference: gcs_actor_manager.h:116)."""
+        if info["state"] == "DEAD":
+            return  # already terminal (e.g. explicit kill raced the reaper)
+        rec = self.task_table.get(actor_id)
+        restarts = rec["retries_left"] if rec else 0
+        if no_restart or rec is None or restarts == 0:
+            info["state"] = "DEAD"
+            if rec is not None:
+                if rec["state"] != "FINISHED":
+                    from ..exceptions import ActorDiedError
+
+                    # Creation never completed: unblock creation-ref waiters.
+                    self._fail_record(
+                        rec, ActorDiedError(actor_id.hex()[:12]))
+                self.task_table.pop(actor_id, None)
+                for oid in rec["return_ids"]:
+                    if self.lineage.get(oid) == actor_id:
+                        del self.lineage[oid]
+            await self.publish(
+                "actors", {"actor_id": actor_id, "state": "DEAD"})
+            return
+        if restarts > 0:             # -1 = infinite restarts
+            rec["retries_left"] = restarts - 1
+        info["state"] = "RESTARTING"
+        info["node_id"] = None
+        info["address"] = None
+        await self.publish(
+            "actors", {"actor_id": actor_id, "state": "RESTARTING"})
+        payload = rec["payload"]
+        payload["restart_count"] = payload.get("restart_count", 0) + 1
+        rec["state"] = "PENDING"
+        rec["node_id"] = None
+        for oid in rec["return_ids"]:
+            self.error_objects.pop(oid, None)
+        self._spawn(self._drive_task(rec))
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, data: Dict[str, Any]):
@@ -224,15 +485,40 @@ class GcsServer:
                     await self._on_node_death(node)
 
     async def _on_node_death(self, node: NodeEntry):
-        # Drop object locations on the dead node; fail actors homed there.
+        # Drop object locations on the dead node; recover/retry what it
+        # was running; restart actors homed there.
+        self._node_conns.pop(node.node_id, None)
         for oid, entry in list(self.objects.items()):
             entry["locations"].discard(node.node_id)
             if not entry["locations"]:
                 del self.objects[oid]
-        for actor_id, info in self.actors.items():
-            if info.get("node_id") == node.node_id and info["state"] == "ALIVE":
-                info["state"] = "DEAD"
-                await self.publish("actors", {"actor_id": actor_id, "state": "DEAD"})
+        for rec in list(self.task_table.values()):
+            if rec["state"] != "DISPATCHED" or rec["node_id"] != node.node_id:
+                continue
+            if rec["kind"] == "actor":
+                # Creation in flight on the dead node: restart or fail it
+                # (ALIVE actors are handled through the actor table below).
+                info = self.actors.get(rec["task_id"])
+                if info is not None:
+                    await self._actor_died(rec["task_id"], info,
+                                           no_restart=False)
+                continue
+            if rec["cancelled"]:
+                self._fail_record(rec, self._cancel_error(rec))
+            elif rec["retries_left"] != 0:
+                if rec["retries_left"] > 0:
+                    rec["retries_left"] -= 1
+                rec["state"] = "PENDING"
+                rec["node_id"] = None
+                self._spawn(self._drive_task(rec))
+            else:
+                from ..exceptions import WorkerCrashedError
+
+                self._fail_record(rec, WorkerCrashedError(
+                    f"node {node.node_id[:8]} died executing task"))
+        for actor_id, info in list(self.actors.items()):
+            if info.get("node_id") == node.node_id and                     info["state"] in ("ALIVE", "PENDING"):
+                await self._actor_died(actor_id, info, no_restart=False)
         await self.publish("nodes", {"node_id": node.node_id, "state": "DEAD"})
 
     # -------------------------------------------------------------- placement
@@ -348,6 +634,7 @@ class GcsServer:
             self.nodes[node_id] = entry
             self._node_order.append(node_id)
             conn.meta["node_id"] = node_id
+            self._node_conns[node_id] = conn
             await self.publish("nodes", {"node_id": node_id, "state": "ALIVE"})
             return {"ok": True, "node_index": entry.index}
 
@@ -368,6 +655,11 @@ class GcsServer:
                 node.last_heartbeat = time.monotonic()
                 if "available" in msg:
                     node.available = msg["available"]
+                # Rebind the dispatch-push connection: after a GCS or client
+                # reconnect the registered conn is stale.
+                if self._node_conns.get(msg["node_id"]) is not conn:
+                    conn.meta["node_id"] = msg["node_id"]
+                    self._node_conns[msg["node_id"]] = conn
             return None  # one-way
 
         @s.handler("list_nodes")
@@ -415,6 +707,110 @@ class GcsServer:
             self._release(msg["node_id"], msg["resources"])
             return None
 
+        # ---- GCS-owned task lifecycle ----
+        @s.handler("submit_task")
+        async def submit_task(msg, conn):
+            if msg["task_id"] in self.task_table:
+                # Client retry across a reconnect: already enqueued.
+                return {"ok": True}
+            payload = {k: v for k, v in msg.items()
+                       if k not in ("type", "rpc_id")}
+            self._enqueue_task(payload, "task",
+                               retries=payload.get("max_retries", 0))
+            return {"ok": True}
+
+        @s.handler("create_actor")
+        async def create_actor(msg, conn):
+            actor_id = msg["actor_id"]
+            if actor_id in self.actors:
+                return {"ok": True}  # client retry across a reconnect
+            info = {"state": "PENDING", "name": msg.get("name"),
+                    "class_name": msg.get("class_name"),
+                    "module": msg.get("module"),
+                    "methods": msg.get("methods", ()),
+                    "node_id": None, "address": None}
+            if info["name"]:
+                if info["name"] in self.named_actors:
+                    return {"ok": False,
+                            "error": f"actor name {info['name']!r} taken"}
+                self.named_actors[info["name"]] = actor_id
+            self.actors[actor_id] = info
+            payload = {k: v for k, v in msg.items()
+                       if k not in ("type", "rpc_id", "class_name",
+                                    "module", "methods", "max_restarts")}
+            payload["task_id"] = actor_id
+            self._enqueue_task(payload, "actor",
+                               retries=msg.get("max_restarts", 0))
+            return {"ok": True}
+
+        @s.handler("task_done")
+        async def task_done(msg, conn):
+            self._release(msg["node_id"], msg.get("resources", {}))
+            rec = self.task_table.get(msg.get("task_id"))
+            # Only the node currently owning the dispatch may finish it: a
+            # stale report from a node we already declared dead (and whose
+            # task was re-driven elsewhere) must not flip the state.
+            if rec is not None and rec["node_id"] == msg["node_id"]:
+                self._finish_record(msg["task_id"])
+            return None  # one-way
+
+        @s.handler("task_failed")
+        async def task_failed(msg, conn):
+            """A node reports a task it was running failed (worker death or
+            dispatch failure). Decide retry (owner-side max_retries,
+            task_manager.h:57) or produce the terminal error blob."""
+            self._release(msg["node_id"], msg.get("resources", {}))
+            rec = self.task_table.get(msg.get("task_id"))
+            if rec is None:
+                return {"ok": True, "will_retry": False}
+            if rec["state"] == "DISPATCHED" and \
+                    rec["node_id"] != msg["node_id"]:
+                # Stale report: the task was already re-driven elsewhere
+                # (e.g. the reporter was declared dead after a heartbeat
+                # blip). Don't double-drive it.
+                return {"ok": True, "will_retry": True}
+            if rec["kind"] == "actor":
+                # Restart decision happens on the update_actor DEAD path.
+                return {"ok": True, "will_retry": False}
+            if rec["cancelled"]:
+                self._fail_record(rec, self._cancel_error(rec))
+                blob = self.error_objects.get(rec["return_ids"][0])                     if rec["return_ids"] else None
+                return {"ok": True, "will_retry": False, "error_blob": blob}
+            if rec["retries_left"] != 0:
+                if rec["retries_left"] > 0:
+                    rec["retries_left"] -= 1
+                rec["state"] = "PENDING"
+                rec["node_id"] = None
+                self._spawn(self._drive_task(rec))
+                return {"ok": True, "will_retry": True}
+            rec["state"] = "FAILED"
+            return {"ok": True, "will_retry": False}
+
+        @s.handler("cancel_task")
+        async def cancel_task(msg, conn):
+            oid = msg.get("object_id")
+            task_id = msg.get("task_id") or self.lineage.get(oid)
+            rec = self.task_table.get(task_id) if task_id else None
+            if rec is None or rec["state"] in ("FINISHED", "FAILED"):
+                return {"ok": True, "cancelled": False}
+            rec["cancelled"] = True
+            if rec["state"] == "PENDING":
+                # _drive_task notices on its next wakeup; fail eagerly so
+                # waiters unblock now.
+                self._fail_record(rec, self._cancel_error(rec))
+            elif rec["state"] == "DISPATCHED":
+                node_conn = self._node_conns.get(rec["node_id"])
+                if node_conn is not None:
+                    try:
+                        await node_conn.send({
+                            "type": "cancel_task",
+                            "task_id": rec["task_id"],
+                            "force": msg.get("force", False),
+                        })
+                    except Exception:  # noqa: BLE001
+                        pass
+            return {"ok": True, "cancelled": True}
+
         # ---- objects ----
         @s.handler("add_object_location")
         async def add_object_location(msg, conn):
@@ -431,18 +827,33 @@ class GcsServer:
         async def get_object_locations(msg, conn):
             async def work():
                 oid = msg["object_id"]
+                blob = self.error_objects.get(oid)
+                if blob is not None:
+                    # Terminal task error: served straight from the
+                    # directory (no node holds a copy).
+                    return {"ok": True, "locations": [], "addresses": [],
+                            "error_blob": blob}
                 entry = self.objects.get(oid)
                 if entry is None and msg.get("wait"):
+                    # No copy anywhere: if lineage knows the producer,
+                    # re-execute it (reconstruction) while we wait.
+                    self._maybe_recover_object(oid)
                     ev = asyncio.Event()
                     self._object_waiters.setdefault(oid, []).append(ev)
                     try:
                         await asyncio.wait_for(ev.wait(), msg.get("timeout", 60.0))
                     except asyncio.TimeoutError:
                         return {"ok": True, "locations": [], "addresses": []}
+                    blob = self.error_objects.get(oid)
+                    if blob is not None:
+                        return {"ok": True, "locations": [], "addresses": [],
+                                "error_blob": blob}
                     entry = self.objects.get(oid)
                 locations = sorted(entry["locations"]) if entry else []
                 alive = [n for n in locations
                          if n in self.nodes and self.nodes[n].alive]
+                if not alive and locations:
+                    self._maybe_recover_object(oid)
                 addrs = [list(self.nodes[n].address) for n in alive]
                 # Parallel list: the native data-plane endpoint per location
                 # ([host, transfer_port]; port 0 = no native plane there).
@@ -495,6 +906,13 @@ class GcsServer:
             info = self.actors.get(msg["actor_id"])
             if info is None:
                 return {"ok": False, "error": "unknown actor"}
+            if msg.get("state") == "DEAD":
+                # no_restart=False (a crash report) may transition to
+                # RESTARTING instead, per max_restarts.
+                await self._actor_died(
+                    msg["actor_id"], info,
+                    no_restart=msg.get("no_restart", True))
+                return {"ok": True}
             info.update({k: msg[k] for k in
                          ("state", "node_id", "address") if k in msg})
             await self.publish("actors", {"actor_id": msg["actor_id"],
@@ -515,7 +933,8 @@ class GcsServer:
                     return {"ok": False, "error": "unknown actor"}
                 # wait (detached) for a pending actor to come up
                 deadline = time.monotonic() + msg.get("timeout", 30.0)
-                while info["state"] == "PENDING" and time.monotonic() < deadline:
+                while info["state"] in ("PENDING", "RESTARTING") and \
+                        time.monotonic() < deadline:
                     await asyncio.sleep(0.01)
                 return {"ok": True, "actor_id": actor_id, **info}
 
@@ -566,6 +985,20 @@ class GcsServer:
                     "size": info.get("size", 0),
                 }
             return {"ok": True, "objects": out}
+
+        @s.handler("debug_state")
+        async def debug_state(msg, conn):
+            """Introspection dump (reference: NodeManager DumpDebugState)."""
+            return {"ok": True, "tasks": [
+                {"task_id": tid.hex()[:16], "kind": r["kind"],
+                 "state": r["state"], "node_id": r["node_id"],
+                 "retries_left": r["retries_left"],
+                 "cancelled": r["cancelled"],
+                 "name": r["payload"].get("name")}
+                for tid, r in self.task_table.items()
+            ], "num_objects": len(self.objects),
+               "num_errors": len(self.error_objects),
+               "pending_place": len(self._pending_place)}
 
         @s.handler("pending_demands")
         async def pending_demands(msg, conn):

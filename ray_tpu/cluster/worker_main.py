@@ -85,6 +85,38 @@ def main():
     ser = get_context()
     fn_cache: Dict[bytes, Any] = {}
     actor_instance: Optional[Any] = None
+    actor_id: Optional[bytes] = None
+
+    def checkpoint_key(aid: bytes) -> str:
+        return "__actor_ckpt:" + aid.hex()
+
+    def maybe_save_checkpoint() -> None:
+        """After each method: Checkpointable actors persist state to the GCS
+        kv so a restart (possibly on another node) can restore it
+        (reference: actor.py:972 + GCS checkpoint RPCs)."""
+        inst = actor_instance
+        if (inst is None or actor_id is None
+                or not hasattr(inst, "should_checkpoint")
+                or not hasattr(inst, "save_checkpoint")):
+            return
+        try:
+            if inst.should_checkpoint(None):
+                core.gcs.call({
+                    "type": "kv_put", "key": checkpoint_key(actor_id),
+                    "value": pickle.dumps(inst.save_checkpoint()),
+                })
+        except Exception:  # noqa: BLE001 - checkpointing is best-effort
+            pass
+
+    def maybe_restore_checkpoint(msg) -> None:
+        inst = actor_instance
+        if (inst is None or not msg.get("restart_count")
+                or not hasattr(inst, "load_checkpoint")):
+            return
+        resp = core.gcs.call({"type": "kv_get",
+                              "key": checkpoint_key(msg["actor_id"])})
+        if resp.get("value") is not None:
+            inst.load_checkpoint(pickle.loads(resp["value"]))
 
     def load_function(fn_id: bytes):
         fn = fn_cache.get(fn_id)
@@ -148,6 +180,8 @@ def main():
                 cls = load_function(msg["fn_id"])
                 pos, kwargs = resolve_args(msg)
                 actor_instance = cls(*pos, **kwargs)
+                actor_id = msg["actor_id"]
+                maybe_restore_checkpoint(msg)
                 store_result(msg["return_ids"][0], True)
             elif mtype == "execute_actor_task":
                 if actor_instance is None:
@@ -159,6 +193,7 @@ def main():
                 if asyncio.iscoroutine(result):
                     result = asyncio.run(result)
                 run_returns(msg, result)
+                maybe_save_checkpoint()
             else:
                 continue
         except BaseException as e:  # noqa: BLE001 - task errors are data
@@ -168,7 +203,10 @@ def main():
                 traceback.print_exc()
         finally:
             try:
-                controller.send_oneway({"type": "task_done"})
+                controller.send_oneway({
+                    "type": "task_done",
+                    "return_ids": msg.get("return_ids", []),
+                })
             except ConnectionError:
                 break
 

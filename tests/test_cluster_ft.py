@@ -1,0 +1,235 @@
+"""Cluster fault tolerance: task retry, object reconstruction, actor restart,
+cancellation.
+
+Modeled on the reference's test_component_failures / test_actor_failures /
+test_reconstruction / test_cancel suites: real processes are killed and the
+GCS task table (lineage) must bring the work back.
+"""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.testing import Cluster
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_task_retry_on_worker_death(cluster):
+    marker = tempfile.mktemp(prefix="ray_tpu_retry_")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(marker_path):
+        # First attempt kills its worker; the retry succeeds.
+        if not os.path.exists(marker_path):
+            with open(marker_path, "w") as f:
+                f.write("attempt 1")
+            os._exit(1)
+        return "survived"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=90) == "survived"
+
+
+def test_no_retry_raises_worker_crashed(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_retries_exhausted(cluster):
+    @ray_tpu.remote(max_retries=1)
+    def always_dies():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(always_dies.remote(), timeout=90)
+
+
+def test_object_reconstruction_on_node_death(cluster):
+    """The only copy of a task output dies with its node; a dependent task's
+    fetch triggers lineage re-execution on a fresh node."""
+    n2 = cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"pin": 1})
+    def produce():
+        return np.arange(1000, dtype=np.int64)
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    cluster.remove_node(n2)           # SIGKILL: arena and object are gone
+    cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+
+    @ray_tpu.remote
+    def consume(x):
+        return int(x.sum())
+
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 499500
+
+
+def test_chained_reconstruction(cluster):
+    """y = g(f()) with both outputs only on the dead node: recovering y
+    recursively recovers x first."""
+    n2 = cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"pin": 1})
+    def f():
+        return np.full(10, 7, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"pin": 1})
+    def g(x):
+        return int(x.sum()) + 1
+
+    x = f.remote()
+    y = g.remote(x)
+    ready, _ = ray_tpu.wait([y], num_returns=1, timeout=60)
+    assert ready
+    cluster.remove_node(n2)
+    cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+    assert ray_tpu.get(y, timeout=120) == 71
+
+
+def test_actor_restart_on_worker_death(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def crash(self):
+            os._exit(1)
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    with pytest.raises((ActorDiedError, TaskError, WorkerCrashedError)):
+        ray_tpu.get(c.crash.remote(), timeout=60)
+    # Restarted with fresh state: counter resets.
+    assert ray_tpu.get(c.incr.remote(), timeout=90) == 1
+    # Second crash exhausts max_restarts: the actor stays dead.
+    with pytest.raises((ActorDiedError, TaskError, WorkerCrashedError)):
+        ray_tpu.get(c.crash.remote(), timeout=60)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(c.incr.remote(), timeout=30)
+        except (ActorDiedError, TaskError):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("actor should be permanently dead")
+
+
+def test_checkpointable_actor_restores_state(cluster):
+    @ray_tpu.remote(max_restarts=2)
+    class CkptCounter(ray_tpu.Checkpointable):
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def crash(self):
+            os._exit(1)
+
+        def should_checkpoint(self, ctx):
+            return True
+
+        def save_checkpoint(self):
+            return self.n
+
+        def load_checkpoint(self, checkpoint):
+            self.n = checkpoint
+
+    c = CkptCounter.remote()
+    for expect in (1, 2, 3):
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == expect
+    with pytest.raises((ActorDiedError, TaskError, WorkerCrashedError)):
+        ray_tpu.get(c.crash.remote(), timeout=60)
+    # Restart restores n=3 from the GCS-kv checkpoint.
+    assert ray_tpu.get(c.incr.remote(), timeout=90) == 4
+
+
+def test_actor_restart_on_node_death(cluster):
+    n2 = cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(max_restarts=1, resources={"pin": 1})
+    class Pinned:
+        def where(self):
+            return os.getpid()
+
+    a = Pinned.remote()
+    pid1 = ray_tpu.get(a.where.remote(), timeout=60)
+    cluster.remove_node(n2)
+    cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+    pid2 = ray_tpu.get(a.where.remote(), timeout=120)
+    assert pid2 != pid1
+
+
+def test_cancel_queued_task(cluster):
+    @ray_tpu.remote(resources={"nonexistent": 1})
+    def never_runs():
+        return 1
+
+    ref = never_runs.remote()
+    time.sleep(0.3)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_running_task(cluster):
+    started = tempfile.mktemp(prefix="ray_tpu_cancel_")
+
+    @ray_tpu.remote(max_retries=3)
+    def slow(path):
+        with open(path, "w") as f:
+            f.write("started")
+        time.sleep(120)
+        return "done"
+
+    ref = slow.remote(started)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(started) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(started), "task never started"
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 42
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 42
+    ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=60) == 42
