@@ -29,7 +29,7 @@ class Config:
     num_heartbeats_timeout: int = 30
     # --- scheduling ---
     scheduler_backend: str = "jax"  # "jax" | "scalar"
-    scheduler_tick_ms: int = 10
+    scheduler_tick_ms: int = 2
     scheduler_spread_threshold: float = 0.5
     max_tasks_per_tick: int = 65536
     # --- objects ---

@@ -91,6 +91,16 @@ class NodeController:
 
         atexit.register(self.store.close)
         self._store_waiters: Dict[bytes, List[asyncio.Event]] = {}
+        # Local strict admission (reference: DispatchTasks against the
+        # node's available resources, node_manager.cc:993): the GCS may
+        # queue more work here than fits; execution waits for headroom.
+        # Class-indexed FIFO queues drained by ONE pump task — a per-task
+        # wait on a shared event would wake every queued task per release
+        # (O(N^2) for N queued).
+        self.local_avail: Dict[str, float] = dict(resources)
+        self._admit_event = asyncio.Event()
+        self._admit_queues: Dict[Tuple, Any] = {}
+        self._admit_pump_running = False
         self.workers: Dict[int, WorkerHandle] = {}  # pid -> handle
         self._idle_event = asyncio.Event()
         self._gcs: Optional[RpcClient] = None
@@ -391,6 +401,7 @@ class NodeController:
 
         from ..exceptions import ClusterUnavailableError, WorkerCrashedError
 
+        self._release_local(task)
         will_retry = False
         error_blob: Optional[bytes] = None
         task_id = task.get("task_id")
@@ -452,6 +463,66 @@ class NodeController:
             return
         self._loop.call_soon_threadsafe(lambda: self._spawn_bg(coro))
 
+    def _fits_local(self, res: Dict[str, float]) -> bool:
+        return all(self.local_avail.get(k, 0.0) + 1e-9 >= v
+                   for k, v in res.items())
+
+    def _acquire_now(self, task: Dict) -> None:
+        for k, v in task.get("resources", {}).items():
+            self.local_avail[k] = self.local_avail.get(k, 0.0) - v
+        task["local_acquired"] = True
+
+    async def _acquire_local(self, task: Dict) -> None:
+        """FIFO admission within the task's resource class; returns once
+        the local share is held."""
+        res = task.get("resources", {})
+        klass = tuple(sorted(res.items()))
+        granted = asyncio.Event()
+        from collections import deque as _deque
+
+        dq = self._admit_queues.get(klass)
+        if dq is None:
+            dq = self._admit_queues[klass] = _deque()
+        dq.append((task, granted))
+        self._admit_event.set()
+        if not self._admit_pump_running:
+            self._admit_pump_running = True
+            self._spawn_bg(self._admit_pump())
+        await granted.wait()
+
+    async def _admit_pump(self):
+        """Single drainer: admits queue heads as resources free up."""
+        try:
+            while True:
+                progressed = False
+                for klass in list(self._admit_queues):
+                    dq = self._admit_queues.get(klass)
+                    while dq and self._fits_local(dq[0][0].get("resources", {})):
+                        task, granted = dq.popleft()
+                        self._acquire_now(task)
+                        granted.set()
+                        progressed = True
+                    if dq is not None and not dq:
+                        del self._admit_queues[klass]
+                if not self._admit_queues:
+                    return
+                if not progressed:
+                    self._admit_event.clear()
+                    try:
+                        await asyncio.wait_for(self._admit_event.wait(), 0.5)
+                    except asyncio.TimeoutError:
+                        pass
+        finally:
+            self._admit_pump_running = False
+
+    def _release_local(self, task: Dict) -> None:
+        if not task.pop("local_acquired", False):
+            return
+        for k, v in task.get("resources", {}).items():
+            self.local_avail[k] = min(
+                self.local_avail.get(k, 0.0) + v, self.resources.get(k, v))
+        self._admit_event.set()
+
     async def _cancel_task(self, task_id: bytes, force: bool) -> None:
         """Cancel a GCS-dispatched task on this node: pre-dispatch tasks are
         flagged (the dep-staging path checks), running ones lose their worker
@@ -487,7 +558,7 @@ class NodeController:
         @s.handler("task_done")
         async def task_done(msg, conn):
             """Worker finished: blobs already stored via store_object."""
-            pid = conn.meta.get("worker_pid")
+            pid = msg.get("pid") or conn.meta.get("worker_pid")
             w = self.workers.get(pid)
             if w is not None:
                 for rid in msg.get("return_ids", []):
@@ -498,6 +569,7 @@ class NodeController:
                     w.idle = True
                     self._idle_event.set()
                 if task is not None:
+                    self._release_local(task)
                     await self._release(task)
             return None
 
@@ -671,6 +743,7 @@ class NodeController:
         try:
             for oid in task.get("deps", []):
                 await self._store_get(oid)
+            await self._acquire_local(task)
             worker = await self._pop_idle_worker()
         except Exception as e:  # noqa: BLE001
             await self._fail_task(task, f"dispatch failed: {e}")
@@ -688,6 +761,7 @@ class NodeController:
         try:
             for oid in msg.get("deps", []):
                 await self._store_get(oid)
+            await self._acquire_local(msg)
             worker = await self._pop_idle_worker()
         except Exception as e:  # noqa: BLE001
             await self._fail_task(msg, f"actor creation dispatch failed: {e}")

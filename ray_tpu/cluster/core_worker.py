@@ -67,8 +67,20 @@ class ClusterCoreWorker:
         self._transfer_cli: Any = None  # None=unprobed, False=unavailable
         self._transfer_has_store = False
         self._sub_client = None
+        # Pipelined task submission: specs buffer here and move to the GCS
+        # in batched, idempotent submit_batch calls (reference: the owner's
+        # async submission queue in direct_task_transport.h:46).
+        self._submit_buf: List[Dict] = []
+        self._submit_lock = threading.Lock()
+        self._submit_timer: Any = None
         if role == "driver":
             self._subscribe_logs()
+            try:
+                # Attach to a same-host shm arena early: get() then reads
+                # results zero-copy instead of over RPC.
+                self._home_controller()
+            except Exception:  # noqa: BLE001 - no nodes yet; attach lazily
+                pass
 
     def _subscribe_logs(self) -> None:
         """Stream worker stdout/stderr lines to this driver's console
@@ -154,6 +166,44 @@ class ClusterCoreWorker:
                 kwargs[key] = self._pack_value(val)
         return args, kwargs, deps
 
+    # ---------------------------------------------------------- submit pipe
+    def _queue_submit(self, msg: Dict) -> None:
+        with self._submit_lock:
+            self._submit_buf.append(msg)
+            n = len(self._submit_buf)
+            if self._submit_timer is None:
+                # Arm a short flush timer so a lone submit still departs
+                # quickly even if the caller never get()s.
+                self._submit_timer = threading.Timer(
+                    0.003, self._flush_submits)
+                self._submit_timer.daemon = True
+                self._submit_timer.start()
+        if n >= 128:
+            self._flush_submits()
+
+    def _flush_submits(self) -> None:
+        with self._submit_lock:
+            timer, self._submit_timer = self._submit_timer, None
+            buf, self._submit_buf = self._submit_buf, []
+        if timer is not None:
+            timer.cancel()
+        if not buf:
+            return
+        try:
+            self.gcs.call({"type": "submit_batch", "tasks": buf})
+        except (ConnectionError, OSError):
+            # Put them back and re-arm the retry timer; submit_batch is
+            # idempotent per task_id so a re-send is safe. Without the
+            # timer, a blocked get() would poll forever for tasks that
+            # were never delivered.
+            with self._submit_lock:
+                self._submit_buf = buf + self._submit_buf
+                if self._submit_timer is None:
+                    self._submit_timer = threading.Timer(
+                        0.25, self._flush_submits)
+                    self._submit_timer.daemon = True
+                    self._submit_timer.start()
+
     # ------------------------------------------------------------------ tasks
     def next_task_id(self) -> TaskID:
         ctx = ensure_context(self)
@@ -194,8 +244,7 @@ class ClusterCoreWorker:
         args, kwargs, deps = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
         resources = spec.resources.to_dict()
-        self.gcs.call({
-            "type": "submit_task",
+        self._queue_submit({
             "task_id": spec.task_id.binary(),
             "name": spec.function.repr_name,
             "fn_id": fn_id, "args": args, "kwargs": kwargs,
@@ -206,6 +255,7 @@ class ClusterCoreWorker:
 
     # ----------------------------------------------------------------- actors
     def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
+        self._flush_submits()
         actor_id = spec.actor_id
         methods = tuple(n for n in dir(cls) if not n.startswith("_"))
         fn_id = self._export_fn(cls)
@@ -248,6 +298,7 @@ class ClusterCoreWorker:
             if info.get("state") != "DEAD" else None
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._flush_submits()
         actor_id = spec.actor_id.binary()
         args, kwargs, deps = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
@@ -350,8 +401,11 @@ class ClusterCoreWorker:
         if self.local_store is not None:
             try:
                 self.local_store.put(oid, blob)
-                controller.call({"type": "object_added", "object_id": oid,
-                                 "size": len(blob)})
+                # One-way: the blob is already durable in the arena; the
+                # notification only wakes waiters / updates the directory.
+                controller.send_oneway({"type": "object_added",
+                                        "object_id": oid,
+                                        "size": len(blob)})
                 return
             except ConnectionError:
                 raise
@@ -400,6 +454,27 @@ class ClusterCoreWorker:
         except Exception:  # noqa: BLE001
             return None
 
+    def _fetch_from(self, oid: bytes, addresses, transfer) -> Optional[bytes]:
+        """Fetch one blob given directory addresses: native plane first
+        (bulk bytes move C-to-C, GIL released), RPC fallback."""
+        for i, addr in enumerate(addresses):
+            blob = self._native_fetch(
+                transfer[i] if i < len(transfer) else None, oid)
+            if blob is not None:
+                if not self._transfer_has_store:
+                    self._cache_blob(oid, blob)
+                return blob
+            try:
+                fetched = self._controller(tuple(addr)).call(
+                    {"type": "fetch_object", "object_id": oid}
+                )
+                blob = fetched["blob"]
+                self._cache_blob(oid, blob)
+                return blob
+            except (RuntimeError, ConnectionError, TimeoutError):
+                continue
+        return None
+
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
         if self.local_store is not None:
             blob = self.local_store.get_bytes(oid)
@@ -421,24 +496,11 @@ class ClusterCoreWorker:
                 # Terminal task failure recorded in the GCS task table
                 # (retries exhausted / cancelled): no node holds a copy.
                 return resp["error_blob"]
-            transfer = resp.get("transfer_addresses", [])
-            for i, addr in enumerate(resp.get("addresses", [])):
-                # Native plane first: bulk bytes move C-to-C, GIL released.
-                blob = self._native_fetch(
-                    transfer[i] if i < len(transfer) else None, oid)
-                if blob is not None:
-                    if not self._transfer_has_store:
-                        self._cache_blob(oid, blob)
-                    return blob
-                try:
-                    fetched = self._controller(tuple(addr)).call(
-                        {"type": "fetch_object", "object_id": oid}
-                    )
-                    blob = fetched["blob"]
-                    self._cache_blob(oid, blob)
-                    return blob
-                except (RuntimeError, ConnectionError, TimeoutError):
-                    continue
+            blob = self._fetch_from(
+                oid, resp.get("addresses", []),
+                resp.get("transfer_addresses", []))
+            if blob is not None:
+                return blob
 
     def _cache_blob(self, oid: bytes, blob: bytes):
         self._blob_cache[oid] = blob
@@ -447,34 +509,89 @@ class ClusterCoreWorker:
             old = self._blob_cache_order.popleft()
             self._blob_cache.pop(old, None)
 
-    def get_blob_value(self, oid: bytes, timeout: Optional[float] = None) -> Any:
-        blob = self._fetch_blob(oid, timeout)
+    def _blob_value(self, blob: bytes) -> Any:
         if blob[:1] == ERR_PREFIX:
             raise pickle.loads(blob[1:])
         return self._ser.deserialize(SerializedObject.from_bytes(blob[1:]))
 
+    def get_blob_value(self, oid: bytes, timeout: Optional[float] = None) -> Any:
+        self._flush_submits()
+        return self._blob_value(self._fetch_blob(oid, timeout))
+
+    def _local_blob(self, oid: bytes) -> Optional[bytes]:
+        if self.local_store is not None:
+            blob = self.local_store.get_bytes(oid)
+            if blob is not None:
+                return blob
+        return self._blob_cache.get(oid)
+
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
-        return [self.get_blob_value(r.id.binary(), timeout) for r in refs]
+        """Batched get: one locations_batch poll covers every still-missing
+        ref per cycle instead of a blocking directory round trip per ref."""
+        self._flush_submits()
+        oids = [r.id.binary() for r in refs]
+        blobs: Dict[bytes, bytes] = {}
+        pending = set(oids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        poll = 0.0005
+        while pending:
+            for oid in list(pending):
+                blob = self._local_blob(oid)
+                if blob is not None:
+                    blobs[oid] = blob
+                    pending.discard(oid)
+            if not pending:
+                break
+            resp = self.gcs.call({"type": "locations_batch",
+                                  "object_ids": list(pending)})
+            for oid, info in resp.get("objects", {}).items():
+                if info.get("error_blob") is not None:
+                    blobs[oid] = info["error_blob"]
+                    pending.discard(oid)
+                    continue
+                blob = self._fetch_from(
+                    oid, info.get("addresses", []),
+                    info.get("transfer_addresses", []))
+                if blob is not None:
+                    blobs[oid] = blob
+                    pending.discard(oid)
+            if not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                some = next(iter(pending))
+                raise GetTimeoutError(
+                    f"{len(pending)} objects not ready "
+                    f"(e.g. {some.hex()[:16]})")
+            time.sleep(poll)
+            poll = min(poll * 2, 0.02)
+        values: Dict[bytes, Any] = {}
+        out = []
+        for oid in oids:
+            if oid not in values:
+                values[oid] = self._blob_value(blobs[oid])
+            out.append(values[oid])
+        return out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._flush_submits()
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = {r.id.binary(): r for r in refs}
         ready: set = set()
         while True:
+            unknown = []
             for oid in list(pending):
                 if oid in ready:
                     continue
-                if oid in self._blob_cache:
+                if self._local_blob(oid) is not None:
                     ready.add(oid)
                     continue
-                resp = self.gcs.call({
-                    "type": "get_object_locations", "object_id": oid,
-                    "wait": False,
-                })
-                if resp.get("locations") or resp.get("error_blob") is not None:
-                    ready.add(oid)
+                unknown.append(oid)
+            if unknown:
+                resp = self.gcs.call({"type": "locations_batch",
+                                      "object_ids": unknown})
+                ready.update(resp.get("objects", {}).keys())
             expired = deadline is not None and time.monotonic() >= deadline
             if len(ready) >= num_returns or expired:
                 # at most num_returns in the ready list, input order preserved
@@ -503,6 +620,7 @@ class ClusterCoreWorker:
         """Cancel the task producing ``ref`` (reference:
         core_worker.h:588-595): queued tasks fail immediately at the GCS,
         dispatched ones are interrupted on their node."""
+        self._flush_submits()
         self.gcs.call({"type": "cancel_task",
                        "object_id": ref.id.binary(), "force": force})
 
@@ -552,6 +670,7 @@ class ClusterCoreWorker:
         return self.gcs.call({"type": "get_profile_data"})["events"]
 
     def shutdown(self):
+        self._flush_submits()
         self.flush_events()
         for client in self._controllers.values():
             client.close()

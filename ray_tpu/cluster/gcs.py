@@ -523,12 +523,17 @@ class GcsServer:
 
     # -------------------------------------------------------------- placement
     def _avail_matrix(self, custom_names: Tuple[str, ...] = ()
-                      ) -> Tuple[np.ndarray, List[str]]:
+                      ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+        """(available-load clamped at 0, totals, node order). available can
+        go negative under queue-at-node overcommit; the kernel sees 0."""
         order = [nid for nid in self._node_order if self.nodes[nid].alive]
+        if not order:
+            empty = np.zeros((0, NUM_PREDEFINED + len(custom_names)), np.int64)
+            return empty, empty, []
         sets = [ResourceSet.from_dict(self.nodes[nid].available) for nid in order]
-        if not sets:
-            return np.zeros((0, NUM_PREDEFINED + len(custom_names)), np.int64), []
-        return dense_matrix(sets, custom_names), order
+        totals = [ResourceSet.from_dict(self.nodes[nid].resources) for nid in order]
+        avail = np.maximum(dense_matrix(sets, custom_names), 0)
+        return avail, dense_matrix(totals, custom_names), order
 
     async def _placement_loop(self):
         """Batch placement: drain the queue each tick, one kernel call."""
@@ -546,7 +551,7 @@ class GcsServer:
             custom_names = tuple(sorted(
                 {name for d, _, _ in batch for name in d.custom}
             ))
-            avail, order = self._avail_matrix(custom_names)
+            avail, totals, order = self._avail_matrix(custom_names)
             if not order:
                 for _, _, fut in batch:
                     if not fut.done():
@@ -559,15 +564,42 @@ class GcsServer:
                 dtype=np.int32,
             )
             placement = self._place(demand, avail, locality)
+            # Queue-at-node fallback (reference: tasks the per-tick policy
+            # can't admit queue at a raylet, which admits locally when
+            # resources free — node_manager DispatchTasks). A task the
+            # kernel deferred but that fits SOME node's total resources is
+            # assigned to the feasible node with the most headroom; the
+            # node's controller enforces strict local admission, and the
+            # (possibly negative) availability keeps steering future
+            # placements away from deep queues. Only totals-infeasible
+            # tasks remain deferred (they feed the autoscaler demand).
+            headroom = avail.astype(np.int64).copy()
             for (dset, _, fut), node_idx in zip(batch, placement):
                 if fut.done():
                     continue
                 if node_idx < 0:
-                    fut.set_result(None)   # infeasible/deferred; caller retries
-                else:
-                    nid = order[int(node_idx)]
-                    self._acquire(nid, dset)
-                    fut.set_result(nid)
+                    d = dense_matrix([dset], custom_names)[0]
+                    feas = (d <= totals).all(axis=1)
+                    if feas.any():
+                        req = d > 0
+                        if req.any():
+                            # Headroom only over requested dims: a zero
+                            # column for an unrequested resource must not
+                            # clamp every node's score to 0 (which would
+                            # degenerate to first-fit on node order).
+                            scores = (headroom[:, req] - d[req]).min(axis=1)
+                        else:
+                            scores = headroom.sum(axis=1)
+                        scores = np.where(
+                            feas, scores, np.iinfo(np.int64).min)
+                        node_idx = int(np.argmax(scores))
+                        headroom[node_idx] -= d
+                    else:
+                        fut.set_result(None)  # infeasible; caller retries
+                        continue
+                nid = order[int(node_idx)]
+                self._acquire(nid, dset)
+                fut.set_result(nid)
 
     def _place(self, demand: np.ndarray, avail: np.ndarray,
                locality: np.ndarray) -> np.ndarray:
@@ -708,6 +740,52 @@ class GcsServer:
             return None
 
         # ---- GCS-owned task lifecycle ----
+        @s.handler("ping")
+        async def ping(msg, conn):
+            return {"ok": True}
+
+        @s.handler("submit_batch")
+        async def submit_batch(msg, conn):
+            """Pipelined submissions: one RPC carries many task specs.
+            Idempotent per task_id, so a client may safely re-send a whole
+            window after a reconnect."""
+            for t in msg["tasks"]:
+                if t["task_id"] in self.task_table:
+                    continue
+                self._enqueue_task(t, "task", retries=t.get("max_retries", 0))
+            return {"ok": True, "count": len(msg["tasks"])}
+
+        @s.handler("locations_batch")
+        async def locations_batch(msg, conn):
+            """Non-blocking location/error lookup for many objects at once
+            (the driver's get() poll loop)."""
+            out = {}
+            for oid in msg["object_ids"]:
+                blob = self.error_objects.get(oid)
+                if blob is not None:
+                    out[oid] = {"error_blob": blob}
+                    continue
+                entry = self.objects.get(oid)
+                if not entry:
+                    # Never produced yet (normal poll) or lost with its
+                    # entry dropped at node death: recovery is a no-op for
+                    # in-flight producers and re-drives lost FINISHED ones.
+                    self._maybe_recover_object(oid)
+                    continue
+                alive = [n for n in sorted(entry["locations"])
+                         if n in self.nodes and self.nodes[n].alive]
+                if not alive:
+                    self._maybe_recover_object(oid)
+                    continue
+                out[oid] = {
+                    "addresses": [list(self.nodes[n].address) for n in alive],
+                    "transfer_addresses": [
+                        [self.nodes[n].address[0], self.nodes[n].transfer_port]
+                        for n in alive
+                    ],
+                }
+            return {"ok": True, "objects": out}
+
         @s.handler("submit_task")
         async def submit_task(msg, conn):
             if msg["task_id"] in self.task_table:

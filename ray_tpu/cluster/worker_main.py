@@ -203,11 +203,18 @@ def main():
                 traceback.print_exc()
         finally:
             try:
-                controller.send_oneway({
+                # Send on the SAME connection the result notifications used
+                # (core's controller client): TCP FIFO guarantees the
+                # controller registers the objects before it sees task_done,
+                # so the GCS can never mark the task FINISHED while its
+                # outputs are still unindexed (a lost-object false positive
+                # that would trigger spurious lineage re-execution).
+                core._controller((chost, int(cport))).send_oneway({
                     "type": "task_done",
+                    "pid": os.getpid(),
                     "return_ids": msg.get("return_ids", []),
                 })
-            except ConnectionError:
+            except (ConnectionError, OSError):
                 break
 
 
