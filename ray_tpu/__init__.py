@@ -14,6 +14,7 @@ __version__ = "0.1.0"
 from .api import (  # noqa: F401
     available_resources,
     cancel,
+    free,
     cluster_resources,
     get,
     get_actor,
@@ -60,6 +61,7 @@ __all__ = [
     "wait",
     "kill",
     "cancel",
+    "free",
     "get_actor",
     "nodes",
     "cluster_resources",

@@ -528,6 +528,10 @@ class LocalRuntime:
         if to_delete and self.config.ref_counting_enabled:
             self.store.delete(to_delete)
 
+    def free(self, refs) -> None:
+        """Eager delete (reference: ray.internal.free)."""
+        self.store.delete([r.id for r in refs])
+
     def reference_counts(self) -> Dict[str, Dict[str, int]]:
         """Debug view (feeds the reference's `ray memory`-style accounting)."""
         with self._ref_lock:

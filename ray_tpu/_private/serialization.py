@@ -41,6 +41,35 @@ class SerializedObject:
             parts.append(raw.tobytes() if raw.ndim else bytes(raw))
         return b"".join(parts)
 
+    def framed_size(self) -> int:
+        """Size of the to_bytes() framing without materializing it."""
+        return (8 + len(self.header) + 4
+                + sum(8 + b.raw().nbytes for b in self.buffers))
+
+    def write_into(self, view: memoryview) -> int:
+        """Write the to_bytes() layout directly into ``view`` (e.g. a shm
+        arena slot) — one copy from source buffers instead of two."""
+        off = 0
+
+        def w(b: bytes):
+            nonlocal off
+            view[off:off + len(b)] = b
+            off += len(b)
+
+        w(len(self.header).to_bytes(8, "little"))
+        w(self.header)
+        w(len(self.buffers).to_bytes(4, "little"))
+        for b in self.buffers:
+            raw = b.raw()
+            w(raw.nbytes.to_bytes(8, "little"))
+            try:
+                flat = raw.cast("B")
+            except TypeError:
+                flat = memoryview(raw.tobytes())
+            view[off:off + raw.nbytes] = flat
+            off += raw.nbytes
+        return off
+
     @classmethod
     def from_bytes(cls, data: bytes) -> "SerializedObject":
         view = memoryview(data)

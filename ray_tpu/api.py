@@ -144,6 +144,17 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     worker.core.cancel(ref, force)
 
 
+def free(refs: Sequence[ObjectRef]):
+    """Eagerly delete objects from every store holding them (reference:
+    ray.internal.free). The objects' lineage is dropped too, so they will
+    NOT be reconstructed — only free objects you own and are done with."""
+    worker = global_worker()
+    worker.check_connected()
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    worker.core.free(list(refs))
+
+
 def get_actor(name: str):
     from .actor import ActorHandle
 

@@ -457,6 +457,8 @@ class NodeController:
             coro = self._create_actor(_payload(msg))
         elif mtype == "cancel_task":
             coro = self._cancel_task(msg["task_id"], msg.get("force", False))
+        elif mtype == "delete_objects":
+            coro = self._delete_objects(msg["object_ids"])
         elif mtype == "pubsub":
             return
         else:
@@ -522,6 +524,11 @@ class NodeController:
             self.local_avail[k] = min(
                 self.local_avail.get(k, 0.0) + v, self.resources.get(k, v))
         self._admit_event.set()
+
+    async def _delete_objects(self, oids) -> None:
+        for oid in oids:
+            self.store.delete(oid)
+            self._overflow.pop(oid, None)
 
     async def _cancel_task(self, task_id: bytes, force: bool) -> None:
         """Cancel a GCS-dispatched task on this node: pre-dispatch tasks are

@@ -417,8 +417,25 @@ class ClusterCoreWorker:
     def put(self, value: Any) -> ObjectRef:
         ctx = ensure_context(self)
         oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
-        blob = VAL_PREFIX + self._ser.serialize(value).to_bytes()
-        self.put_blob(oid.binary(), blob)
+        sobj = self._ser.serialize(value)
+        controller = self._home_controller()
+        if self.local_store is not None:
+            # Serialize straight into a created arena slot (plasma
+            # create/seal), skipping the intermediate flat bytes copy.
+            size = 1 + sobj.framed_size()
+            try:
+                view = self.local_store.create(oid.binary(), size)
+            except Exception:  # noqa: BLE001 - arena full etc.
+                view = None
+            if view is not None:
+                view[0:1] = VAL_PREFIX
+                sobj.write_into(view[1:])
+                self.local_store.seal(oid.binary())
+                controller.send_oneway({"type": "object_added",
+                                        "object_id": oid.binary(),
+                                        "size": size})
+                return ObjectRef(oid)
+        self.put_blob(oid.binary(), VAL_PREFIX + sobj.to_bytes())
         return ObjectRef(oid)
 
     def _transfer_client(self):
@@ -615,6 +632,19 @@ class ClusterCoreWorker:
 
         threading.Thread(target=run, daemon=True).start()
         return fut
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        """Eagerly delete objects cluster-wide: the GCS drops directory
+        entries + lineage (no reconstruction) and tells holder nodes to
+        evict (reference: ray.internal.free -> FreeObjects broadcast)."""
+        self._flush_submits()
+        oids = [r.id.binary() for r in refs]
+        for oid in oids:
+            self._blob_cache.pop(oid, None)
+        try:
+            self.gcs.call({"type": "free_objects", "object_ids": oids})
+        except (ConnectionError, OSError):
+            pass
 
     def cancel(self, ref: ObjectRef, force: bool = False):
         """Cancel the task producing ``ref`` (reference:

@@ -945,6 +945,32 @@ class GcsServer:
             self._detach(msg, conn, work())
             return None
 
+        @s.handler("free_objects")
+        async def free_objects(msg, conn):
+            """Eager cluster-wide delete: directory + lineage dropped (so
+            recovery cannot resurrect), holder nodes told to evict."""
+            by_node: Dict[str, List[bytes]] = {}
+            for oid in msg["object_ids"]:
+                entry = self.objects.pop(oid, None)
+                if entry:
+                    for nid in entry["locations"]:
+                        by_node.setdefault(nid, []).append(oid)
+                tid = self.lineage.pop(oid, None)
+                rec = self.task_table.get(tid) if tid else None
+                if rec is not None and rec["state"] == "FINISHED" and all(
+                        o not in self.lineage for o in rec["return_ids"]):
+                    self.task_table.pop(tid, None)
+                self.error_objects.pop(oid, None)
+            for nid, oids in by_node.items():
+                node_conn = self._node_conns.get(nid)
+                if node_conn is not None:
+                    try:
+                        await node_conn.send({"type": "delete_objects",
+                                              "object_ids": oids})
+                    except Exception:  # noqa: BLE001
+                        pass
+            return {"ok": True}
+
         @s.handler("remove_object_locations")
         async def remove_object_locations(msg, conn):
             for oid in msg["object_ids"]:

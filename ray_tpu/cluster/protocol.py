@@ -86,6 +86,9 @@ class RpcServer:
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = Connection(reader, writer)
         self._conns.add(conn)
         try:
@@ -164,6 +167,10 @@ class RpcClient:
         self.addr = (host, port)
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(None)
+        # Small control messages back-to-back must not wait out Nagle +
+        # delayed-ACK (a one-way notification followed by a call would
+        # stall ~40 ms).
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
         self._pending: Dict[int, "threading.Event"] = {}
         self._responses: Dict[int, Dict] = {}
