@@ -223,6 +223,9 @@ void release_slot(Handle* h, Slot* s) {
 
 // Evicts the least-recently-used sealed, unreferenced object.
 // Returns true if something was evicted.
+// O(table_cap) scan under the lock: fine at the common 1K-64K slot sizes;
+// a sustained slot-full small-object workload would want a clock-hand
+// cursor in the header to amortize this (plasma uses an LRU list).
 bool evict_one(Handle* h) {
   Slot* table = slot_table(h);
   Slot* victim = nullptr;
@@ -373,10 +376,16 @@ int tps_create_obj(void* handle, const uint8_t* id, uint64_t size,
     block = alloc_block(h, size);
   }
   Slot* s = find_slot(h, id, true);
-  if (s == nullptr) {  // table full — free and report OOM
-    free_block(h, block);
-    unlock(h);
-    return kOutOfMemory;
+  while (s == nullptr) {
+    // Slot table full (all kUsed, no tombstone): evict an idle object to
+    // reclaim a slot, like plasma does for arena pressure. Dense
+    // small-object workloads hit this before the arena fills.
+    if (!evict_one(h)) {
+      free_block(h, block);
+      unlock(h);
+      return kOutOfMemory;
+    }
+    s = find_slot(h, id, true);
   }
   std::memcpy(s->id, id, kIdLen);
   s->state = kUsed;

@@ -16,7 +16,7 @@ Wire format of a serialized object:
 from __future__ import annotations
 
 import pickle
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
 import cloudpickle
@@ -27,6 +27,11 @@ import numpy as np
 class SerializedObject:
     header: bytes
     buffers: List[pickle.PickleBuffer]
+    # ObjectIDs (binary) of ObjectRefs pickled inside this object. Not part
+    # of the wire format: the serializing process reports them to its ref
+    # counter so contained refs keep their targets alive (reference:
+    # reference_count.h nested/contained refs, AddNestedObjectIds).
+    contained_refs: List[bytes] = field(default_factory=list)
 
     def total_bytes(self) -> int:
         return len(self.header) + sum(b.raw().nbytes for b in self.buffers)
@@ -112,8 +117,11 @@ class SerializationContext:
 
     # -- serialize ------------------------------------------------------------
     def serialize(self, value: Any) -> SerializedObject:
+        from ..object_ref import ObjectRef
+
         buffers: List[pickle.PickleBuffer] = []
         oob_arrays: List[Any] = []  # device arrays exported out-of-band
+        contained: List[bytes] = []
 
         def reducer_override(obj):
             custom = self._custom.get(type(obj))
@@ -121,6 +129,10 @@ class SerializationContext:
                 ser, de = custom
                 payload = ser(obj)
                 return (_apply_deserializer, (de, payload))
+            if isinstance(obj, ObjectRef):
+                # Record and fall through to ObjectRef.__reduce__.
+                contained.append(obj.id.binary())
+                return NotImplemented
             if _is_jax_array(obj):
                 idx = len(oob_arrays)
                 oob_arrays.append(obj)
@@ -144,7 +156,8 @@ class SerializationContext:
             host = np.asarray(arr)  # device->host copy (single transfer)
             buffers.append(pickle.PickleBuffer(host))
         return SerializedObject(
-            header=_prefix_oob_base(header, n_inband), buffers=buffers
+            header=_prefix_oob_base(header, n_inband), buffers=buffers,
+            contained_refs=contained,
         )
 
     # -- deserialize ----------------------------------------------------------

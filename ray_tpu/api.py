@@ -27,9 +27,14 @@ def init(
     """Start (or connect to) a runtime.
 
     ``address=None`` starts the in-process local runtime (the common path for
-    single-host TPU work). ``address="tcp://host:port"`` connects to a running
-    cluster head (ray_tpu/cluster). Reference: python/ray/worker.py:461.
+    single-host TPU work) unless ``RAY_TPU_ADDRESS`` is set in the
+    environment (how ``cli submit``/``exec`` point driver scripts at a
+    running cluster — reference: RAY_ADDRESS, python/ray/worker.py:461).
+    ``address="tcp://host:port"`` connects to a running cluster head
+    (ray_tpu/cluster).
     """
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     worker = global_worker()
     if worker.connected:
         if ignore_reinit_error:

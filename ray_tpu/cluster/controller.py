@@ -110,6 +110,15 @@ class NodeController:
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
         self._cancelled: Set[bytes] = set()  # task_ids cancelled pre-dispatch
+        self._inflight_fetch: Dict[bytes, asyncio.Task] = {}  # pull dedupe
+        # Borrower-side holds for actor-call args: actor calls bypass the
+        # GCS task table (no dep pins there), so this node registers as
+        # holder of the call's ref args from enqueue until the call
+        # resolves — closing the window where the caller drops its handle
+        # while the call is staged/running (reference: borrower registration,
+        # reference_count.h:33).
+        self._ref_held_calls: Dict[bytes, List[bytes]] = {}
+        self._ref_uid = f"node-{self.node_id[:12]}"
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._register_handlers()
 
@@ -219,14 +228,48 @@ class NodeController:
 
     async def _heartbeat_loop(self):
         interval = self.config.heartbeat_interval_ms / 1000.0
+        last_refresh = 0.0
         while True:
             await asyncio.sleep(interval)
             try:
                 self._gcs.send_oneway({
                     "type": "heartbeat", "node_id": self.node_id,
                 })
+                now = time.monotonic()
+                if now - last_refresh > 2.0 and self._ref_held_calls:
+                    last_refresh = now
+                    held = sorted({o for oids in self._ref_held_calls.values()
+                                   for o in oids})
+                    self._gcs.send_oneway({"type": "ref_refresh",
+                                           "worker": self._ref_uid,
+                                           "held": held})
             except ConnectionError:
                 return
+
+    def _borrow_call_refs(self, msg: Dict) -> None:
+        if not self.config.ref_counting_enabled:
+            return  # no GC -> a lone borrow/unborrow cycle would BE the GC
+        oids = list(msg.get("deps", [])) + list(msg.get("pin_refs", []))
+        rids = msg.get("return_ids") or []
+        if not oids or not rids:
+            return
+        self._ref_held_calls[rids[0]] = oids
+        try:
+            self._gcs.send_oneway({"type": "ref_update",
+                                   "worker": self._ref_uid,
+                                   "inc": oids, "dec": []})
+        except ConnectionError:
+            pass
+
+    def _unborrow_call_refs(self, rid: bytes) -> None:
+        oids = self._ref_held_calls.pop(rid, None)
+        if oids:
+            try:
+                self._gcs.send_oneway({"type": "ref_update",
+                                       "worker": self._ref_uid,
+                                       "inc": [], "dec": oids})
+            except ConnectionError:
+                pass
 
     async def _reap_loop(self):
         """Detect dead worker processes; fail their tasks; respawn."""
@@ -317,10 +360,25 @@ class NodeController:
             self._register_object(oid, len(blob))
 
     async def _store_get(self, oid: bytes, timeout: float = 60.0) -> bytes:
-        """Local get; fetches from a remote node if needed (Pull path)."""
+        """Local get; fetches from a remote node if needed (Pull path).
+
+        Single-flight per object: concurrent stagings of the same ref (e.g.
+        a large batch fanned out to several co-located consumers) share one
+        transfer instead of racing N duplicate pulls (reference: the pull
+        manager dedupes active pulls, object_manager.h:213).
+        """
         blob = self._local_blob(oid)
         if blob is not None:
             return blob
+        task = self._inflight_fetch.get(oid)
+        if task is None:
+            task = asyncio.create_task(self._remote_fetch(oid, timeout))
+            self._inflight_fetch[oid] = task
+            task.add_done_callback(
+                lambda t, o=oid: self._inflight_fetch.pop(o, None))
+        return await asyncio.shield(task)
+
+    async def _remote_fetch(self, oid: bytes, timeout: float = 60.0) -> bytes:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             resp = await asyncio.to_thread(self._gcs.call, {
@@ -567,6 +625,8 @@ class NodeController:
             """Worker finished: blobs already stored via store_object."""
             pid = msg.get("pid") or conn.meta.get("worker_pid")
             w = self.workers.get(pid)
+            for rid in msg.get("return_ids", []):
+                self._unborrow_call_refs(rid)
             if w is not None:
                 for rid in msg.get("return_ids", []):
                     w.inflight.pop(rid, None)
@@ -636,6 +696,7 @@ class NodeController:
             hence one queue + dispatcher task per actor.
             """
             actor_id = msg["actor_id"]
+            self._borrow_call_refs(msg)
             q = self._actor_queues.get(actor_id)
             if q is None:
                 q = asyncio.Queue()
@@ -726,6 +787,10 @@ class NodeController:
         try:
             await asyncio.to_thread(
                 self._peer(addr).call, dict(msg, type="actor_call"))
+            # The new home registered its own borrow in its actor_call
+            # handler before acking; ours can go.
+            if msg.get("return_ids"):
+                self._unborrow_call_refs(msg["return_ids"][0])
             return True
         except Exception:  # noqa: BLE001
             return False
@@ -744,6 +809,8 @@ class NodeController:
         blob = ERR_PREFIX + pickle.dumps(ActorDiedError(msg["actor_id"].hex()[:12]))
         for oid in msg["return_ids"]:
             await self._store_put(oid, blob)
+        if msg.get("return_ids"):
+            self._unborrow_call_refs(msg["return_ids"][0])
 
     # -------------------------------------------------------------- task run
     async def _run_task(self, task: Dict):

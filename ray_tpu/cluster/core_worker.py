@@ -73,6 +73,25 @@ class ClusterCoreWorker:
         self._submit_buf: List[Dict] = []
         self._submit_lock = threading.Lock()
         self._submit_timer: Any = None
+        # Distributed reference counting (reference: reference_count.h:33;
+        # the owner<->borrower WaitForRefRemoved protocol of
+        # core_worker.proto:322 collapses into holder registration with the
+        # GCS, which already owns the object directory + task lifecycle).
+        # Every process holding a live ObjectRef is a registered holder;
+        # transitions ship as batched one-way ref_updates, and a periodic
+        # full-set refresh doubles as a lease so holders that die without
+        # dec'ing (SIGKILL) expire at the GCS.
+        import uuid as _uuid
+
+        self.worker_uid = _uuid.uuid4().hex
+        self._ref_lock = threading.Lock()
+        self._ref_counts: Dict[bytes, int] = {}
+        self._ref_inc: List[bytes] = []
+        self._ref_dec: List[bytes] = []
+        self._ref_dirty = threading.Event()  # wakes the flusher
+        self._ref_flusher: Any = None
+        self._ref_refresher: Any = None
+        self._ref_shutdown = threading.Event()
         if role == "driver":
             self._subscribe_logs()
             try:
@@ -80,6 +99,95 @@ class ClusterCoreWorker:
                 # results zero-copy instead of over RPC.
                 self._home_controller()
             except Exception:  # noqa: BLE001 - no nodes yet; attach lazily
+                pass
+
+    # ------------------------------------------------------------- refcount
+    def add_local_ref(self, oid) -> None:
+        """0->1 transitions register this process as a holder with the GCS
+        (batched one-way). Called from ObjectRef.__init__."""
+        if not self.config.ref_counting_enabled:
+            return
+        b = oid.binary()
+        with self._ref_lock:
+            n = self._ref_counts.get(b, 0) + 1
+            self._ref_counts[b] = n
+            if n == 1:
+                self._ref_inc.append(b)
+                self._arm_ref_timer()
+
+    def remove_local_ref(self, oid) -> None:
+        if not self.config.ref_counting_enabled:
+            return
+        b = oid.binary()
+        with self._ref_lock:
+            n = self._ref_counts.get(b, 0) - 1
+            if n > 0:
+                self._ref_counts[b] = n
+                return
+            self._ref_counts.pop(b, None)
+            if n == 0:
+                self._ref_dec.append(b)
+                self._arm_ref_timer()
+
+    def _arm_ref_timer(self) -> None:
+        # Caller holds _ref_lock. One persistent flusher thread batches
+        # transitions on a 20ms cadence (a Timer per window would churn
+        # ~50 OS threads/s under ref-heavy loops).
+        self._ref_dirty.set()
+        if self._ref_flusher is None:
+            self._ref_flusher = threading.Thread(
+                target=self._ref_flush_loop, daemon=True)
+            self._ref_flusher.start()
+        if self._ref_refresher is None:
+            self._ref_refresher = threading.Thread(
+                target=self._ref_refresh_loop, daemon=True)
+            self._ref_refresher.start()
+
+    def _ref_flush_loop(self) -> None:
+        while not self._ref_shutdown.is_set():
+            self._ref_dirty.wait()
+            if self._ref_shutdown.is_set():
+                return
+            time.sleep(0.02)  # batch the window's transitions
+            self._ref_dirty.clear()
+            self._flush_refs()
+
+    def _flush_refs(self) -> None:
+        with self._ref_lock:
+            inc, self._ref_inc = self._ref_inc, []
+            dec, self._ref_dec = self._ref_dec, []
+        if not inc and not dec:
+            return
+        try:
+            self.gcs.send_oneway({"type": "ref_update",
+                                  "worker": self.worker_uid,
+                                  "inc": inc, "dec": dec})
+        except (ConnectionError, OSError):
+            pass  # the next refresh re-asserts the authoritative held set
+
+    def _ref_refresh_loop(self) -> None:
+        """Lease heartbeat: periodically re-assert the full held set. The
+        GCS treats it as authoritative for this worker (drops stale holds)
+        and expires workers that stop refreshing."""
+        while not self._ref_shutdown.wait(2.0):
+            with self._ref_lock:
+                held = list(self._ref_counts)
+            try:
+                self.gcs.send_oneway({"type": "ref_refresh",
+                                      "worker": self.worker_uid,
+                                      "held": held})
+            except (ConnectionError, OSError):
+                pass
+
+    def _report_contained(self, parent_oid: bytes, children: List[bytes]):
+        """Refs pickled inside a stored object pin their targets while the
+        containing object lives (reference: AddNestedObjectIds)."""
+        if children and self.config.ref_counting_enabled:
+            try:
+                self.gcs.send_oneway({"type": "ref_contained",
+                                      "parent": parent_oid,
+                                      "children": children})
+            except (ConnectionError, OSError):
                 pass
 
     def _subscribe_logs(self) -> None:
@@ -145,26 +253,31 @@ class ClusterCoreWorker:
                 self._exported_fns.add(fn_id)
         return fn_id
 
-    def _pack_value(self, value: Any) -> Tuple[str, bytes]:
-        return ("value", self._ser.serialize(value).to_bytes())
+    def _pack_value(self, value: Any,
+                    pins: Optional[List[bytes]] = None) -> Tuple[str, bytes]:
+        sobj = self._ser.serialize(value)
+        if pins is not None and sobj.contained_refs:
+            pins.extend(sobj.contained_refs)
+        return ("value", sobj.to_bytes())
 
     def _pack_args(self, spec: TaskSpec):
         args = []
         deps = []
+        pins: List[bytes] = []  # refs nested inside plain-value args
         for kind, payload in spec.args:
             if kind == "ref":
                 args.append(("ref", payload.binary()))
                 deps.append(payload.binary())
             else:
-                args.append(self._pack_value(payload))
+                args.append(self._pack_value(payload, pins))
         kwargs = {}
         for key, val in spec.metadata.get("kwargs", {}).items():
             if isinstance(val, ObjectRef):
                 kwargs[key] = ("ref", val.id.binary())
                 deps.append(val.id.binary())
             else:
-                kwargs[key] = self._pack_value(val)
-        return args, kwargs, deps
+                kwargs[key] = self._pack_value(val, pins)
+        return args, kwargs, deps, pins
 
     # ---------------------------------------------------------- submit pipe
     def _queue_submit(self, msg: Dict) -> None:
@@ -241,14 +354,14 @@ class ClusterCoreWorker:
         collapsed into the central service that already runs the placement
         kernel)."""
         fn_id = self._export_fn(fn)
-        args, kwargs, deps = self._pack_args(spec)
+        args, kwargs, deps, pins = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
         resources = spec.resources.to_dict()
         self._queue_submit({
             "task_id": spec.task_id.binary(),
             "name": spec.function.repr_name,
             "fn_id": fn_id, "args": args, "kwargs": kwargs,
-            "deps": deps, "return_ids": return_ids,
+            "deps": deps, "pin_refs": pins, "return_ids": return_ids,
             "resources": resources, "max_retries": spec.max_retries,
         })
         return [ObjectRef(oid) for oid in spec.return_ids()]
@@ -261,19 +374,20 @@ class ClusterCoreWorker:
         fn_id = self._export_fn(cls)
         packed_args = []
         deps = []
+        pins: List[bytes] = []
         for a in args:
             if isinstance(a, ObjectRef):
                 packed_args.append(("ref", a.id.binary()))
                 deps.append(a.id.binary())
             else:
-                packed_args.append(self._pack_value(a))
+                packed_args.append(self._pack_value(a, pins))
         packed_kwargs = {}
         for key, val in (kwargs or {}).items():
             if isinstance(val, ObjectRef):
                 packed_kwargs[key] = ("ref", val.id.binary())
                 deps.append(val.id.binary())
             else:
-                packed_kwargs[key] = self._pack_value(val)
+                packed_kwargs[key] = self._pack_value(val, pins)
         resources = spec.resources.to_dict()
         self._actor_resources[actor_id.binary()] = resources
         self.gcs.call({
@@ -281,7 +395,7 @@ class ClusterCoreWorker:
             "name": spec.name, "class_name": cls.__name__,
             "module": cls.__module__, "methods": methods,
             "fn_id": fn_id, "args": packed_args, "kwargs": packed_kwargs,
-            "deps": deps,
+            "deps": deps, "pin_refs": pins,
             "return_ids": [spec.return_ids()[0].binary()],
             "resources": resources,
             "max_restarts": spec.max_restarts,
@@ -300,14 +414,14 @@ class ClusterCoreWorker:
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         self._flush_submits()
         actor_id = spec.actor_id.binary()
-        args, kwargs, deps = self._pack_args(spec)
+        args, kwargs, deps, pins = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         msg = {
             "type": "actor_call", "actor_id": actor_id,
             "method": spec.function.qualname,
             "args": args, "kwargs": kwargs, "deps": deps,
-            "return_ids": return_ids,
+            "pin_refs": pins, "return_ids": return_ids,
             "name": spec.function.repr_name,
         }
         # Fast path: the cached address (no GCS round trip per call). Only
@@ -418,6 +532,7 @@ class ClusterCoreWorker:
         ctx = ensure_context(self)
         oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
         sobj = self._ser.serialize(value)
+        self._report_contained(oid.binary(), sobj.contained_refs)
         controller = self._home_controller()
         if self.local_store is not None:
             # Serialize straight into a created arena slot (plasma
@@ -701,6 +816,19 @@ class ClusterCoreWorker:
 
     def shutdown(self):
         self._flush_submits()
+        self._ref_shutdown.set()
+        self._ref_dirty.set()  # unblock the flusher so it can exit
+        self._flush_refs()
+        # Exiting process drops all its holds (reference: owner death).
+        with self._ref_lock:
+            held, self._ref_counts = list(self._ref_counts), {}
+        if held and self.config.ref_counting_enabled:
+            try:
+                self.gcs.send_oneway({"type": "ref_update",
+                                      "worker": self.worker_uid,
+                                      "inc": [], "dec": held})
+            except (ConnectionError, OSError):
+                pass
         self.flush_events()
         for client in self._controllers.values():
             client.close()

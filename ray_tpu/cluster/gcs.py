@@ -76,6 +76,25 @@ class GcsServer:
         self.task_table: Dict[bytes, Dict[str, Any]] = {}
         self.lineage: Dict[bytes, bytes] = {}
         self.error_objects: Dict[bytes, bytes] = {}
+        # free() tombstones: a location registration that races the free
+        # (put's add_object_location is one-way and may arrive after the
+        # free_objects call) must not resurrect the object in the directory.
+        self._freed: Set[bytes] = set()
+        self._freed_order: Any = _deque()
+        # ---- Distributed reference counting (reference:
+        # reference_count.h:33 owner/borrower; WaitForRefRemoved of
+        # core_worker.proto:322 becomes holder registration against this
+        # central table). holders: oid -> worker_uids; worker_held is the
+        # reverse index and the lease unit (a worker that stops refreshing
+        # drops all its holds). dep pins keep task args alive while their
+        # consuming task is non-terminal; containment pins keep refs
+        # pickled inside live objects alive.
+        self._ref_holders: Dict[bytes, Set[str]] = {}
+        self._ref_worker_held: Dict[str, Set[bytes]] = {}
+        self._ref_worker_seen: Dict[str, float] = {}
+        self._ref_zero_since: Dict[bytes, float] = {}
+        self._dep_pins: Dict[bytes, int] = {}
+        self._contained: Dict[bytes, List[bytes]] = {}
         self._error_order: Any = _deque()
         self._finished_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
@@ -131,6 +150,7 @@ class GcsServer:
                 self._spawn(self._drive_task(rec))
         self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
         self._tasks.append(asyncio.create_task(self._placement_loop()))
+        self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
         if self.persist_path:
             self._tasks.append(asyncio.create_task(self._snapshot_loop()))
         return port
@@ -266,6 +286,7 @@ class GcsServer:
             "return_ids": list(payload.get("return_ids", [])),
         }
         self.task_table[task_id] = rec
+        self._pin_deps(rec)
         for oid in rec["return_ids"]:
             self.lineage[oid] = task_id
             # A resubmitted/restarted producer supersedes any old error.
@@ -374,6 +395,7 @@ class GcsServer:
                      blob: Optional[bytes] = None) -> None:
         """Terminal failure: serve the error straight from the directory."""
         rec["state"] = "FAILED"
+        self._unpin_deps(rec)
         if blob is None:
             blob = b"E" + pickle.dumps(err)
         for oid in rec["return_ids"]:
@@ -391,8 +413,11 @@ class GcsServer:
         rec["state"] = "FINISHED"
         if rec["kind"] == "actor":
             # The creation record doubles as restart lineage; it is dropped
-            # when the actor goes terminally DEAD, not by the eviction cap.
+            # when the actor goes terminally DEAD, not by the eviction cap —
+            # and its arg deps stay PINNED until then, or the ref GC could
+            # delete creation args a later restart must re-stage.
             return
+        self._unpin_deps(rec)
         self._finished_order.append(task_id)
         # Bound lineage growth (reference: max_lineage_size
         # ray_config_def.h:157): evict oldest finished records.
@@ -407,6 +432,120 @@ class GcsServer:
                 if self.lineage.get(oid) == old_tid:
                     del self.lineage[oid]
 
+    # ------------------------------------------------- reference counting
+    def _ref_inc(self, worker: str, oid: bytes) -> None:
+        if oid in self._freed:
+            return
+        self._ref_holders.setdefault(oid, set()).add(worker)
+        self._ref_worker_held.setdefault(worker, set()).add(oid)
+        self._ref_zero_since.pop(oid, None)
+
+    def _ref_dec(self, worker: str, oid: bytes) -> None:
+        holders = self._ref_holders.get(oid)
+        if holders is not None:
+            holders.discard(worker)
+            if not holders:
+                del self._ref_holders[oid]
+                self._ref_zero_since[oid] = time.monotonic()
+        held = self._ref_worker_held.get(worker)
+        if held is not None:
+            held.discard(oid)
+
+    def _pin_deps(self, rec: Dict[str, Any]) -> None:
+        if rec.get("deps_pinned"):
+            return
+        rec["deps_pinned"] = True
+        for oid in rec["payload"].get("deps", []):
+            self._dep_pins[oid] = self._dep_pins.get(oid, 0) + 1
+        for oid in rec["payload"].get("pin_refs", []):
+            self._dep_pins[oid] = self._dep_pins.get(oid, 0) + 1
+
+    def _unpin_deps(self, rec: Dict[str, Any]) -> None:
+        if not rec.get("deps_pinned"):
+            return
+        rec["deps_pinned"] = False
+        for oid in (list(rec["payload"].get("deps", []))
+                    + list(rec["payload"].get("pin_refs", []))):
+            n = self._dep_pins.get(oid, 0) - 1
+            if n > 0:
+                self._dep_pins[oid] = n
+            else:
+                self._dep_pins.pop(oid, None)
+
+    async def _ref_gc_loop(self) -> None:
+        """Collect objects whose last holder left: zero holders for longer
+        than the grace window (covers in-flight inc one-ways) and no task
+        pinning them. Also expires holders whose lease lapsed (process died
+        without dec'ing)."""
+        grace = 2.5
+        lease = 20.0
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for worker, seen in list(self._ref_worker_seen.items()):
+                if now - seen > lease:
+                    for oid in list(self._ref_worker_held.get(worker, ())):
+                        self._ref_dec(worker, oid)
+                    self._ref_worker_held.pop(worker, None)
+                    self._ref_worker_seen.pop(worker, None)
+            victims = [oid for oid, t in self._ref_zero_since.items()
+                       if now - t > grace
+                       and self._dep_pins.get(oid, 0) == 0]
+            if victims:
+                await self._gc_objects(victims)
+
+    def _release_object_state(self, oid: bytes) -> List[str]:
+        """Drop one object's directory entry, lineage (+ its finished task
+        record when no sibling return survives), error blob, and containment
+        pins (re-arming the GC clock for cascade-orphaned children). Shared
+        by free() and the ref GC. Returns the node ids that held a copy."""
+        self._ref_zero_since.pop(oid, None)
+        entry = self.objects.pop(oid, None)
+        holders = list(entry["locations"]) if entry else []
+        tid = self.lineage.pop(oid, None)
+        rec = self.task_table.get(tid) if tid else None
+        if rec is not None and rec["state"] == "FINISHED" and all(
+                o not in self.lineage for o in rec["return_ids"]):
+            self.task_table.pop(tid, None)
+        self.error_objects.pop(oid, None)
+        for child in self._contained.pop(oid, []):
+            n = self._dep_pins.get(child, 0) - 1
+            if n > 0:
+                self._dep_pins[child] = n
+            else:
+                self._dep_pins.pop(child, None)
+                if child not in self._ref_holders \
+                        and child not in self._ref_zero_since \
+                        and (child in self.objects
+                             or child in self.lineage):
+                    self._ref_zero_since[child] = time.monotonic()
+        return holders
+
+    async def _gc_objects(self, oids: List[bytes]) -> None:
+        """Delete unreferenced objects cluster-wide: directory, lineage,
+        holder copies, and containment pins (cascading via the sweep)."""
+        by_node: Dict[str, List[bytes]] = {}
+        for oid in oids:
+            # Tombstone like free(): a late one-way add_object_location
+            # (e.g. the producing task finishing after its return ref was
+            # dropped) must be evicted on arrival, not resurrected as an
+            # uncollectable directory entry.
+            if oid not in self._freed:
+                self._freed.add(oid)
+                self._freed_order.append(oid)
+            for nid in self._release_object_state(oid):
+                by_node.setdefault(nid, []).append(oid)
+        while len(self._freed_order) > 100_000:
+            self._freed.discard(self._freed_order.popleft())
+        for nid, dead in by_node.items():
+            node_conn = self._node_conns.get(nid)
+            if node_conn is not None:
+                try:
+                    await node_conn.send({"type": "delete_objects",
+                                          "object_ids": dead})
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _maybe_recover_object(self, oid: bytes) -> bool:
         """A wanted object has no live copy: re-execute its producing task
         from lineage (reference: ReconstructionPolicy + ObjectRecovery)."""
@@ -417,6 +556,7 @@ class GcsServer:
         if rec["state"] == "FINISHED":
             rec["state"] = "PENDING"
             rec["node_id"] = None
+            self._pin_deps(rec)  # re-executing: args must stay alive again
             self._spawn(self._drive_task(rec))
             return True
         # PENDING/DISPATCHED: already in flight; FAILED: error served.
@@ -438,6 +578,7 @@ class GcsServer:
                     # Creation never completed: unblock creation-ref waiters.
                     self._fail_record(
                         rec, ActorDiedError(actor_id.hex()[:12]))
+                self._unpin_deps(rec)  # terminally dead: release arg pins
                 self.task_table.pop(actor_id, None)
                 for oid in rec["return_ids"]:
                     if self.lineage.get(oid) == actor_id:
@@ -893,6 +1034,17 @@ class GcsServer:
         @s.handler("add_object_location")
         async def add_object_location(msg, conn):
             oid = msg["object_id"]
+            if oid in self._freed:
+                # Late registration of a freed object: keep it out of the
+                # directory and tell the holder to evict its copy.
+                node_conn = self._node_conns.get(msg["node_id"])
+                if node_conn is not None:
+                    try:
+                        await node_conn.send({"type": "delete_objects",
+                                              "object_ids": [oid]})
+                    except Exception:  # noqa: BLE001
+                        pass
+                return None
             entry = self.objects.setdefault(
                 oid, {"locations": set(), "size": msg.get("size", 0)}
             )
@@ -945,30 +1097,71 @@ class GcsServer:
             self._detach(msg, conn, work())
             return None
 
+        @s.handler("ref_update")
+        async def ref_update(msg, conn):
+            worker = msg["worker"]
+            self._ref_worker_seen[worker] = time.monotonic()
+            for oid in msg.get("inc", []):
+                self._ref_inc(worker, oid)
+            for oid in msg.get("dec", []):
+                self._ref_dec(worker, oid)
+            return None
+
+        @s.handler("ref_refresh")
+        async def ref_refresh(msg, conn):
+            """Authoritative held-set for one worker (lease heartbeat):
+            asserts holds that may have been lost and drops stale ones."""
+            worker = msg["worker"]
+            self._ref_worker_seen[worker] = time.monotonic()
+            held = set(msg.get("held", []))
+            old = self._ref_worker_held.get(worker, set())
+            for oid in held - old:
+                self._ref_inc(worker, oid)
+            for oid in old - held:
+                self._ref_dec(worker, oid)
+            return None
+
+        @s.handler("ref_contained")
+        async def ref_contained(msg, conn):
+            """Refs pickled inside object ``parent`` pin their targets for
+            the parent's lifetime (reference: AddNestedObjectIds)."""
+            parent = msg["parent"]
+            children = list(msg.get("children", []))
+            if parent in self._freed:
+                return None
+            prev = self._contained.setdefault(parent, [])
+            prev.extend(children)
+            for child in children:
+                self._dep_pins[child] = self._dep_pins.get(child, 0) + 1
+            return None
+
         @s.handler("free_objects")
         async def free_objects(msg, conn):
             """Eager cluster-wide delete: directory + lineage dropped (so
-            recovery cannot resurrect), holder nodes told to evict."""
-            by_node: Dict[str, List[bytes]] = {}
-            for oid in msg["object_ids"]:
-                entry = self.objects.pop(oid, None)
-                if entry:
-                    for nid in entry["locations"]:
-                        by_node.setdefault(nid, []).append(oid)
-                tid = self.lineage.pop(oid, None)
-                rec = self.task_table.get(tid) if tid else None
-                if rec is not None and rec["state"] == "FINISHED" and all(
-                        o not in self.lineage for o in rec["return_ids"]):
-                    self.task_table.pop(tid, None)
-                self.error_objects.pop(oid, None)
-            for nid, oids in by_node.items():
-                node_conn = self._node_conns.get(nid)
-                if node_conn is not None:
-                    try:
-                        await node_conn.send({"type": "delete_objects",
-                                              "object_ids": oids})
-                    except Exception:  # noqa: BLE001
-                        pass
+            recovery cannot resurrect), ALL nodes told to evict (a holder
+            whose one-way add_object_location hasn't landed yet would be
+            missed by a holders-only broadcast), and a tombstone keeps late
+            registrations out of the directory."""
+            oids = list(msg["object_ids"])
+            for oid in oids:
+                if oid not in self._freed:
+                    self._freed.add(oid)
+                    self._freed_order.append(oid)
+                # Drop refcount state: freed is terminal regardless of
+                # outstanding holders (reference: free is forceful).
+                for worker in self._ref_holders.pop(oid, ()):
+                    held = self._ref_worker_held.get(worker)
+                    if held is not None:
+                        held.discard(oid)
+                self._release_object_state(oid)
+            while len(self._freed_order) > 100_000:
+                self._freed.discard(self._freed_order.popleft())
+            for node_conn in list(self._node_conns.values()):
+                try:
+                    await node_conn.send({"type": "delete_objects",
+                                          "object_ids": oids})
+                except Exception:  # noqa: BLE001
+                    pass
             return {"ok": True}
 
         @s.handler("remove_object_locations")

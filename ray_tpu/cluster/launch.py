@@ -108,6 +108,9 @@ async def run_node(gcs_host: str, gcs_port: int, resources: dict,
 
 def main():
     _force_cpu_jax()
+    from ray_tpu._private.stack_dump import register_stack_dump
+
+    register_stack_dump()
     parser = argparse.ArgumentParser()
     sub = parser.add_subparsers(dest="role", required=True)
 

@@ -24,6 +24,9 @@ VAL_PREFIX = b"V"
 
 
 def main():
+    from ray_tpu._private.stack_dump import register_stack_dump
+
+    register_stack_dump()
     parser = argparse.ArgumentParser()
     parser.add_argument("--controller", required=True)
     parser.add_argument("--gcs", required=True)
@@ -144,7 +147,10 @@ def main():
         return pos, kwargs
 
     def store_result(oid: bytes, value: Any):
-        core.put_blob(oid, VAL_PREFIX + ser.serialize(value).to_bytes())
+        sobj = ser.serialize(value)
+        # Refs returned inside the result stay pinned while it lives.
+        core._report_contained(oid, sobj.contained_refs)
+        core.put_blob(oid, VAL_PREFIX + sobj.to_bytes())
 
     def store_error(msg, exc: BaseException):
         if not isinstance(exc, TaskError):

@@ -8,6 +8,7 @@ replay), IMPALA-style async learner, ES.
 
 from .agents import (  # noqa: F401
     A2CTrainer,
+    ApexTrainer,
     DDPPOTrainer,
     DQNTrainer,
     ESTrainer,
@@ -15,12 +16,26 @@ from .agents import (  # noqa: F401
     MARWILTrainer,
     PGTrainer,
     PPOTrainer,
+    QMIXTrainer,
+    SACTrainer,
     Trainer,
     build_trainer,
 )
+from .external_env import ExternalEnv, ExternalEnvSampler  # noqa: F401
 from .offline import JsonReader, JsonWriter  # noqa: F401
-from .env import CartPole, Env, StatelessBandit, VectorEnv, make_env, register_env  # noqa: F401
+from .env import (  # noqa: F401
+    CartPole,
+    Env,
+    MultiAgentBandit,
+    MultiAgentEnv,
+    StatelessBandit,
+    TwoStepGame,
+    VectorEnv,
+    make_env,
+    register_env,
+)
 from .execution import (  # noqa: F401
+    AggregatorActor,
     ConcatBatches,
     LearnerThread,
     ParallelRollouts,
@@ -28,7 +43,9 @@ from .execution import (  # noqa: F401
     ReplayBuffer,
     StoreToReplayBuffer,
     TrainOneStep,
+    make_aggregation_tree,
 )
+from .multi_agent import MultiAgentRolloutWorker, MultiAgentTrainer  # noqa: F401
 from .policy import DQNPolicy, Policy, PPOPolicy  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae  # noqa: F401

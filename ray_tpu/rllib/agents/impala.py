@@ -34,6 +34,10 @@ IMPALA_CONFIG = {
     "hiddens": [64, 64],
     "broadcast_interval": 1,  # learner updates between weight broadcasts
     "max_requests_in_flight": 2,
+    # > 0: insert a layer of aggregator actors between rollout workers and
+    # the learner (reference: rllib/execution/tree_agg.py — hierarchical
+    # experience aggregation for large worker counts).
+    "num_aggregation_workers": 0,
 }
 
 
@@ -45,14 +49,27 @@ class ImpalaTrainer(Trainer):
     def _build(self, config: Dict) -> None:
         self.learner = LearnerThread(self.workers.local_worker())
         self.learner.start()
-        self._inflight: Dict = {}  # ref -> worker
+        self._inflight: Dict = {}  # ref -> worker-or-aggregator
         self._last_broadcast_seq = 0
+        self.aggregators = []
+        if (config["num_aggregation_workers"] > 0
+                and self.workers.remote_workers()):
+            from ..execution import make_aggregation_tree
+
+            self.aggregators = make_aggregation_tree(
+                self.workers, config["num_aggregation_workers"],
+                config["train_batch_size"])
+            for agg in self.aggregators:
+                self._inflight[agg.aggregate.remote()] = agg
+            return
         for w in self.workers.remote_workers():
             for _ in range(self.raw_config["max_requests_in_flight"]):
                 self._inflight[w.sample.remote()] = w
 
     def _train_step(self) -> Dict:
         cfg = self.raw_config
+        if self.aggregators:
+            return self._train_step_tree()
         remote = self.workers.remote_workers()
         if not remote:
             # Degenerate sync fallback (no async pipeline without workers).
@@ -89,6 +106,41 @@ class ImpalaTrainer(Trainer):
             **{k: float(v) for k, v in self.learner.last_stats.items()},
         }
 
+    def _train_step_tree(self) -> Dict:
+        """Aggregated path: one already-concatenated train batch per
+        aggregator round; weight broadcasts fan out through the tree."""
+        cfg = self.raw_config
+        ready, _ = ray_tpu.wait(list(self._inflight.keys()), num_returns=1)
+        ref = ready[0]
+        agg = self._inflight.pop(ref)
+        batch = ray_tpu.get(ref)
+        self._steps_sampled += batch.count
+        target = self.learner.steps_trained + batch.count
+        self.learner.inqueue.put(batch)
+        if (self.learner.weights_seq - self._last_broadcast_seq
+                >= cfg["broadcast_interval"]):
+            # Boxed ref: the aggregator receives the ObjectRef itself (a
+            # top-level ref arg would arrive resolved) and fans it out so
+            # each worker pulls the ONE stored copy.
+            weights = ray_tpu.put(self.workers.local_worker().get_weights())
+            agg.set_worker_weights.remote([weights])
+            self._last_broadcast_seq = self.learner.weights_seq
+        self._inflight[agg.aggregate.remote()] = agg
+        # Wait (relative target — restored checkpoints reset the learner's
+        # counter) until this batch is trained, so reported stats track it;
+        # a dead learner thread must not hang the driver.
+        while (self.learner.steps_trained < target
+               and self.learner.is_alive()):
+            time.sleep(0.005)
+        return {
+            "learner_updates": self.learner.num_updates,
+            "steps_trained": self.learner.steps_trained,
+            "num_aggregators": len(self.aggregators),
+            **{k: float(v) for k, v in self.learner.last_stats.items()},
+        }
+
     def cleanup(self) -> None:
         self.learner.stop()
+        for agg in self.aggregators:
+            ray_tpu.kill(agg)
         super().cleanup()

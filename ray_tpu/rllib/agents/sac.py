@@ -1,0 +1,187 @@
+"""Soft Actor-Critic, discrete-action variant
+(reference: rllib/agents/sac/sac.py + sac_tf_policy.py; discrete form per
+Christodoulou 2019).
+
+Twin Q networks with polyak-averaged targets, a categorical actor, and a
+learned entropy temperature alpha driven toward a target entropy. The whole
+update (two critic losses, actor loss, alpha loss, polyak) is ONE jitted
+function — no per-network python round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from ..execution import ReplayBuffer
+from ..models import apply_mlp, init_mlp
+from ..policy import Policy
+from ..sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+from .trainer import Trainer
+
+SAC_CONFIG = {
+    "rollout_fragment_length": 32,
+    "train_batch_size": 64,
+    "buffer_size": 50_000,
+    "learning_starts": 500,
+    "num_train_batches_per_step": 4,
+    "lr": 3e-3,
+    "alpha_lr": 3e-3,
+    "tau": 0.01,                 # polyak coefficient for target nets
+    "initial_alpha": 0.2,
+    "target_entropy": None,      # default: 0.98 * log(num_actions)
+    "hiddens": [64, 64],
+}
+
+
+class SACPolicy(Policy):
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict[str, Any]):
+        self.config = config
+        hid = config.get("hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        kp, k1, k2, self._act_key = jax.random.split(key, 4)
+        self.params = {
+            "pi": init_mlp(kp, [obs_dim] + hid + [num_actions]),
+            "q1": init_mlp(k1, [obs_dim] + hid + [num_actions]),
+            "q2": init_mlp(k2, [obs_dim] + hid + [num_actions]),
+            "log_alpha": jnp.log(
+                jnp.asarray(config.get("initial_alpha", 0.2), jnp.float32)),
+        }
+        self.target = {
+            "q1": jax.tree_util.tree_map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree_util.tree_map(jnp.copy, self.params["q2"]),
+        }
+        self.opt = optax.adam(config.get("lr", 3e-3))
+        self.opt_state = self.opt.init(self.params)
+        gamma = config.get("gamma", 0.99)
+        tau = config.get("tau", 0.01)
+        target_entropy = config.get("target_entropy") or (
+            0.98 * float(np.log(num_actions)))
+
+        def pi_dist(params, obs):
+            logits = apply_mlp(params["pi"], obs)
+            logp = jax.nn.log_softmax(logits)
+            return jnp.exp(logp), logp
+
+        def update(params, target, opt_state, batch):
+            def loss_fn(params):
+                alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+                acts = batch[ACTIONS].astype(jnp.int32)
+                n = acts.shape[0]
+
+                # Critic targets: soft state value of s' under the target
+                # twins and the CURRENT policy (discrete SAC: expectation
+                # over actions instead of a sampled squashed action).
+                probs_n, logp_n = pi_dist(params, batch[NEXT_OBS])
+                q1_t = apply_mlp(target["q1"], batch[NEXT_OBS])
+                q2_t = apply_mlp(target["q2"], batch[NEXT_OBS])
+                v_next = jnp.sum(
+                    probs_n * (jnp.minimum(q1_t, q2_t) - alpha * logp_n),
+                    axis=-1)
+                y = jax.lax.stop_gradient(
+                    batch[REWARDS] + gamma * (1.0 - batch[DONES]) * v_next)
+
+                q1 = apply_mlp(params["q1"], batch[OBS])
+                q2 = apply_mlp(params["q2"], batch[OBS])
+                idx = jnp.arange(n)
+                critic_loss = (jnp.mean((q1[idx, acts] - y) ** 2)
+                               + jnp.mean((q2[idx, acts] - y) ** 2))
+
+                # Actor: minimize E_s[ pi(s) . (alpha*log pi - min Q) ]
+                # against FROZEN critics.
+                probs, logp = pi_dist(params, batch[OBS])
+                q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+                actor_loss = jnp.mean(
+                    jnp.sum(probs * (alpha * logp - q_min), axis=-1))
+
+                # Temperature: drive policy entropy toward target_entropy.
+                entropy = -jnp.sum(
+                    jax.lax.stop_gradient(probs * logp), axis=-1)
+                alpha_loss = jnp.mean(
+                    params["log_alpha"] * (entropy - target_entropy))
+
+                total = critic_loss + actor_loss + alpha_loss
+                return total, {
+                    "critic_loss": critic_loss, "actor_loss": actor_loss,
+                    "alpha": jnp.exp(params["log_alpha"]),
+                    "entropy": jnp.mean(entropy),
+                }
+
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_new = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, target_new, opt_state, stats
+
+        def sample_action(params, obs, key):
+            logits = apply_mlp(params["pi"], obs)
+            return jax.random.categorical(key, logits)
+
+        def greedy(params, obs):
+            return jnp.argmax(apply_mlp(params["pi"], obs), axis=-1)
+
+        self._sample = jax.jit(sample_action)
+        self._greedy = jax.jit(greedy)
+        self._update = jax.jit(update)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        obs = jnp.asarray(obs, jnp.float32)
+        if explore:
+            self._act_key, sub = jax.random.split(self._act_key)
+            return np.asarray(self._sample(self.params, obs, sub)), None, None
+        return np.asarray(self._greedy(self.params, obs)), None, None
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)}
+        self.params, self.target, self.opt_state, stats = self._update(
+            self.params, self.target, self.opt_state, dev)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params, "target": self.target})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target = jax.device_put(weights["target"])
+
+
+class SACTrainer(Trainer):
+    _policy_cls = SACPolicy
+    _default_config = SAC_CONFIG
+    _name = "SAC"
+
+    def _build(self, config: Dict) -> None:
+        self.replay = ReplayBuffer(config["buffer_size"],
+                                   seed=config["seed"])
+
+    def _train_step(self) -> Dict:
+        cfg = self.raw_config
+        remote = self.workers.remote_workers()
+        if remote:
+            batches = ray_tpu.get([w.sample.remote() for w in remote])
+        else:
+            batches = [self.workers.local_worker().sample()]
+        for b in batches:
+            self.replay.add_batch(b)
+            self._steps_sampled += b.count
+
+        stats: Dict = {"buffer_size": len(self.replay)}
+        if self._steps_sampled < cfg["learning_starts"]:
+            return stats
+        policy: SACPolicy = self.workers.local_worker().policy
+        for _ in range(cfg["num_train_batches_per_step"]):
+            batch = self.replay.sample(cfg["train_batch_size"])
+            stats.update(policy.learn_on_batch(batch))
+            self._steps_trained += batch.count
+        self.workers.sync_weights()
+        return stats

@@ -140,9 +140,116 @@ class VectorEnv:
         return out
 
 
+class MultiAgentEnv:
+    """Dict-keyed multi-agent protocol (reference: rllib/env/multi_agent_env.py).
+
+    ``reset() -> {agent_id: obs}``;
+    ``step({agent_id: action}) -> (obs_dict, reward_dict, done_dict, info_dict)``
+    where ``done_dict["__all__"]`` ends the episode. Only agents present in
+    the returned obs dict act on the next step — agents may come and go.
+    """
+
+    observation_dim: int
+    num_actions: int
+
+    def reset(self) -> Dict[Any, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[Any, int]) -> Tuple[
+            Dict[Any, np.ndarray], Dict[Any, float], Dict[Any, bool],
+            Dict[Any, Dict]]:
+        raise NotImplementedError
+
+    def seed(self, seed: int) -> None:
+        pass
+
+
+class MultiAgentBandit(MultiAgentEnv):
+    """N independent one-step bandits under one env: agent i's reward is 1
+    when it pulls its own lucky arm. The fastest possible behavior test for
+    independent multi-agent learning (analogue of the reference's
+    BasicMultiAgent mock, rllib/tests/test_multi_agent_env.py)."""
+
+    observation_dim = 1
+    num_actions = 4
+
+    def __init__(self, num_agents: int = 2):
+        self.num_agents = num_agents
+        self.best_arms = [(2 * i + 1) % self.num_actions
+                          for i in range(num_agents)]
+
+    def reset(self) -> Dict[Any, np.ndarray]:
+        obs = np.zeros(1, dtype=np.float32)
+        return {i: obs.copy() for i in range(self.num_agents)}
+
+    def step(self, action_dict):
+        rewards = {
+            i: 1.0 if int(a) == self.best_arms[i] else 0.0
+            for i, a in action_dict.items()
+        }
+        obs = {i: np.zeros(1, dtype=np.float32) for i in action_dict}
+        dones = {i: True for i in action_dict}
+        dones["__all__"] = True
+        return obs, rewards, dones, {i: {} for i in action_dict}
+
+
+class TwoStepGame(MultiAgentEnv):
+    """The cooperative two-step matrix game used to motivate QMIX
+    (reference: rllib/examples/twostep_game.py; Rashid et al. 2018).
+
+    Step 1: agent 0's action picks the payoff matrix (0 -> safe, 1 -> risky).
+    Step 2: the joint action is paid out to BOTH agents:
+      safe:  always 7.
+      risky: [[0, 1], [1, 8]] — 8 requires both agents to coordinate on 1.
+    Optimal return is 8; independent greedy learners typically settle on 7.
+    Observations: one-hot of (step, chosen branch) + the agent's index.
+    """
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self):
+        self.stage = 0
+        self.branch = 0
+
+    def _obs(self):
+        base = np.zeros(4, dtype=np.float32)
+        base[self.stage] = 1.0
+        base[2] = float(self.branch)
+        out = {}
+        for i in range(2):
+            o = base.copy()
+            o[3] = float(i)
+            out[i] = o
+        return out
+
+    def reset(self):
+        self.stage = 0
+        self.branch = 0
+        return self._obs()
+
+    def step(self, action_dict):
+        if self.stage == 0:
+            self.branch = int(action_dict[0])
+            self.stage = 1
+            obs = self._obs()
+            return (obs, {0: 0.0, 1: 0.0}, {"__all__": False, 0: False,
+                                            1: False}, {0: {}, 1: {}})
+        a0, a1 = int(action_dict[0]), int(action_dict[1])
+        if self.branch == 0:
+            reward = 7.0
+        else:
+            reward = [[0.0, 1.0], [1.0, 8.0]][a0][a1]
+        obs = self._obs()
+        return (obs, {0: reward, 1: reward},
+                {"__all__": True, 0: True, 1: True}, {0: {}, 1: {}})
+
+
 _ENV_REGISTRY = {
     "CartPole": CartPole,
     "StatelessBandit": StatelessBandit,
+    "MultiAgentBandit": MultiAgentBandit,
+    "TwoStepGame": TwoStepGame,
 }
 
 

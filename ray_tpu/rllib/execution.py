@@ -197,6 +197,7 @@ class LearnerThread(threading.Thread):
         self.inqueue: _queue.Queue = _queue.Queue(maxsize=max_queue_size)
         self.stopped = False
         self.num_updates = 0
+        self.errors = 0
         self.last_stats: Dict[str, float] = {}
         self.steps_trained = 0
         self.weights_seq = 0  # bumped on every update; samplers poll this
@@ -209,7 +210,14 @@ class LearnerThread(threading.Thread):
                 continue
             if batch is None:
                 break
-            self.last_stats = self.local_worker.learn_on_batch(batch)
+            try:
+                self.last_stats = self.local_worker.learn_on_batch(batch)
+            except Exception:  # noqa: BLE001 - keep the thread alive
+                import traceback
+
+                traceback.print_exc()
+                self.errors += 1
+                self.last_stats = {"learner_errors": float(self.errors)}
             self.num_updates += 1
             self.steps_trained += batch.count
             self.weights_seq += 1
@@ -220,6 +228,64 @@ class LearnerThread(threading.Thread):
             self.inqueue.put_nowait(None)
         except _queue.Full:
             pass
+
+
+@ray_tpu.remote
+class AggregatorActor:
+    """One level of hierarchical sample aggregation
+    (reference: rllib/execution/tree_agg.py:gather_experiences_tree_agg).
+
+    Each aggregator owns a subset of the rollout workers: it drives their
+    sample() calls, concatenates fragments up to ``train_batch_size``
+    timesteps, and hands the learner ONE large batch — so the learner's
+    inbound fan-in is num_aggregators instead of num_workers, and concat
+    cost is spread across the tree.
+    """
+
+    def __init__(self, worker_handles: List, train_batch_size: int):
+        self.workers = list(worker_handles)
+        self.train_batch_size = train_batch_size
+        self._inflight = {w.sample.remote(): w for w in self.workers}
+        self._pending: List[SampleBatch] = []
+        self._count = 0
+
+    def aggregate(self) -> SampleBatch:
+        """Block until train_batch_size timesteps are buffered; return the
+        concatenated batch."""
+        while self._count < self.train_batch_size:
+            ready, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                    num_returns=1)
+            worker = self._inflight.pop(ready[0])
+            batch = ray_tpu.get(ready[0])
+            self._pending.append(batch)
+            self._count += batch.count
+            self._inflight[worker.sample.remote()] = worker
+        out = SampleBatch.concat_samples(self._pending)
+        self._pending, self._count = [], 0
+        return out
+
+    def set_worker_weights(self, weights_box) -> None:
+        """Fan the learner's weight broadcast out through the tree.
+
+        ``weights_box`` is ``[ObjectRef]`` — boxed so the ref survives the
+        hop (a top-level ref arg arrives resolved); each worker then pulls
+        the single stored copy instead of this actor re-shipping N inline
+        copies."""
+        ref = weights_box[0]
+        ray_tpu.get([w.set_weights.remote(ref) for w in self.workers])
+
+
+def make_aggregation_tree(workers, num_aggregators: int,
+                          train_batch_size: int) -> List:
+    """Partition remote workers round-robin across aggregator actors."""
+    remote = workers.remote_workers()
+    num_aggregators = max(1, min(num_aggregators, len(remote)))
+    groups: List[List] = [[] for _ in range(num_aggregators)]
+    for i, w in enumerate(remote):
+        groups[i % num_aggregators].append(w)
+    return [
+        AggregatorActor.remote(g, train_batch_size) for g in groups if g
+    ]
 
 
 class StoreToReplayBuffer:

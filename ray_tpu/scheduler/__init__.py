@@ -7,6 +7,10 @@ pending set is batched into dense tensors and placed by a jit-compiled kernel
 must match bit-for-bit; dag.py generates benchmark DAGs.
 """
 
-from .kernel import BatchScheduler, schedule_dag  # noqa: F401
+from .kernel import (  # noqa: F401
+    BatchScheduler,
+    schedule_dag,
+    schedule_dag_collapsed,
+)
 from .reference import schedule_dag_reference  # noqa: F401
-from .dag import random_dag, uniform_cluster  # noqa: F401
+from .dag import collapse_chains, random_dag, uniform_cluster  # noqa: F401

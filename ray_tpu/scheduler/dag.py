@@ -77,3 +77,63 @@ def chain_rounds_dag(rounds: int, width: int,
         # depend on one task of the previous round (ring offset)
         parents[start : start + width, 0] = np.arange(width) + (r - 1) * width
     return demand, parents
+
+
+def collapse_chains(
+    demand: np.ndarray,       # [T, R]
+    parents: np.ndarray,      # [T, K]
+    locality: Optional[np.ndarray] = None,  # [T] preferred node or -1
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], np.ndarray]:
+    """Collapse linear chains into super-tasks before kernel placement.
+
+    A task with exactly one parent whose parent has exactly one child runs
+    strictly after it and (absent a locality hint) is best co-located with
+    it — so the pair needs no scheduling round of its own. Chains collapse
+    to their head with demand = elementwise max over members (members run
+    sequentially, holding at most one member's resources at a time).
+
+    This removes the pure-chain worst case of one-task-per-round placement
+    (the reference hits the same wall: one DispatchTasks pass per newly
+    ready task, scheduling_policy.cc:31). Returns
+    ``(demand', parents', locality', expand)`` where ``expand[t]`` is the
+    reduced-problem index whose placement task ``t`` inherits.
+    """
+    T, K = parents.shape
+    in_deg = (parents >= 0).sum(axis=1)
+    single_parent = in_deg == 1
+    the_parent = np.where(single_parent, parents.max(axis=1), -1)
+    out_deg = np.zeros(T, dtype=np.int64)
+    edges = parents[parents >= 0]
+    np.add.at(out_deg, edges, 1)
+
+    merge = single_parent & (the_parent >= 0)
+    merge &= out_deg[np.maximum(the_parent, 0)] == 1
+    if locality is not None:
+        merge &= np.asarray(locality) < 0  # hinted tasks anchor their own row
+
+    # Chain representative by pointer jumping (parents precede children, so
+    # this terminates in O(log chain_len) rounds).
+    rep = np.arange(T, dtype=np.int64)
+    rep[merge] = the_parent[merge]
+    while True:
+        nxt = rep[rep]
+        if np.array_equal(nxt, rep):
+            break
+        rep = nxt
+
+    # Chain demand: elementwise max over members, accumulated at the head.
+    head_demand = demand.copy()
+    np.maximum.at(head_demand, rep, demand)
+
+    heads = np.flatnonzero(rep == np.arange(T))
+    new_id = np.full(T, -1, dtype=np.int64)
+    new_id[heads] = np.arange(len(heads))
+
+    reduced_parents = parents[heads].copy()
+    live = reduced_parents >= 0
+    # A head's parent may itself sit inside a chain: inherit its rep.
+    reduced_parents[live] = new_id[rep[reduced_parents[live]]].astype(
+        parents.dtype)
+    reduced_locality = None if locality is None else np.asarray(locality)[heads]
+    expand = new_id[rep]
+    return head_demand[heads], reduced_parents, reduced_locality, expand

@@ -163,6 +163,38 @@ def schedule_dag(
     return placement, rounds
 
 
+def schedule_dag_collapsed(
+    demand: np.ndarray,
+    parents: np.ndarray,
+    avail,
+    key,
+    locality: Optional[np.ndarray] = None,
+    chunk: int = 8192,
+    max_rounds: int = 0,
+) -> Tuple[np.ndarray, int]:
+    """Host wrapper: collapse linear chains (dag.collapse_chains), place the
+    reduced DAG with the kernel, broadcast each head's node to its chain.
+
+    This is the production full-DAG entry: a 50k-task pure chain collapses
+    to one kernel round instead of 50k (the reference pays one DispatchTasks
+    pass per newly-ready task there, scheduling_policy.cc:31). Placements of
+    collapsed members are co-located with their head, which is also the
+    locality-optimal choice (each consumes only its parent's output).
+    """
+    from .dag import collapse_chains
+
+    demand = np.asarray(demand)
+    parents = np.asarray(parents)
+    r_demand, r_parents, r_locality, expand = collapse_chains(
+        demand, parents, locality)
+    placement, rounds = schedule_dag(
+        jnp.asarray(r_demand), jnp.asarray(r_parents), avail, key,
+        locality=None if r_locality is None else jnp.asarray(r_locality),
+        chunk=chunk, max_rounds=max_rounds,
+    )
+    return np.asarray(placement)[expand], int(rounds)
+
+
 class BatchScheduler:
     """Stateful wrapper used by the cluster control plane.
 

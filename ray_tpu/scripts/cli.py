@@ -52,6 +52,20 @@ def _gcs_client(address: Optional[str]):
 
 # ---------------------------------------------------------------- commands
 
+def _launch_env() -> Dict[str, str]:
+    """Env for spawned cluster processes: importable package, no TPU-tunnel
+    claim at interpreter startup (same scrubbing as cluster.testing)."""
+    import ray_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def cmd_start(args) -> None:
     resources = json.loads(args.resources) if args.resources else {"CPU": 4}
     if args.head:
@@ -59,31 +73,41 @@ def cmd_start(args) -> None:
                "--port", str(args.port),
                "--resources", json.dumps(resources),
                "--num-workers", str(args.num_workers)]
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.DEVNULL, text=True)
-        # wait for the gcs_started event line
+        # Output goes to LOG FILES, never a pipe: the head outlives this CLI
+        # process, and an unread pipe fills after ~64KB of worker logs and
+        # then blocks the controller's event loop on print() — wedging the
+        # whole node (observed: register_worker RPCs timing out).
+        log_path = f"/tmp/ray_tpu_head_{os.getpid()}.log"
+        out = open(log_path, "w")
+        proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                                env=_launch_env())
+        # wait for the gcs_started event line to appear in the log
         deadline = time.monotonic() + 60
         port = None
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if not line:
-                if proc.poll() is not None:
-                    raise SystemExit("head process died during startup")
-                continue
-            try:
-                event = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if event.get("event") == "gcs_started":
-                port = event["port"]
-                break
+        with open(log_path) as tail:
+            while time.monotonic() < deadline and port is None:
+                line = tail.readline()
+                if not line:
+                    if proc.poll() is not None:
+                        raise SystemExit(
+                            f"head process died during startup; "
+                            f"see {log_path}")
+                    time.sleep(0.05)
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if event.get("event") == "gcs_started":
+                    port = event["port"]
         if port is None:
             proc.kill()
             raise SystemExit("timed out waiting for GCS startup")
         address = f"127.0.0.1:{port}"
         _save_session({"address": address, "head_pid": proc.pid,
-                       "worker_pids": []})
+                       "worker_pids": [], "head_log": log_path})
         print(f"started head: address={address} pid={proc.pid}")
+        print(f"logs: {log_path}")
         print(f"connect with ray_tpu.init(address={address!r})")
         return
 
@@ -94,7 +118,7 @@ def cmd_start(args) -> None:
            "--resources", json.dumps(resources),
            "--num-workers", str(args.num_workers)]
     proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+                            stderr=subprocess.DEVNULL, env=_launch_env())
     state = _load_session()
     state.setdefault("worker_pids", []).append(proc.pid)
     _save_session(state)
@@ -166,6 +190,93 @@ def cmd_kill_random_node(args) -> None:
         print(f"marked node dead: {victim['NodeID'][:12]}")
     finally:
         gcs.close()
+
+
+def _driver_env(address: Optional[str]) -> Dict[str, str]:
+    """Environment for a driver process pointed at the running cluster."""
+    if address is None:
+        address = _load_session().get("address")
+    if address is None:
+        raise SystemExit("no running cluster (and no --address given)")
+    import ray_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = address
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def cmd_submit(args) -> None:
+    """Run a python script as a driver against the running cluster
+    (reference: ray submit, scripts.py:781 — minus the cloud rsync: the
+    cluster is local/multi-process, so the script path is already here).
+    The script's plain ray_tpu.init() connects via RAY_TPU_ADDRESS."""
+    if not os.path.exists(args.script):
+        raise SystemExit(f"script not found: {args.script}")
+    proc = subprocess.run(
+        [sys.executable, args.script, *args.script_args],
+        env=_driver_env(args.address),
+    )
+    raise SystemExit(proc.returncode)
+
+
+def cmd_exec(args) -> None:
+    """Run a shell command with the cluster env exported (reference:
+    ray exec, scripts.py:863)."""
+    proc = subprocess.run(
+        args.command, shell=True, env=_driver_env(args.address),
+    )
+    raise SystemExit(proc.returncode)
+
+
+def _descendants(pid: int) -> List[int]:
+    out = [pid]
+    try:
+        kids = subprocess.run(
+            ["pgrep", "-P", str(pid)], capture_output=True, text=True
+        ).stdout.split()
+    except OSError:
+        return out
+    for kid in kids:
+        out.extend(_descendants(int(kid)))
+    return out
+
+
+def cmd_stack(args) -> None:
+    """Dump python stacks of every process in the session's cluster tree
+    (reference: ray stack, scripts.py:1000 — py-spy replaced by the
+    faulthandler SIGUSR1 dumps every cluster process registers)."""
+    from ray_tpu._private.stack_dump import STACK_DIR
+
+    state = _load_session()
+    roots = state.get("worker_pids", []) + (
+        [state["head_pid"]] if "head_pid" in state else [])
+    if not roots:
+        raise SystemExit("no running cluster session")
+    pids = []
+    for root in roots:
+        pids.extend(_descendants(root))
+    pids = sorted(set(pids))
+    dumped = []
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            dumped.append(pid)
+        except (ProcessLookupError, PermissionError):
+            pass
+    time.sleep(0.8)  # give handlers time to write
+    for pid in dumped:
+        path = os.path.join(STACK_DIR, f"{pid}.txt")
+        print(f"{'=' * 30} pid {pid} {'=' * 30}")
+        try:
+            with open(path) as f:
+                content = f.read()
+            # faulthandler appends; show only the most recent dump.
+            print(content[-6000:] if len(content) > 6000 else content)
+        except OSError:
+            print("(no dump: process has no stack handler registered)")
 
 
 def cmd_timeline(args) -> None:
@@ -241,6 +352,20 @@ def main(argv: Optional[List[str]] = None) -> None:
         if name == "memory":
             sp.add_argument("--limit", type=int, default=1000)
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("submit", help="run a driver script on the cluster")
+    sp.add_argument("--address")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("exec", help="run a shell command with cluster env")
+    sp.add_argument("--address")
+    sp.add_argument("command")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("stack", help="dump stacks of cluster processes")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")

@@ -27,11 +27,23 @@ def actors() -> Dict[str, Dict[str, Any]]:
 
 
 def objects() -> Dict[str, Dict[str, Any]]:
-    """object_id hex -> {size, has_error} for every stored object."""
+    """object_id hex -> {size, has_error} for every stored object.
+
+    Local mode reads the in-process store; cluster mode reads the GCS
+    object directory (reference: GlobalState.objects over the GCS object
+    table)."""
     core = _core()
     store = getattr(core, "store", None)
     if store is None:
-        return {}
+        gcs = getattr(core, "gcs", None)
+        if gcs is None:
+            return {}
+        resp = gcs.call({"type": "list_objects", "limit": 1_000_000})
+        return {
+            hex_id: {"size_bytes": info.get("size", 0), "has_error": False,
+                     "locations": info.get("locations", [])}
+            for hex_id, info in resp.get("objects", {}).items()
+        }
     out = {}
     with store._lock:
         for oid, obj in store._objects.items():

@@ -1,0 +1,210 @@
+// ASAN/UBSAN stress harness for the shared-memory object store.
+//
+// Reference counterpart: ci/asan_tests/run_asan_tests.sh + the plasma store
+// stress/abort tests (src/ray/object_manager/test/). Exercises, under
+// sanitizers:
+//   1. concurrent create/seal/get/release/delete from many threads with
+//      data-integrity verification,
+//   2. SIGKILL of a process that is HOLDING the store mutex (robust-mutex
+//      EOWNERDEAD recovery must let survivors continue),
+//   3. SIGKILL of a writer mid-put loop (arbitrary kill points),
+//   4. arena-full create/delete churn (split/coalesce allocator paths).
+//
+// Built and run by tests/test_shm_stress.py:
+//   g++ -fsanitize=address,undefined -g -O1 -std=c++17 \
+//       tests/native/stress_shm.cc -o stress_shm -lpthread -lrt
+//
+// Includes the store's .cc directly (same pattern as transfer.cc) so the
+// whole store is sanitizer-instrumented and internals (lock/unlock) are
+// reachable for the deterministic died-holding-the-lock case.
+
+#include "../../ray_tpu/_native/src/shm_store.cc"
+
+#include <signal.h>
+#include <sys/wait.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr const char* kStoreName = "rtps-stress";
+constexpr uint64_t kCapacity = 16ull << 20;  // 16 MiB
+
+void fill_id(uint8_t* id, uint32_t thread_idx, uint32_t i) {
+  std::memset(id, 0, kIdLen);
+  std::memcpy(id, &thread_idx, sizeof(thread_idx));
+  std::memcpy(id + 4, &i, sizeof(i));
+  id[23] = 0x5a;
+}
+
+uint8_t pattern_byte(uint32_t thread_idx, uint32_t i, uint64_t pos) {
+  return static_cast<uint8_t>(thread_idx * 131u + i * 31u + pos * 7u + 1u);
+}
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+// ---- 1. concurrent thread churn with integrity verification -------------
+void thread_churn(void* store) {
+  constexpr int kThreads = 8;
+  constexpr uint32_t kIters = 400;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([store, t, &failures] {
+      uint8_t id[kIdLen];
+      for (uint32_t i = 0; i < kIters; ++i) {
+        fill_id(id, t, i);
+        uint64_t size = 64 + (t * 977 + i * 131) % 8192;
+        uint64_t off = 0;
+        int rc = tps_create_obj(store, id, size, &off);
+        if (rc == kOutOfMemory) continue;  // under churn pressure: fine
+        if (rc != kOk) {
+          failures.fetch_add(1);
+          continue;
+        }
+        auto* h = static_cast<Handle*>(store);
+        uint8_t* data = h->base + off;
+        for (uint64_t p = 0; p < size; ++p) data[p] = pattern_byte(t, i, p);
+        CHECK(tps_seal(store, id) == kOk);
+
+        uint64_t got_off = 0, got_size = 0;
+        CHECK(tps_get(store, id, &got_off, &got_size) == kOk);
+        CHECK(got_size == size);
+        uint8_t* rd = h->base + got_off;
+        for (uint64_t p = 0; p < size; p += 97)
+          CHECK(rd[p] == pattern_byte(t, i, p));
+        CHECK(tps_release(store, id) == kOk);
+        if (i % 3 == 0) tps_delete(store, id);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK(failures.load() == 0);
+  std::printf("thread_churn ok\n");
+}
+
+// ---- 2. SIGKILL while holding the store mutex ---------------------------
+void kill_lock_holder() {
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    void* store = tps_open(kStoreName);
+    if (store == nullptr) _exit(2);
+    lock(static_cast<Handle*>(store));  // die holding it
+    for (;;) pause();
+  }
+  usleep(200 * 1000);  // child has the lock by now
+  CHECK(kill(pid, SIGKILL) == 0);
+  waitpid(pid, nullptr, 0);
+
+  // Survivor must recover the dead owner's lock (EOWNERDEAD ->
+  // pthread_mutex_consistent) and keep operating.
+  void* store = tps_open(kStoreName);
+  CHECK(store != nullptr);
+  uint8_t id[kIdLen];
+  fill_id(id, 900, 1);
+  uint8_t payload[256];
+  std::memset(payload, 0xAB, sizeof(payload));
+  CHECK(tps_put(store, id, payload, sizeof(payload)) == kOk);
+  CHECK(tps_contains(store, id) == 1);
+  CHECK(tps_delete(store, id) == kOk);
+  tps_close(store);
+  std::printf("kill_lock_holder ok\n");
+}
+
+// ---- 3. SIGKILL a writer at an arbitrary point --------------------------
+void kill_writer_midput(int round) {
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    void* store = tps_open(kStoreName);
+    if (store == nullptr) _exit(2);
+    uint8_t id[kIdLen];
+    std::vector<uint8_t> payload(4096, 0xCD);
+    for (uint32_t i = 0;; ++i) {
+      fill_id(id, 1000 + round, i);
+      tps_put(store, id, payload.data(), payload.size());
+      tps_delete(store, id);
+    }
+  }
+  usleep((37 + round * 13) % 120 * 1000);
+  CHECK(kill(pid, SIGKILL) == 0);
+  waitpid(pid, nullptr, 0);
+
+  void* store = tps_open(kStoreName);
+  CHECK(store != nullptr);
+  uint8_t id[kIdLen];
+  fill_id(id, 2000 + round, 0);
+  uint8_t payload[128];
+  std::memset(payload, round & 0xFF, sizeof(payload));
+  CHECK(tps_put(store, id, payload, sizeof(payload)) == kOk);
+  CHECK(tps_delete(store, id) == kOk);
+  tps_close(store);
+}
+
+// ---- 4. arena-full churn (split/coalesce + OOM paths) -------------------
+void full_arena_churn(void* store) {
+  uint8_t id[kIdLen];
+  std::vector<uint8_t> payload(1 << 20, 0xEE);  // 1 MiB objects
+  uint32_t created = 0;
+  for (uint32_t i = 0; i < 64; ++i) {
+    fill_id(id, 3000, i);
+    int rc = tps_put(store, id, payload.data(), payload.size());
+    if (rc == kOutOfMemory) break;
+    CHECK(rc == kOk);
+    ++created;
+  }
+  CHECK(created >= 8);  // 16 MiB arena must hold at least 8 MiB of payload
+  // Free every other object, then fill the holes with half-size objects
+  // (split path), then everything (coalesce path).
+  for (uint32_t i = 0; i < created; i += 2) {
+    fill_id(id, 3000, i);
+    int rc = tps_delete(store, id);
+    // LRU eviction (slot/arena pressure) may have beaten us to it.
+    CHECK(rc == kOk || rc == kNotFound);
+  }
+  for (uint32_t i = 0; i < created; ++i) {
+    fill_id(id, 4000, i);
+    int rc = tps_put(store, id, payload.data(), payload.size() / 2);
+    CHECK(rc == kOk || rc == kOutOfMemory);
+  }
+  for (uint32_t i = 0; i < created; ++i) {
+    fill_id(id, 3000, i);
+    tps_delete(store, id);
+    fill_id(id, 4000, i);
+    tps_delete(store, id);
+  }
+  uint64_t stats[8] = {0};
+  CHECK(tps_stats(store, stats) == kOk);
+  std::printf("full_arena_churn ok (evictions=%llu)\n",
+              static_cast<unsigned long long>(stats[4]));
+}
+
+}  // namespace
+
+int main() {
+  void* store = tps_create(kStoreName, kCapacity);
+  CHECK(store != nullptr);
+
+  thread_churn(store);
+  kill_lock_holder();
+  for (int round = 0; round < 6; ++round) kill_writer_midput(round);
+  std::printf("kill_writer_midput ok\n");
+  full_arena_churn(store);
+
+  tps_close(store);
+  tps_unlink(kStoreName);
+  std::printf("ALL OK\n");
+  return 0;
+}

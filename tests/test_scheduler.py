@@ -7,6 +7,7 @@ bit-identical placements between the jit kernel and the scalar reference.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -162,3 +163,90 @@ class TestBatchScheduler:
         placement = sched.place(demand)
         placed = placement[placement >= 0]
         assert (placed == 1).all()  # node 0 drained
+
+
+class TestChainCollapse:
+    """Chain-collapse preprocessing (schedule_dag_collapsed): linear chains
+    place in one kernel round, co-located with their head."""
+
+    def test_pure_chain_collapses_to_one_round(self):
+        from ray_tpu.scheduler import schedule_dag_collapsed, uniform_cluster
+
+        T = 5_000
+        demand = np.full((T, 1), 1000, np.int32)
+        parents = (np.arange(T, dtype=np.int32) - 1).reshape(-1, 1)
+        avail = jnp.asarray(uniform_cluster(16, cpu=16.0)[:, :1])
+        placement, rounds = schedule_dag_collapsed(
+            demand, parents, avail, jax.random.PRNGKey(0), chunk=64)
+        assert rounds == 1
+        assert (placement >= 0).all()
+        assert len(set(placement.tolist())) == 1  # whole chain co-located
+
+    def test_chain_demand_is_member_max(self):
+        from ray_tpu.scheduler.dag import collapse_chains
+
+        demand = np.array([[1000], [3000], [2000]], np.int32)
+        parents = np.array([[-1], [0], [1]], np.int32)
+        r_demand, r_parents, _, expand = collapse_chains(demand, parents)
+        assert r_demand.shape[0] == 1
+        assert r_demand[0, 0] == 3000          # max over the chain
+        assert (expand == 0).all()
+        assert (r_parents == -1).all()
+
+    def test_branching_breaks_chains(self):
+        from ray_tpu.scheduler.dag import collapse_chains
+
+        # 0 -> {1, 2}: out-degree 2, so 1 and 2 must stay separate heads.
+        demand = np.full((3, 1), 1000, np.int32)
+        parents = np.array([[-1], [0], [0]], np.int32)
+        r_demand, r_parents, _, expand = collapse_chains(demand, parents)
+        assert r_demand.shape[0] == 3
+        assert sorted(expand.tolist()) == [0, 1, 2]
+        # children still depend on the head in the reduced problem
+        assert r_parents[expand[1], 0] == expand[0]
+        assert r_parents[expand[2], 0] == expand[0]
+
+    def test_locality_hint_anchors_member(self):
+        from ray_tpu.scheduler.dag import collapse_chains
+
+        demand = np.full((3, 1), 1000, np.int32)
+        parents = np.array([[-1], [0], [1]], np.int32)
+        locality = np.array([-1, 7, -1], np.int32)
+        r_demand, _, r_locality, expand = collapse_chains(
+            demand, parents, locality)
+        # Task 1 is hinted: it must stay its own head (hint preserved);
+        # task 2 then chains onto task 1.
+        assert r_demand.shape[0] == 2
+        assert expand[0] != expand[1]
+        assert expand[1] == expand[2]
+        assert r_locality[expand[1]] == 7
+
+    def test_collapsed_matches_plain_on_random_dag(self):
+        """Same DAG through both entries: both produce complete, feasible
+        placements (placements differ — collapse changes the RNG stream)."""
+        from ray_tpu.scheduler import (
+            random_dag,
+            schedule_dag,
+            schedule_dag_collapsed,
+            uniform_cluster,
+        )
+
+        demand, parents = random_dag(2_000, parent_window=256, seed=3)
+        avail = jnp.asarray(uniform_cluster(32, cpu=64.0))
+        p1, _ = schedule_dag(
+            jnp.asarray(demand), jnp.asarray(parents), avail,
+            jax.random.PRNGKey(1), chunk=512)
+        p2, _ = schedule_dag_collapsed(
+            demand, parents, avail, jax.random.PRNGKey(1), chunk=512)
+        p1 = np.asarray(p1)
+        assert (p1 >= 0).all() and (p2 >= 0).all()
+        # Chain members inherit their head's node: every task with a single
+        # parent whose parent has out-degree 1 shares the parent's node.
+        in_deg = (parents >= 0).sum(1)
+        out_deg = np.zeros(len(demand), np.int64)
+        np.add.at(out_deg, parents[parents >= 0], 1)
+        single = np.flatnonzero((in_deg == 1))
+        for t in single[:200]:
+            p = parents[t].max()
+            if out_deg[p] == 1:
+                assert p2[t] == p2[p]

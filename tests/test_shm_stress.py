@@ -1,0 +1,31 @@
+"""Sanitizer stress run of the native shm store (reference:
+ci/asan_tests/run_asan_tests.sh). Builds tests/native/stress_shm.cc with
+ASAN+UBSAN and runs it: concurrent churn, SIGKILL-while-holding-the-mutex
+robust recovery, mid-put kills, and full-arena allocator churn."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "tests", "native", "stress_shm.cc")
+
+
+@pytest.mark.slow
+def test_shm_store_asan_stress(tmp_path):
+    binary = str(tmp_path / "stress_shm")
+    build = subprocess.run(
+        ["g++", "-fsanitize=address,undefined", "-g", "-O1", "-std=c++17",
+         "-o", binary, SRC, "-lpthread", "-lrt"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run(
+        [binary], capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, ASAN_OPTIONS="abort_on_error=1"),
+    )
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "ALL OK" in run.stdout
+    assert "ERROR: AddressSanitizer" not in run.stderr
+    assert "runtime error" not in run.stderr  # UBSAN
