@@ -5,10 +5,14 @@ The pallas kernel implements the standard online-softmax flash attention
 matmuls on the MXU). It is used on TPU for shapes that tile cleanly; everything
 else (CPU tests, ragged shapes) uses the XLA reference, which XLA fuses well.
 
-Backward: custom_vjp with rematerialized XLA math — correct and memory-lean
-(no score tensor saved); a pallas backward kernel is a later optimization.
+Backward: pallas kernels too (Dao 2022 two-pass form) — dq in one kernel
+sweeping KV blocks, dk/dv in a second sweeping Q blocks, both recomputing P
+from the forward's saved logsumexp instead of materializing [T, S] scores.
+Validated against the XLA reference gradient in pallas interpret mode
+(tests/test_fused_ops.py), so correctness holds without TPU hardware.
 
-Supports GQA: q has H heads, k/v have KH heads with H % KH == 0.
+Supports GQA: q has H heads, k/v have KH heads with H % KH == 0 (backward
+group-sums per-Q-head dk/dv into the shared kv heads).
 """
 
 from __future__ import annotations
@@ -65,7 +69,12 @@ def attention_reference(
 # Pallas flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+# Flipped to True by tests: runs every pallas kernel in interpret mode on
+# CPU so the backward kernels are validated without TPU hardware.
+_INTERPRET = False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                   *, causal: bool, scale: float, block_q: int, block_k: int):
     """One (batch*head, q_block, k_block) grid step with accumulation.
 
@@ -136,9 +145,191 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         o_ref[0, :, :] = (
             acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
         ).astype(o_ref.dtype)
+        # Row logsumexp, the only softmax residual the backward needs
+        # (flash attention v2 trick: m + log l folds max and sum).
+        lse_ref[0, :] = (
+            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        )
 
 
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int) -> jax.Array:
+def _kv_row_fn(H: int, KH: int):
+    group = H // KH
+
+    def kv_row(bh, ki, g=group, h_per_b=H, kh_per_b=KH):
+        b, h = bh // h_per_b, bh % h_per_b
+        return (b * kh_per_b + h // g, ki, 0)
+
+    return kv_row
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Returns (out [B,T,H,D], lse [B*H, T] f32)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    scale = D ** -0.5
+
+    # [B, T, H, D] -> [B*H, T, D]: tiles land on the native (T, D) layout.
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
+    grid = (B * H, T // block_q, S // block_k)
+    kv_row = _kv_row_fn(H, KH)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+                   acc_scr, *, causal: bool, scale: float,
+                   block_q: int, block_k: int):
+    """dq for one (bh, q block): sweep KV blocks, accumulate in VMEM.
+
+    With the forward's logsumexp residual, P recomputes in one pass
+    (P = exp(S - lse)), no second softmax reduction needed:
+      ds = P * (dO @ V^T - rowsum(dO*O)) * scale;  dq += ds @ K
+    (Dao 2022, backward pass).
+    """
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        v = v_ref[0, :, :]
+        do = do_ref[0, :, :]
+        lse = lse_ref[0, :]                     # [block_q]
+        dsum = dsum_ref[0, :]                   # [block_q]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])           # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum[:, None]) * scale
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0, :, :] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                    scale: float, block_q: int, block_k: int):
+    """dk/dv for one (bh, kv block): sweep Q blocks, accumulate in VMEM.
+
+      dv += P^T @ dO;   dk += ds^T @ Q
+    """
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        v = v_ref[0, :, :]
+        do = do_ref[0, :, :]
+        lse = lse_ref[0, :]
+        dsum = dsum_ref[0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])           # [block_q, block_k]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum[:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Q blocks strictly above the diagonal contribute nothing.
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool,
+                    block_q: int, block_k: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -147,55 +338,87 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int) -> jax.Arr
     group = H // KH
     scale = D ** -0.5
 
-    # [B, T, H, D] -> [B*H, T, D]: tiles land on the native (T, D) layout.
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * KH, S, D)
-    grid = (B * H, T // block_q, S // block_k)
+    dof = g.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    # D_i = rowsum(dO_i * O_i): cheap elementwise reduce, left to XLA.
+    dsum = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(B * H, T)
+    kv_row = _kv_row_fn(H, KH)
 
-    def kv_row(bh, ki, g=group, h_per_b=H, kh_per_b=KH):
-        b, h = bh // h_per_b, bh % h_per_b
-        return (b * kh_per_b + h // g, ki, 0)
-
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(B * H, T // block_q, S // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: kv_row(bh, ki)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_INTERPRET,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    # dk/dv are computed per Q head ([B*H, S, D]) and group-summed to the
+    # KH kv heads afterwards (GQA): the kernel stays dense and the group
+    # reduction is one XLA reshape-sum.
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(B * H, S // block_k, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: kv_row(bh, ki)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: kv_row(bh, ki)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
         ],
-    )(qf, kf, vf)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    dq = dq.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    dk = dk_h.reshape(B, KH, group, S, D).sum(axis=2)
+    dv = dv_h.reshape(B, KH, group, S, D).sum(axis=2)
+    dk = dk.transpose(0, 2, 1, 3)
+    dv = dv.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, block_q, block_k):
-    return _flash_forward(q, k, v, causal, block_q, block_k)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_forward(q, k, v, causal, block_q, block_k), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    # Rematerialize through the XLA reference; XLA differentiates it.
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

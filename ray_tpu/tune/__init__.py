@@ -31,7 +31,9 @@ from .schedulers import (  # noqa: F401
     TrialScheduler,
 )
 from .search import BasicVariantGenerator, SearchAlgorithm, generate_variants  # noqa: F401
-from .suggest import SuggestSearcher  # noqa: F401
+from .suggest import BOHBSearcher, SuggestSearcher  # noqa: F401
+from .syncer import FunctionSyncer, LocalSyncer, Syncer, get_syncer  # noqa: F401
+from .durable_trainable import DurableTrainable, make_durable  # noqa: F401
 from .trainable import FunctionTrainable, Trainable, report, wrap_function  # noqa: F401
 from .trial import Trial  # noqa: F401
 from .trial_executor import RayTrialExecutor  # noqa: F401
@@ -41,6 +43,13 @@ from .tune import ExperimentAnalysis, register_trainable, run  # noqa: F401
 __all__ = [
     "run",
     "SuggestSearcher",
+    "BOHBSearcher",
+    "Syncer",
+    "LocalSyncer",
+    "FunctionSyncer",
+    "get_syncer",
+    "DurableTrainable",
+    "make_durable",
     "report",
     "register_trainable",
     "Trainable",

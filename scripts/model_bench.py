@@ -1,0 +1,133 @@
+"""Flagship-model on-chip benchmark: tokens/s + MFU, pallas vs XLA attention.
+
+Reference bar: the per-release perf logs culture
+(``doc/dev/release_logs/0.8.5/``) — publish measured numbers per round.
+
+Run on the real chip (takes minutes; first compile is slow):
+
+    python scripts/model_bench.py [--steps 20] [--seq 2048] [--batch 8]
+
+Writes MODEL_BENCH.json next to the repo root and prints a summary table.
+MFU = achieved_flops / peak_flops with the standard 6*N*T transformer
+train-step estimate (fwd 2N + bwd 4N matmul flops per token, N = non-embed
+params) + exact attention flops; peak defaults to 275 TFLOPs bf16 (v5p-ish)
+and is overridable with --peak-tflops for the actual chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _param_count(params) -> int:
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def train_step_flops(cfg, batch: int, seq: int, n_params: int) -> float:
+    """6*N per token matmul flops + exact attention term (causal halves it):
+    fwd QK^T + PV = 2 * 2*T^2*D per head; backward doubles twice -> x3."""
+    embed = cfg.vocab_size * cfg.d_model
+    n_matmul = n_params - embed  # embedding lookup is a gather, not a matmul
+    dense = 6.0 * n_matmul * batch * seq
+    attn_fwd = 4.0 * batch * cfg.n_heads * seq * seq * cfg.head_dim * 0.5
+    return dense + 3.0 * attn_fwd
+
+
+def bench_config(use_pallas: bool, *, batch: int, seq: int, steps: int,
+                 cfg=None):
+    from ray_tpu.models import TransformerConfig, init_params, make_train_step
+    from ray_tpu.ops import attention as att
+
+    cfg = cfg or TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=16, d_ff=4096, max_seq_len=seq, dtype=jnp.bfloat16)
+
+    # Dispatch override: force the XLA path by pretending blocks don't tile.
+    orig = att.flash_attention
+    if not use_pallas:
+        def xla_only(q, k, v, **kw):
+            return att.attention_reference(
+                q, k, v, causal=kw.get("causal", True))
+        att.flash_attention = xla_only
+        # models.transformer binds the name at import; patch there too.
+        import ray_tpu.models.transformer as tr
+        tr.flash_attention = xla_only
+    try:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        n_params = _param_count(params)
+        init_opt, train_step = make_train_step(cfg)
+        opt_state = init_opt(params)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size,
+            dtype=jnp.int32)
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+
+        t0 = time.time()
+        params, opt_state, loss = step(params, opt_state, {"tokens": tokens})
+        float(loss)
+        compile_s = time.time() - t0
+
+        t0 = time.time()
+        for _ in range(steps):
+            params, opt_state, loss = step(
+                params, opt_state, {"tokens": tokens})
+        float(loss)  # barrier
+        wall = (time.time() - t0) / steps
+        toks = batch * seq / wall
+        flops = train_step_flops(cfg, batch, seq, n_params)
+        return {"tokens_per_sec": round(toks, 1),
+                "step_ms": round(wall * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+                "achieved_tflops": round(flops / wall / 1e12, 2),
+                "n_params_m": round(n_params / 1e6, 1),
+                "loss": float(loss)}
+    finally:
+        if not use_pallas:
+            att.flash_attention = orig
+            import ray_tpu.models.transformer as tr
+            tr.flash_attention = orig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--peak-tflops", type=float, default=275.0,
+                    help="chip peak bf16 TFLOPs for the MFU denominator")
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    print(f"# backend: {backend}", file=sys.stderr)
+    out = {"backend": backend, "batch": args.batch, "seq": args.seq,
+           "peak_tflops": args.peak_tflops}
+    for name, use_pallas in (("xla_attention", False),
+                             ("pallas_attention", True)):
+        r = bench_config(use_pallas, batch=args.batch, seq=args.seq,
+                         steps=args.steps)
+        r["mfu_pct"] = round(100.0 * r["achieved_tflops"]
+                             / args.peak_tflops, 2)
+        out[name] = r
+        print(f"# {name}: {r}", file=sys.stderr)
+    fast = max(("xla_attention", "pallas_attention"),
+               key=lambda n: out[n]["tokens_per_sec"])
+    out["winner"] = fast
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "MODEL_BENCH.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

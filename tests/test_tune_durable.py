@@ -1,0 +1,173 @@
+"""Tune durability: checkpoint sync + durable trainables + BOHB
+(reference: python/ray/tune/durable_trainable.py, syncer.py,
+schedulers/bohb.py + suggest/bohb.py)."""
+
+import os
+import pickle
+import shutil
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import DurableTrainable, LocalSyncer
+
+
+def _make_step_counter():
+    """Defined in a function so cloudpickle ships the class BY VALUE to
+    cluster workers (a module-level test class pickles by reference to a
+    module the workers cannot import)."""
+
+    class StepCounter(DurableTrainable):
+        """Counts steps; checkpoint = the count."""
+
+        def setup(self, config):
+            self.count = 0
+
+        def step(self):
+            self.count += 1
+            return {"count": self.count}
+
+        def save_checkpoint(self, checkpoint_dir):
+            with open(os.path.join(checkpoint_dir, "count.pkl"), "wb") as f:
+                pickle.dump(self.count, f)
+            return checkpoint_dir
+
+        def load_checkpoint(self, checkpoint_path):
+            with open(os.path.join(checkpoint_path, "count.pkl"), "rb") as f:
+                self.count = pickle.load(f)
+
+    return StepCounter
+
+
+def test_local_syncer_atomic_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.txt").write_text("v1")
+    syncer = LocalSyncer()
+    remote = str(tmp_path / "store" / "ckpt")
+    assert syncer.sync_up(str(src), remote)
+    (src / "a.txt").write_text("v2")
+    assert syncer.sync_up(str(src), remote)          # atomic replace
+    dest = str(tmp_path / "dest")
+    assert syncer.sync_down(remote, dest)
+    assert open(os.path.join(dest, "a.txt")).read() == "v2"
+    assert syncer.delete(remote)
+    assert not syncer.sync_down(remote, str(tmp_path / "dest2"))
+
+
+def test_durable_restores_after_local_disk_loss(tmp_path):
+    """save() uploads; after the local checkpoint dir is destroyed (node
+    loss), restore() pulls the synced copy back down."""
+    upload = str(tmp_path / "durable")
+    StepCounter = _make_step_counter()
+    t = StepCounter({"__upload_dir__": upload, "__trial_id__": "trial0"})
+    for _ in range(3):
+        t.train()
+    path = t.save()
+    shutil.rmtree(path)                    # the node's disk is gone
+    assert not os.path.exists(path)
+
+    t2 = StepCounter({"__upload_dir__": upload, "__trial_id__": "trial0"})
+    t2.restore(path)
+    assert t2.count == 3
+    assert t2.iteration == 3
+    assert t2.train()["count"] == 4
+
+
+@pytest.mark.slow
+def test_durable_trial_resumes_on_fresh_node():
+    """Cluster flow: the trial's actor runs on node A and checkpoints
+    durably; node A dies (local checkpoint gone with it); the executor
+    restarts the trial and the fresh actor restores from the synced copy."""
+    import tempfile
+
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.tune import RayTrialExecutor, Trial
+
+    StepCounter = _make_step_counter()
+    upload = tempfile.mkdtemp(prefix="durable_store_")
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        node_a = cluster.add_node(resources={"CPU": 2, "A": 1},
+                                  num_workers=1)
+        ray_tpu.init(address=cluster.address)
+        executor = RayTrialExecutor()
+        trial = Trial(StepCounter,
+                      {"__upload_dir__": upload, "__trial_id__": "t1"},
+                      resources={"CPU": 1, "A": 1})
+        trial.config["__trial_id__"] = "t1"
+        assert executor.start_trial(trial)
+        got, result = executor.get_next_available_result(timeout=60)
+        assert got is trial and result["count"] == 1
+        ckpt = executor.save(trial)        # disk save + durable upload
+        local_path = ckpt.value
+
+        # Node A dies: its "disk" (the local checkpoint dir) goes with it.
+        executor.drop_inflight(trial)
+        cluster.remove_node(node_a)
+        shutil.rmtree(local_path, ignore_errors=True)
+        executor.stop_trial(trial, status=Trial.PENDING)
+
+        # Reschedule anywhere (no A resource anymore) from the checkpoint.
+        trial.resources = {"CPU": 1}
+        assert executor.start_trial(trial, checkpoint=ckpt), trial.error_msg
+        got, result = executor.get_next_available_result(timeout=60)
+        assert got is trial and not isinstance(result, Exception), result
+        assert result["count"] == 2        # resumed, not restarted
+        executor.stop_trial(trial)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+        shutil.rmtree(upload, ignore_errors=True)
+
+
+def test_bohb_concentrates_on_optimum():
+    """BOHB's KDE sampling: late suggestions cluster near the optimum of a
+    1-D quadratic much tighter than the random startup phase."""
+    from ray_tpu.tune import BOHBSearcher
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    searcher = BOHBSearcher(space, metric="score", mode="max",
+                            num_samples=60, max_concurrent=1,
+                            random_fraction=0.1, seed=4)
+    xs = []
+    while True:
+        nxt = searcher.next_trial_config()
+        if nxt is None:
+            break
+        tag, cfg = nxt
+        xs.append(cfg["x"])
+        score = -(cfg["x"] - 0.7) ** 2
+        searcher.on_trial_complete(
+            tag, {"score": score, "training_iteration": 4})
+    early = np.abs(np.asarray(xs[:10]) - 0.7)
+    late = np.abs(np.asarray(xs[-20:]) - 0.7)
+    assert late.mean() < early.mean() * 0.6, (early.mean(), late.mean())
+    assert searcher.is_finished()
+
+
+def test_bohb_with_tune_run_and_asha(local_ray):
+    """End-to-end: BOHB searcher + ASHA rungs through tune.run."""
+    from ray_tpu.tune import AsyncHyperBandScheduler, BOHBSearcher
+
+    def objective(config, reporter):
+        for i in range(8):
+            reporter(score=-(config["x"] - 0.25) ** 2 + 0.01 * i)
+
+    searcher = BOHBSearcher({"x": tune.uniform(0.0, 1.0)}, metric="score",
+                            mode="max", num_samples=12, max_concurrent=2,
+                            seed=2)
+    analysis = tune.run(
+        objective,
+        search_alg=searcher,
+        scheduler=AsyncHyperBandScheduler(
+            metric="score", mode="max", max_t=8, grace_period=2),
+        verbose=0,
+    )
+    best = analysis.get_best_config(metric="score", mode="max")
+    assert abs(best["x"] - 0.25) < 0.35
