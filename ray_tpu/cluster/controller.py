@@ -227,8 +227,12 @@ class NodeController:
                          name=f"logpump-{proc.pid}").start()
 
     async def _heartbeat_loop(self):
+        from .._private.node_stats import NodeStatsSampler
+
         interval = self.config.heartbeat_interval_ms / 1000.0
         last_refresh = 0.0
+        last_report = 0.0
+        sampler = NodeStatsSampler()
         while True:
             await asyncio.sleep(interval)
             try:
@@ -243,6 +247,16 @@ class NodeController:
                     self._gcs.send_oneway({"type": "ref_refresh",
                                            "worker": self._ref_uid,
                                            "held": held})
+                if now - last_report > 2.0:
+                    # Per-node physical reporter (reference: dashboard/
+                    # reporter.py daemon): cpu/mem/disk + per-worker usage,
+                    # piggybacked on the node's GCS connection.
+                    last_report = now
+                    stats = sampler.sample([os.getpid(), *self.workers])
+                    stats["store"] = self.store.stats()
+                    self._gcs.send_oneway({"type": "node_stats",
+                                           "node_id": self.node_id,
+                                           "stats": stats})
             except ConnectionError:
                 return
 

@@ -60,6 +60,12 @@ class ClusterCoreWorker:
         self._actor_resources: Dict[bytes, Dict[str, float]] = {}
         self._blob_cache: Dict[bytes, bytes] = {}
         self._blob_cache_order: deque = deque()
+        # Objects THIS process put that contain no nested ObjectRefs: the
+        # only ones safe to inline as task args (inlining a container of
+        # refs would drop the dep pin that transitively protects its
+        # children — contained refs are not recoverable from the blob).
+        self._inline_ok: set = set()
+        self._inline_ok_order: deque = deque()
         # Same-host shared-memory arena, when one is reachable (workers get
         # it from their controller's env; drivers attach lazily — shm
         # existence doubles as the same-host check).
@@ -260,21 +266,33 @@ class ClusterCoreWorker:
             pins.extend(sobj.contained_refs)
         return ("value", sobj.to_bytes())
 
+    def _pack_ref_arg(self, oid: bytes, deps: List[bytes]):
+        """Ref arg fast path (reference: the dependency resolver's
+        small-object inlining, max_direct_call_object_size): a small value
+        blob already available locally ships inline in the task spec —
+        no directory lookup, no dep staging, no fetch on the other side."""
+        limit = self.config.max_direct_call_object_size
+        if oid in self._inline_ok:
+            blob = self._local_blob(oid)
+            if (blob is not None and blob[:1] == VAL_PREFIX
+                    and len(blob) - 1 <= limit):
+                return ("value", blob[1:])
+        deps.append(oid)
+        return ("ref", oid)
+
     def _pack_args(self, spec: TaskSpec):
         args = []
-        deps = []
+        deps: List[bytes] = []
         pins: List[bytes] = []  # refs nested inside plain-value args
         for kind, payload in spec.args:
             if kind == "ref":
-                args.append(("ref", payload.binary()))
-                deps.append(payload.binary())
+                args.append(self._pack_ref_arg(payload.binary(), deps))
             else:
                 args.append(self._pack_value(payload, pins))
         kwargs = {}
         for key, val in spec.metadata.get("kwargs", {}).items():
             if isinstance(val, ObjectRef):
-                kwargs[key] = ("ref", val.id.binary())
-                deps.append(val.id.binary())
+                kwargs[key] = self._pack_ref_arg(val.id.binary(), deps)
             else:
                 kwargs[key] = self._pack_value(val, pins)
         return args, kwargs, deps, pins
@@ -373,19 +391,17 @@ class ClusterCoreWorker:
         methods = tuple(n for n in dir(cls) if not n.startswith("_"))
         fn_id = self._export_fn(cls)
         packed_args = []
-        deps = []
+        deps: List[bytes] = []
         pins: List[bytes] = []
         for a in args:
             if isinstance(a, ObjectRef):
-                packed_args.append(("ref", a.id.binary()))
-                deps.append(a.id.binary())
+                packed_args.append(self._pack_ref_arg(a.id.binary(), deps))
             else:
                 packed_args.append(self._pack_value(a, pins))
         packed_kwargs = {}
         for key, val in (kwargs or {}).items():
             if isinstance(val, ObjectRef):
-                packed_kwargs[key] = ("ref", val.id.binary())
-                deps.append(val.id.binary())
+                packed_kwargs[key] = self._pack_ref_arg(val.id.binary(), deps)
             else:
                 packed_kwargs[key] = self._pack_value(val, pins)
         resources = spec.resources.to_dict()
@@ -533,6 +549,11 @@ class ClusterCoreWorker:
         oid = ObjectID.for_put(ctx.current_task_id, next(ctx.put_counter))
         sobj = self._ser.serialize(value)
         self._report_contained(oid.binary(), sobj.contained_refs)
+        if not sobj.contained_refs:
+            self._inline_ok.add(oid.binary())
+            self._inline_ok_order.append(oid.binary())
+            while len(self._inline_ok_order) > 65536:
+                self._inline_ok.discard(self._inline_ok_order.popleft())
         controller = self._home_controller()
         if self.local_store is not None:
             # Serialize straight into a created arena slot (plasma

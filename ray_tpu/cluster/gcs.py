@@ -98,6 +98,7 @@ class GcsServer:
         self._error_order: Any = _deque()
         self._finished_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
+        self.node_stats: Dict[str, Dict[str, Any]] = {}  # reporter data
         self._place_event = asyncio.Event()
         self._seed = 0
         self._tasks: List[asyncio.Task] = []
@@ -775,7 +776,15 @@ class GcsServer:
 
                 sched.avail = jnp.asarray(avail.astype(np.int32))
             return sched.place(demand.astype(np.int32), locality)[:T]
-        except Exception:  # noqa: BLE001 - jax unavailable: numpy spec
+        except Exception as exc:  # noqa: BLE001 - jax unavailable: numpy spec
+            # Log the first fallback loudly: a silent except here can mask
+            # a kernel regression as a quiet perf cliff.
+            if not getattr(self, "_kernel_fallback_logged", False):
+                self._kernel_fallback_logged = True
+                import sys as _sys
+
+                print(f"[gcs] placement kernel unavailable, using numpy "
+                      f"spec: {exc!r}", file=_sys.stderr)
             return _place_numpy(demand[:T], avail, locality[:T], self._seed)
 
     def _acquire(self, node_id: str, demand: ResourceSet):
@@ -1096,6 +1105,20 @@ class GcsServer:
 
             self._detach(msg, conn, work())
             return None
+
+        @s.handler("node_stats")
+        async def node_stats(msg, conn):
+            """Latest physical stats per node (reference: the reporter ->
+            dashboard datapath)."""
+            self.node_stats[msg["node_id"]] = msg["stats"]
+            return None
+
+        @s.handler("get_node_stats")
+        async def get_node_stats(msg, conn):
+            return {"ok": True, "stats": {
+                nid: st for nid, st in self.node_stats.items()
+                if nid in self.nodes and self.nodes[nid].alive
+            }}
 
         @s.handler("ref_update")
         async def ref_update(msg, conn):

@@ -29,11 +29,28 @@ th { background: #222; }
 <h1>ray_tpu dashboard</h1>
 <div id="content">loading…</div>
 <script>
+function bar(pct) {
+  const p = Math.max(0, Math.min(100, pct || 0));
+  return `<div style="width:120px;background:#333;display:inline-block">` +
+         `<div style="width:${p}%;background:${p>85?"#f66":"#7fc"};` +
+         `height:10px"></div></div> ${p}%`;
+}
 async function refresh() {
-  const [nodes, actors, objects, resources, tasks] = await Promise.all(
-    ["nodes","actors","objects","resources","tasks"].map(
+  const [nodes, actors, objects, resources, tasks, nstats] = await Promise.all(
+    ["nodes","actors","objects","resources","tasks","node_stats"].map(
       p => fetch("/api/" + p).then(r => r.json())));
-  let h = "<h2>resources</h2><table><tr><th>kind</th><th>total</th><th>available</th></tr>";
+  let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
+          "<th>mem</th><th>load</th><th>store objs</th><th>workers (pid: cpu%, MB)</th></tr>";
+  for (const [nid, s] of Object.entries(nstats)) {
+    const ws = (s.workers || []).map(
+      w => `${w.pid}: ${w.cpu_percent}%, ${(w.rss_bytes/1048576).toFixed(0)}MB`
+    ).join("<br>");
+    h += `<tr><td>${nid.slice(0,12)}</td><td>${bar(s.cpu_percent)}</td>` +
+         `<td>${bar(s.mem_percent)}</td>` +
+         `<td>${(s.load_avg||[0])[0].toFixed(2)}</td>` +
+         `<td class=num>${(s.store||{}).num_objects ?? "-"}</td><td>${ws}</td></tr>`;
+  }
+  h += "</table><h2>resources</h2><table><tr><th>kind</th><th>total</th><th>available</th></tr>";
   for (const k of Object.keys(resources.total))
     h += `<tr><td>${k}</td><td class=num>${resources.total[k]}</td>` +
          `<td class=num>${resources.available[k] ?? 0}</td></tr>`;
@@ -75,6 +92,8 @@ def _collect(endpoint: str):
     if endpoint == "resources":
         return {"total": state.cluster_resources(),
                 "available": state.available_resources()}
+    if endpoint == "node_stats":
+        return state.node_stats()
     if endpoint == "tasks":
         core = global_worker().core
         return dict(getattr(core, "stats", {}) or {})

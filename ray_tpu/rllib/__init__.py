@@ -9,6 +9,7 @@ replay), IMPALA-style async learner, ES.
 from .agents import (  # noqa: F401
     A2CTrainer,
     ApexTrainer,
+    DDPGTrainer,
     DDPPOTrainer,
     DQNTrainer,
     ESTrainer,
@@ -18,6 +19,7 @@ from .agents import (  # noqa: F401
     PPOTrainer,
     QMIXTrainer,
     SACTrainer,
+    TD3Trainer,
     Trainer,
     build_trainer,
 )
@@ -25,7 +27,9 @@ from .external_env import ExternalEnv, ExternalEnvSampler  # noqa: F401
 from .offline import JsonReader, JsonWriter  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,
+    ContinuousEnv,
     Env,
+    MoveToTarget,
     MultiAgentBandit,
     MultiAgentEnv,
     StatelessBandit,

@@ -8,3 +8,4 @@ from .pg import A2CTrainer, PGTrainer  # noqa: F401
 from .marwil import MARWILTrainer  # noqa: F401
 from .sac import SACTrainer  # noqa: F401
 from .qmix import QMIXTrainer  # noqa: F401
+from .ddpg import DDPGTrainer, TD3Trainer  # noqa: F401

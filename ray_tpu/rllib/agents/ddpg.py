@@ -1,0 +1,205 @@
+"""DDPG / TD3 for continuous action spaces
+(reference: rllib/agents/ddpg/ — ddpg.py + td3.py; Fujimoto et al. 2018).
+
+Deterministic tanh actor + twin Q critics on (s, a). TD3's three fixes over
+DDPG are all config switches here: clipped double-Q targets
+(``twin_q``), target policy smoothing noise, and delayed actor updates
+(``policy_delay``). The entire update — both critics, (maybe) the actor,
+polyak — compiles to one jitted function; the delayed actor update is a
+``lax.cond`` on the step counter, so the schedule lives inside the program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from ..execution import ReplayBuffer
+from ..models import apply_mlp, init_mlp
+from ..policy import Policy
+from ..sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+from .trainer import Trainer
+
+DDPG_CONFIG = {
+    "rollout_fragment_length": 16,
+    "train_batch_size": 64,
+    "buffer_size": 50_000,
+    "learning_starts": 300,
+    "num_train_batches_per_step": 8,
+    "lr": 1e-3,
+    "tau": 0.02,                   # polyak coefficient
+    "exploration_noise": 0.2,      # gaussian action noise while sampling
+    "twin_q": False,               # TD3 switch 1
+    "target_noise": 0.0,           # TD3 switch 2: smoothing sigma
+    "target_noise_clip": 0.5,
+    "policy_delay": 1,             # TD3 switch 3
+    "hiddens": [64, 64],
+}
+
+TD3_CONFIG = dict(
+    DDPG_CONFIG,
+    twin_q=True,
+    target_noise=0.2,
+    policy_delay=2,
+)
+
+
+class DDPGPolicy(Policy):
+    def __init__(self, obs_dim: int, action_dim: int, config: Dict[str, Any]):
+        self.config = config
+        self.action_dim = action_dim
+        hid = config.get("hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        ka, k1, k2, self._act_key = jax.random.split(key, 4)
+        self.params = {
+            "actor": init_mlp(ka, [obs_dim] + hid + [action_dim]),
+            "q1": init_mlp(k1, [obs_dim + action_dim] + hid + [1]),
+            "q2": init_mlp(k2, [obs_dim + action_dim] + hid + [1]),
+        }
+        self.target = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.opt = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.opt.init(self.params)
+        self._updates = jnp.zeros((), jnp.int32)
+        gamma = config.get("gamma", 0.99)
+        tau = config.get("tau", 0.02)
+        twin = bool(config.get("twin_q", False))
+        t_noise = float(config.get("target_noise", 0.0))
+        t_clip = float(config.get("target_noise_clip", 0.5))
+        delay = int(config.get("policy_delay", 1))
+
+        def actor(params, obs):
+            return jnp.tanh(apply_mlp(params["actor"], obs))
+
+        def q_val(params, name, obs, act):
+            return apply_mlp(params[name],
+                             jnp.concatenate([obs, act], -1))[..., 0]
+
+        def update(params, target, opt_state, n_updates, batch, key):
+            a_next = actor(target, batch[NEXT_OBS])
+            if t_noise > 0:
+                eps = jnp.clip(
+                    t_noise * jax.random.normal(key, a_next.shape),
+                    -t_clip, t_clip)
+                a_next = jnp.clip(a_next + eps, -1.0, 1.0)
+            q1_t = q_val(target, "q1", batch[NEXT_OBS], a_next)
+            q_next = (jnp.minimum(q1_t, q_val(target, "q2",
+                                              batch[NEXT_OBS], a_next))
+                      if twin else q1_t)
+            y = jax.lax.stop_gradient(
+                batch[REWARDS] + gamma * (1.0 - batch[DONES]) * q_next)
+
+            def critic_loss(params):
+                loss = jnp.mean(
+                    (q_val(params, "q1", batch[OBS], batch[ACTIONS]) - y) ** 2)
+                if twin:
+                    loss += jnp.mean(
+                        (q_val(params, "q2", batch[OBS],
+                               batch[ACTIONS]) - y) ** 2)
+                return loss
+
+            def actor_loss(params):
+                a = actor(params, batch[OBS])
+                # Maximize Q1 under the current policy; critics frozen.
+                frozen = jax.tree_util.tree_map(
+                    jax.lax.stop_gradient,
+                    {"q1": params["q1"]})
+                return -jnp.mean(q_val({"q1": frozen["q1"]}, "q1",
+                                       batch[OBS], a))
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(params)
+
+            def with_actor(_):
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(params)
+                return a_loss, a_grads["actor"]
+
+            def without_actor(_):
+                zero = jax.tree_util.tree_map(
+                    jnp.zeros_like, params["actor"])
+                return jnp.zeros(()), zero
+
+            a_loss, actor_grad = jax.lax.cond(
+                n_updates % delay == 0, with_actor, without_actor, None)
+            grads = dict(c_grads)
+            grads["actor"] = actor_grad
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_new = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target, params)
+            return params, target_new, opt_state, n_updates + 1, {
+                "critic_loss": c_loss, "actor_loss": a_loss,
+            }
+
+        self._actor = jax.jit(actor)
+        self._update = jax.jit(update)
+        self.noise = float(config.get("exploration_noise", 0.2))
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        a = np.asarray(self._actor(self.params,
+                                   jnp.asarray(obs, jnp.float32)))
+        if explore:
+            self._act_key, sub = jax.random.split(self._act_key)
+            a = np.clip(
+                a + self.noise * np.asarray(
+                    jax.random.normal(sub, a.shape)), -1.0, 1.0)
+        return a, None, None
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)}
+        self._act_key, sub = jax.random.split(self._act_key)
+        (self.params, self.target, self.opt_state, self._updates,
+         stats) = self._update(self.params, self.target, self.opt_state,
+                               self._updates, dev, sub)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params, "target": self.target})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target = jax.device_put(weights["target"])
+
+
+class _ContinuousReplayTrainer(Trainer):
+    def _build(self, config: Dict) -> None:
+        self.replay = ReplayBuffer(config["buffer_size"],
+                                   seed=config["seed"])
+
+    def _train_step(self) -> Dict:
+        cfg = self.raw_config
+        remote = self.workers.remote_workers()
+        if remote:
+            batches = ray_tpu.get([w.sample.remote() for w in remote])
+        else:
+            batches = [self.workers.local_worker().sample()]
+        for b in batches:
+            self.replay.add_batch(b)
+            self._steps_sampled += b.count
+        stats: Dict = {"buffer_size": len(self.replay)}
+        if self._steps_sampled < cfg["learning_starts"]:
+            return stats
+        policy = self.workers.local_worker().policy
+        for _ in range(cfg["num_train_batches_per_step"]):
+            batch = self.replay.sample(cfg["train_batch_size"])
+            stats.update(policy.learn_on_batch(batch))
+            self._steps_trained += batch.count
+        self.workers.sync_weights()
+        return stats
+
+
+class DDPGTrainer(_ContinuousReplayTrainer):
+    _policy_cls = DDPGPolicy
+    _default_config = DDPG_CONFIG
+    _name = "DDPG"
+
+
+class TD3Trainer(_ContinuousReplayTrainer):
+    _policy_cls = DDPGPolicy
+    _default_config = TD3_CONFIG
+    _name = "TD3"

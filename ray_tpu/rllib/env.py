@@ -94,11 +94,46 @@ class StatelessBandit(Env):
         return np.zeros(1, dtype=np.float32), reward, True, {}
 
 
+class ContinuousEnv(Env):
+    """Continuous-action env protocol: ``action_dim`` replaces
+    ``num_actions``; actions are float arrays in [-1, 1]^action_dim
+    (reference: rllib's Box action spaces)."""
+
+    action_dim: int = 0
+    num_actions: int = 0
+
+
+class MoveToTarget(ContinuousEnv):
+    """One-step continuous control: obs is a random target in [-1,1]^d,
+    reward = -||action - target||^2. The continuous analogue of
+    StatelessBandit: optimal policy copies the observation, so actor-critic
+    methods show learning in a handful of iterations."""
+
+    observation_dim = 2
+    action_dim = 2
+
+    def __init__(self):
+        self.rng = np.random.RandomState(0)
+        self.target: Optional[np.ndarray] = None
+
+    def seed(self, seed: int) -> None:
+        self.rng = np.random.RandomState(seed)
+
+    def reset(self) -> np.ndarray:
+        self.target = self.rng.uniform(-0.8, 0.8, 2).astype(np.float32)
+        return self.target.copy()
+
+    def step(self, action):
+        err = float(np.sum((np.asarray(action) - self.target) ** 2))
+        return self.target.copy(), -err, True, {}
+
+
 class VectorEnv:
     """E independent copies stepped in lockstep (reference: rllib/env/vector_env.py).
 
     Observations come back stacked [E, obs_dim] so the policy runs one batched
-    (jitted) forward pass; done sub-envs auto-reset.
+    (jitted) forward pass; done sub-envs auto-reset. Continuous envs
+    (``action_dim > 0``) receive float action vectors; discrete ones ints.
     """
 
     def __init__(self, make_env, num_envs: int, base_seed: int = 0):
@@ -108,6 +143,7 @@ class VectorEnv:
         self.num_envs = num_envs
         self.observation_dim = self.envs[0].observation_dim
         self.num_actions = self.envs[0].num_actions
+        self.action_dim = getattr(self.envs[0], "action_dim", 0)
         self.episode_rewards = np.zeros(num_envs)
         self.episode_lens = np.zeros(num_envs, dtype=np.int64)
         self.completed: List[Tuple[float, int]] = []  # (reward, length)
@@ -118,7 +154,7 @@ class VectorEnv:
     def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[Dict]]:
         obs, rews, dones, infos = [], [], [], []
         for i, (env, a) in enumerate(zip(self.envs, actions)):
-            o, r, d, info = env.step(int(a))
+            o, r, d, info = env.step(a if self.action_dim else int(a))
             self.episode_rewards[i] += r
             self.episode_lens[i] += 1
             if d:
@@ -248,6 +284,7 @@ class TwoStepGame(MultiAgentEnv):
 _ENV_REGISTRY = {
     "CartPole": CartPole,
     "StatelessBandit": StatelessBandit,
+    "MoveToTarget": MoveToTarget,
     "MultiAgentBandit": MultiAgentBandit,
     "TwoStepGame": TwoStepGame,
 }

@@ -32,8 +32,11 @@ class RolloutWorker(ParallelIteratorWorker):
             base_seed=config.get("seed", 0) * 1000 + worker_index * num_envs)
         cfg = dict(config)
         cfg["seed"] = config.get("seed", 0) * 7919 + worker_index
+        # Continuous envs expose action_dim; discrete ones num_actions —
+        # either way the second policy arg is the action-space size.
+        act_size = self.vec_env.action_dim or self.vec_env.num_actions
         self.policy: Policy = policy_cls(
-            self.vec_env.observation_dim, self.vec_env.num_actions, cfg)
+            self.vec_env.observation_dim, act_size, cfg)
         self.obs = self.vec_env.reset()
         self.total_steps = 0
         ParallelIteratorWorker.__init__(self, self._sample_forever(), False)

@@ -54,6 +54,26 @@ def objects() -> Dict[str, Dict[str, Any]]:
     return out
 
 
+_local_sampler = None
+
+
+def node_stats() -> Dict[str, Dict[str, Any]]:
+    """node_id -> physical stats from each node's reporter (reference:
+    dashboard reporter datapath). Local mode samples this process's host."""
+    core = _core()
+    gcs = getattr(core, "gcs", None)
+    if gcs is not None:
+        return gcs.call({"type": "get_node_stats"})["stats"]
+    global _local_sampler
+    from ._private.node_stats import NodeStatsSampler
+
+    if _local_sampler is None:
+        _local_sampler = NodeStatsSampler()
+    import os as _os
+
+    return {"local": _local_sampler.sample([_os.getpid()])}
+
+
 def cluster_resources() -> Dict[str, float]:
     return _core().cluster_resources()
 

@@ -234,7 +234,7 @@ class BOHBSearcher(_SpaceSearcher):
                     if abs(dom.encode(val) - anchor[d]) <= 2 * self._bw:
                         break
                 cand[name] = val
-            x = [self._domains[n].encode(cand[n]) for n in self._names]
+            x = self._encode(cand)
             score = (self._kde_logpdf(x, good)
                      - self._kde_logpdf(x, bad))
             if score > best_score:
