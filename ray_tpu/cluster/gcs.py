@@ -51,8 +51,16 @@ class GcsServer:
         self.config = config
         self.server = RpcServer(host, port)
         # Snapshot persistence (reference: GCS tables against persistent
-        # Redis, test_gcs_fault_tolerance.py): state survives a GCS restart.
+        # Redis via the store-client abstraction, gcs/store_client/):
+        # state survives a GCS restart. Backend selected by URI — plain
+        # path = atomic file, sqlite://path = transactional history.
         self.persist_path = persist_path
+        if persist_path:
+            from .persistence import open_storage
+
+            self._storage = open_storage(persist_path)
+        else:
+            self._storage = None
         self.nodes: Dict[str, NodeEntry] = {}
         self._node_order: List[str] = []       # index -> node_id for the kernel
         self.actors: Dict[str, Dict[str, Any]] = {}
@@ -161,6 +169,7 @@ class GcsServer:
             t.cancel()
         if self.persist_path:
             self._write_snapshot()
+            self._storage.close()
         await self.server.stop()
 
     # ------------------------------------------------------------ persistence
@@ -195,28 +204,17 @@ class GcsServer:
         self._write_snapshot_bytes(payload)
 
     def _write_snapshot_bytes(self, payload: bytes) -> None:
-        import os
-        import threading
-
-        # Unique per writing thread: the shutdown snapshot (loop thread) can
-        # overlap an in-flight periodic write (to_thread worker); sharing a
-        # tmp name would interleave/clobber.
-        tmp = (f"{self.persist_path}.tmp.{os.getpid()}"
-               f".{threading.get_ident()}")
-        try:
-            with open(tmp, "wb") as f:
-                f.write(payload)
-            os.replace(tmp, self.persist_path)  # atomic
-        except OSError:
-            pass
+        self._storage.write(payload)
 
     def _load_snapshot(self) -> None:
         import pickle as _pickle
 
+        payload = self._storage.read()
+        if payload is None:
+            return
         try:
-            with open(self.persist_path, "rb") as f:
-                state = _pickle.load(f)
-        except (OSError, EOFError, _pickle.UnpicklingError):
+            state = _pickle.loads(payload)
+        except (EOFError, _pickle.UnpicklingError, ValueError):
             return
         for n in state.get("nodes", []):
             entry = NodeEntry(
@@ -630,6 +628,7 @@ class GcsServer:
         # Drop object locations on the dead node; recover/retry what it
         # was running; restart actors homed there.
         self._node_conns.pop(node.node_id, None)
+        self.node_stats.pop(node.node_id, None)  # reporter data dies with it
         for oid, entry in list(self.objects.items()):
             entry["locations"].discard(node.node_id)
             if not entry["locations"]:

@@ -1,0 +1,117 @@
+"""GCS storage clients: pluggable snapshot persistence backends
+(reference: ``src/ray/gcs/store_client/`` — redis_store_client /
+in_memory_store_client behind one StoreClient interface; no redis in this
+image, so the durable backends are an atomic-rename file and a
+transactional sqlite history).
+
+Selected by the ``--persist`` URI:
+    /path/snap.pkl            -> FileStorage (atomic replace, 1 snapshot)
+    sqlite:///path/snap.db    -> SqliteStorage (transactional, keeps the
+                                 last N snapshots; a torn write can never
+                                 corrupt the previous one)
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+from typing import Optional
+
+
+class GcsStorageClient:
+    def write(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def read(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileStorage(GcsStorageClient):
+    """Single-snapshot file with atomic rename (the original backend)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, payload: bytes) -> None:
+        # Unique per writing thread: the shutdown snapshot (loop thread)
+        # can overlap an in-flight periodic write (to_thread worker).
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)  # atomic
+        except OSError:
+            pass
+
+    def read(self) -> Optional[bytes]:
+        try:
+            with open(self.path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class SqliteStorage(GcsStorageClient):
+    """Versioned snapshots in one sqlite database (stdlib).
+
+    Each write is a transaction appending a new row and pruning beyond
+    ``keep``; crash-consistency comes from sqlite's journal, so a torn
+    write never damages the previous snapshot. ``read`` returns the
+    newest complete row.
+    """
+
+    def __init__(self, path: str, keep: int = 5):
+        self.path = path
+        self.keep = keep
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS snapshots ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " ts REAL NOT NULL,"
+            " payload BLOB NOT NULL)")
+        self._conn.commit()
+
+    def write(self, payload: bytes) -> None:
+        try:
+            with self._lock, self._conn:
+                self._conn.execute(
+                    "INSERT INTO snapshots (ts, payload) VALUES (?, ?)",
+                    (time.time(), sqlite3.Binary(payload)))
+                self._conn.execute(
+                    "DELETE FROM snapshots WHERE id NOT IN ("
+                    " SELECT id FROM snapshots ORDER BY id DESC LIMIT ?)",
+                    (self.keep,))
+        except sqlite3.Error:
+            pass
+
+    def read(self) -> Optional[bytes]:
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT payload FROM snapshots "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+            return bytes(row[0]) if row else None
+        except sqlite3.Error:
+            return None
+
+    def history(self) -> int:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT COUNT(*) FROM snapshots").fetchone()[0]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def open_storage(uri: str) -> GcsStorageClient:
+    if uri.startswith("sqlite://"):
+        return SqliteStorage(uri[len("sqlite://"):])
+    return FileStorage(uri)

@@ -1,13 +1,24 @@
-"""Model catalog: pure-jax MLPs (reference: rllib/models/catalog.py).
+"""Model catalog: pure-jax networks (reference: rllib/models/catalog.py —
+the fcnet/visionnet/lstm model zoo + action distributions).
 
 Plain pytree-of-arrays params and functional apply: no framework object
 between the optimizer and XLA, so policy updates jit/donate cleanly and ES can
-vmap over whole parameter pytrees.
+vmap over whole parameter pytrees. Networks:
+
+  MLP        — init_mlp / apply_mlp (the fcnet default)
+  ConvNet    — init_convnet / apply_convnet (visionnet: NHWC conv stack on
+               the MXU via lax.conv, flatten, dense head)
+  LSTM       — init_lstm / apply_lstm (use_lstm wrapper: per-step fused
+               gate matmul, scanned over time)
+
+Action distributions (rllib/models/action_dist.py): Categorical for
+discrete policies and DiagGaussian (tanh-squashed option) for continuous —
+sample/logp/entropy as pure functions, usable inside any jitted loss.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,3 +57,140 @@ def unflatten_like(flat: jnp.ndarray, params):
         out.append(flat[i:i + p.size].reshape(p.shape))
         i += p.size
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# ConvNet (reference: rllib/models/tf/visionnet.py) — NHWC conv stack.
+# filters: [(out_channels, kernel, stride), ...]; dense head sizes appended.
+# ---------------------------------------------------------------------------
+
+DEFAULT_FILTERS = [(16, 4, 2), (32, 4, 2)]
+
+
+def init_convnet(key, input_shape: Sequence[int],
+                 filters: Sequence[Tuple[int, int, int]] = None,
+                 head_sizes: Sequence[int] = (64,),
+                 num_outputs: int = 2):
+    """input_shape = (H, W, C). Returns (params, strides): strides are
+    static config, kept OUT of the differentiable pytree (an int leaf
+    would break jax.grad over the params)."""
+    filters = list(filters or DEFAULT_FILTERS)
+    H, W, C = input_shape
+    conv_params = []
+    strides = []
+    cin = C
+    for cout, k, s in filters:
+        key, sub = jax.random.split(key)
+        fan_in = k * k * cin
+        w = jax.random.normal(sub, (k, k, cin, cout)) * jnp.sqrt(2.0 / fan_in)
+        conv_params.append((w, jnp.zeros(cout)))
+        strides.append(s)
+        H = -(-H // s)
+        W = -(-W // s)
+        cin = cout
+    key, sub = jax.random.split(key)
+    head = init_mlp(sub, [H * W * cin, *head_sizes, num_outputs])
+    return {"conv": conv_params, "head": head}, tuple(strides)
+
+
+def apply_convnet(params: Dict, x: jnp.ndarray,
+                  strides: Sequence[int] = None) -> jnp.ndarray:
+    """x: [B, H, W, C] float -> [B, num_outputs]."""
+    if strides is None:
+        strides = [s for _, _, s in DEFAULT_FILTERS]
+    for (w, b), stride in zip(params["conv"], strides):
+        x = jax.lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + b)
+    x = x.reshape(x.shape[0], -1)
+    return apply_mlp(params["head"], x)
+
+
+# ---------------------------------------------------------------------------
+# LSTM wrapper (reference: rllib/models/tf/recurrent_net.py use_lstm) —
+# one fused gate matmul per step, scanned over time.
+# ---------------------------------------------------------------------------
+
+
+def init_lstm(key, input_dim: int, hidden: int, num_outputs: int) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = jnp.sqrt(1.0 / (input_dim + hidden))
+    return {
+        "wx": jax.random.normal(k1, (input_dim, 4 * hidden)) * scale,
+        "wh": jax.random.normal(k2, (hidden, 4 * hidden)) * scale,
+        "b": jnp.zeros(4 * hidden),
+        "head": init_mlp(k3, [hidden, num_outputs]),
+    }
+
+
+def lstm_initial_state(hidden: int, batch: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return (jnp.zeros((batch, hidden)), jnp.zeros((batch, hidden)))
+
+
+def apply_lstm(params: Dict, xs: jnp.ndarray, state=None):
+    """xs: [B, T, D] -> (logits [B, T, num_outputs], final (h, c)).
+
+    The whole sequence runs as one lax.scan, so BPTT is a single XLA
+    program regardless of T.
+    """
+    B, T, _ = xs.shape
+    hidden = params["wh"].shape[0]
+    if state is None:
+        state = lstm_initial_state(hidden, B)
+
+    def step(carry, x_t):
+        h, c = carry
+        gates = x_t @ params["wx"] + h @ params["wh"] + params["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), hs = jax.lax.scan(step, state, xs.transpose(1, 0, 2))
+    logits = apply_mlp(params["head"], hs)            # [T, B, out]
+    return logits.transpose(1, 0, 2), (h, c)
+
+
+# ---------------------------------------------------------------------------
+# Action distributions (reference: rllib/models/tf/tf_action_dist.py) —
+# pure functions over parameter arrays, jit/vmap friendly.
+# ---------------------------------------------------------------------------
+
+
+class Categorical:
+    @staticmethod
+    def sample(key, logits):
+        return jax.random.categorical(key, logits)
+
+    @staticmethod
+    def logp(logits, actions):
+        logp_all = jax.nn.log_softmax(logits)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class DiagGaussian:
+    """mean/log_std parameterization; optional tanh squash to [-1, 1]."""
+
+    @staticmethod
+    def sample(key, mean, log_std, squash: bool = False):
+        a = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+        return jnp.tanh(a) if squash else a
+
+    @staticmethod
+    def logp(mean, log_std, actions):
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var + 2 * log_std
+                    + jnp.log(2 * jnp.pi)),
+            axis=-1)
+
+    @staticmethod
+    def entropy(log_std):
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)

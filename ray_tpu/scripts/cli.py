@@ -66,63 +66,138 @@ def _launch_env() -> Dict[str, str]:
     return env
 
 
+def _launch_head(resources: Dict, num_workers: int, port: int = 0):
+    """Start a head process; returns (address, pid, log_path)."""
+    cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
+           "--port", str(port),
+           "--resources", json.dumps(resources),
+           "--num-workers", str(num_workers)]
+    # Output goes to LOG FILES, never a pipe: the head outlives this CLI
+    # process, and an unread pipe fills after ~64KB of worker logs and
+    # then blocks the controller's event loop on print() — wedging the
+    # whole node (observed: register_worker RPCs timing out).
+    log_path = f"/tmp/ray_tpu_head_{os.getpid()}.log"
+    out = open(log_path, "w")
+    proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                            env=_launch_env())
+    # wait for the gcs_started event line to appear in the log
+    deadline = time.monotonic() + 60
+    gcs_port = None
+    with open(log_path) as tail:
+        while time.monotonic() < deadline and gcs_port is None:
+            line = tail.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"head process died during startup; see {log_path}")
+                time.sleep(0.05)
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if event.get("event") == "gcs_started":
+                gcs_port = event["port"]
+    if gcs_port is None:
+        proc.kill()
+        raise SystemExit("timed out waiting for GCS startup")
+    return f"127.0.0.1:{gcs_port}", proc.pid, log_path
+
+
+def _launch_worker_node(address: str, resources: Dict, num_workers: int,
+                        label: str = "") -> int:
+    """Start a worker node joined to ``address``; returns its pid."""
+    cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
+           "--gcs", address,
+           "--resources", json.dumps(resources),
+           "--num-workers", str(num_workers)]
+    if label:
+        cmd += ["--label", label]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=_launch_env())
+    return proc.pid
+
+
 def cmd_start(args) -> None:
     resources = json.loads(args.resources) if args.resources else {"CPU": 4}
     if args.head:
-        cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
-               "--port", str(args.port),
-               "--resources", json.dumps(resources),
-               "--num-workers", str(args.num_workers)]
-        # Output goes to LOG FILES, never a pipe: the head outlives this CLI
-        # process, and an unread pipe fills after ~64KB of worker logs and
-        # then blocks the controller's event loop on print() — wedging the
-        # whole node (observed: register_worker RPCs timing out).
-        log_path = f"/tmp/ray_tpu_head_{os.getpid()}.log"
-        out = open(log_path, "w")
-        proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
-                                env=_launch_env())
-        # wait for the gcs_started event line to appear in the log
-        deadline = time.monotonic() + 60
-        port = None
-        with open(log_path) as tail:
-            while time.monotonic() < deadline and port is None:
-                line = tail.readline()
-                if not line:
-                    if proc.poll() is not None:
-                        raise SystemExit(
-                            f"head process died during startup; "
-                            f"see {log_path}")
-                    time.sleep(0.05)
-                    continue
-                try:
-                    event = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if event.get("event") == "gcs_started":
-                    port = event["port"]
-        if port is None:
-            proc.kill()
-            raise SystemExit("timed out waiting for GCS startup")
-        address = f"127.0.0.1:{port}"
-        _save_session({"address": address, "head_pid": proc.pid,
+        address, pid, log_path = _launch_head(
+            resources, args.num_workers, args.port)
+        _save_session({"address": address, "head_pid": pid,
                        "worker_pids": [], "head_log": log_path})
-        print(f"started head: address={address} pid={proc.pid}")
+        print(f"started head: address={address} pid={pid}")
         print(f"logs: {log_path}")
         print(f"connect with ray_tpu.init(address={address!r})")
         return
 
     if not args.address:
         raise SystemExit("--address required to start a worker node")
-    cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
-           "--gcs", args.address,
-           "--resources", json.dumps(resources),
-           "--num-workers", str(args.num_workers)]
-    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL, env=_launch_env())
+    pid = _launch_worker_node(args.address, resources, args.num_workers)
     state = _load_session()
-    state.setdefault("worker_pids", []).append(proc.pid)
+    state.setdefault("worker_pids", []).append(pid)
     _save_session(state)
-    print(f"started worker node pid={proc.pid} -> {args.address}")
+    print(f"started worker node pid={pid} -> {args.address}")
+
+
+def _read_cluster_config(path: str) -> Dict:
+    with open(path) as f:
+        text = f.read()
+    cfg = None
+    try:
+        cfg = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # noqa: PLC0415 - optional, like the reference's
+
+            cfg = yaml.safe_load(text)
+        except ImportError:
+            raise SystemExit(
+                f"{path} is not valid JSON and pyyaml is unavailable")
+        except Exception as e:  # noqa: BLE001 - yaml syntax errors
+            raise SystemExit(f"{path} is not valid JSON or YAML: {e}")
+    if not isinstance(cfg, dict):
+        raise SystemExit(
+            f"{path} must parse to a mapping with 'head'/'worker_nodes' "
+            f"keys, got {type(cfg).__name__}")
+    return cfg
+
+
+def cmd_up(args) -> None:
+    """Bring up a whole cluster from a config file (reference: ray up,
+    scripts.py:659 — minus cloud provisioning: node groups become local
+    ``launch node`` processes, the same substrate the autoscaler's
+    SubprocessProvider scales).
+
+    Config (JSON or YAML):
+        {"head": {"resources": {"CPU": 4}, "num_workers": 2},
+         "worker_nodes": [
+             {"resources": {"CPU": 4}, "count": 2, "num_workers": 2}]}
+    """
+    cfg = _read_cluster_config(args.config)
+    head = cfg.get("head", {})
+    address, head_pid, log_path = _launch_head(
+        head.get("resources", {"CPU": 4}), head.get("num_workers", 2))
+    worker_pids = []
+    n_nodes = 0
+    for group_idx, group in enumerate(cfg.get("worker_nodes", [])):
+        for i in range(group.get("count", 1)):
+            worker_pids.append(_launch_worker_node(
+                address, group.get("resources", {"CPU": 4}),
+                group.get("num_workers", 2),
+                label=f"group{group_idx}-{i}"))
+            n_nodes += 1
+    _save_session({"address": address, "head_pid": head_pid,
+                   "worker_pids": worker_pids, "head_log": log_path,
+                   "config": os.path.abspath(args.config)})
+    print(f"cluster up: address={address} head_pid={head_pid} "
+          f"worker_nodes={n_nodes}")
+    print(f"connect with ray_tpu.init(address={address!r})")
+
+
+def cmd_down(args) -> None:
+    """Tear down the session's cluster (reference: ray down,
+    scripts.py:703)."""
+    cmd_stop(args)
 
 
 def cmd_stop(args) -> None:
@@ -344,6 +419,13 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     sp = sub.add_parser("stop", help="stop the session's cluster")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="start a cluster from a config file")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down the session's cluster")
+    sp.set_defaults(fn=cmd_down)
 
     for name, fn in [("status", cmd_status), ("memory", cmd_memory),
                      ("kill_random_node", cmd_kill_random_node)]:

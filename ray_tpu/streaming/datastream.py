@@ -82,7 +82,13 @@ class DataStream:
 
 class StreamingContext:
     def __init__(self, batch_size: int = BATCH_SIZE):
+        import uuid
+
         self.graph = JobGraph()
+        # Channel ids embed a job-unique component: shm channel names are
+        # hashes of the channel id, and two concurrent jobs with colliding
+        # ids would unlink/attach each other's live rings.
+        self._job_uid = uuid.uuid4().hex[:10]
         self._op_counter = itertools.count()
         self._sources: List[tuple] = []  # (op_id, iterable)
         self._sinks: List[int] = []
@@ -118,7 +124,7 @@ class StreamingContext:
         for edge in self.graph.edges:
             src_ws = self._workers[edge.src_id]
             dst_ws = self._workers[edge.dst_id]
-            prefix = f"e{edge.src_id}-{edge.dst_id}"
+            prefix = f"{self._job_uid}:e{edge.src_id}-{edge.dst_id}"
             calls = []
             for i, sw in enumerate(src_ws):
                 calls.append(sw.add_output.remote(

@@ -1,13 +1,20 @@
-"""JobWorker actor + credit-based channel protocol
+"""JobWorker actor + two-transport channel protocol
 (reference: streaming/python/runtime/worker.py + streaming/src/channel.h,
-data_writer/data_reader, flow_control).
+data_writer/data_reader, ring_buffer, flow_control).
 
-One actor per operator instance. Data moves downstream in batches via
-``push(channel, seq, items)`` actor calls; each channel has a credit budget
-(max unacked batches, the reference's ring-buffer capacity). A sender with no
-credits blocks on its oldest in-flight ack — that's the backpressure path.
-EOF markers propagate when all of an instance's input channels are exhausted;
-stateful operators (reduce) flush on EOF.
+One actor per operator instance. Each edge negotiates its transport at
+wiring time:
+
+  native — co-located pairs stream pickled batches through a C++
+    shared-memory SPSC ring (``_native/channel.cc``, the reference's
+    plasma-queue channel): no per-batch RPC, backpressure = ring capacity,
+    EOF ordering by ring close + drain-thread join.
+  actor  — cross-host fallback: ``push(channel, seq, items)`` calls with a
+    credit budget (max unacked batches); large batches ride the object
+    store as refs. A sender with no credits blocks on its oldest ack.
+
+EOF markers propagate when all of an instance's input channels are
+exhausted; stateful operators (reduce) flush on EOF.
 """
 
 from __future__ import annotations
@@ -64,16 +71,57 @@ def _stable_hash(key: Any) -> int:
     return zlib.crc32(data)
 
 
+def _chan_shm_name(channel_id: str) -> str:
+    import hashlib
+
+    digest = hashlib.blake2b(channel_id.encode(), digest_size=10).hexdigest()
+    return f"rtch-{digest}"
+
+
 class _OutChannel:
-    """Sender side of one edge instance pair (reference: ProducerChannel)."""
+    """Sender side of one edge instance pair (reference: ProducerChannel).
+
+    Two transports, negotiated at wiring time:
+      native — a shared-memory SPSC ring (``_native/channel.cc``, the
+        reference's plasma-queue channel): batches are pickled straight
+        into the ring; backpressure IS the ring capacity; no per-batch RPC
+        at all. Used when producer and consumer share a host (the shm
+        open succeeds on the consumer side).
+      actor  — pickled push() calls with credit-based acks, large batches
+        riding the object store as refs. The cross-host fallback.
+    """
 
     def __init__(self, dst_handle, channel_id: str):
         self.dst = dst_handle
         self.channel_id = channel_id
         self.seq = 0
         self.inflight: deque = deque()  # (ack ref, data ref | None)
+        self._writer = None
+        try:
+            from .._native.channel import ChannelWriter
+
+            name = _chan_shm_name(channel_id)
+            writer = ChannelWriter(name, capacity=8 * 1024 * 1024)
+        except Exception:  # noqa: BLE001 - lib unavailable: actor transport
+            return
+        try:
+            ok = ray_tpu.get(
+                self.dst.open_native_channel.remote(channel_id, name))
+        except Exception:  # noqa: BLE001 - consumer dead/unreachable
+            ok = False
+        if ok:
+            self._writer = writer
+        else:
+            writer.close(unlink=True)  # no reader ever attached
 
     def send(self, items: List[Any]) -> None:
+        if self._writer is not None:
+            import pickle as _pickle
+
+            self._writer.write(_pickle.dumps(items, protocol=5),
+                               timeout=120.0)
+            self.seq += 1
+            return
         if len(self.inflight) >= CHANNEL_CREDITS:
             # Out of credits: block on the oldest ack (backpressure).
             self._ack_oldest()
@@ -100,6 +148,12 @@ class _OutChannel:
 
     def send_eof(self) -> None:
         self.flush()
+        if self._writer is not None:
+            # Close the ring first: the consumer's push_eof joins its drain
+            # thread, which exits only after consuming the full backlog —
+            # so EOF can never overtake in-flight ring data.
+            self._writer.close()
+            self._writer = None
         ray_tpu.get(self.dst.push_eof.remote(self.channel_id))
 
     def flush(self) -> None:
@@ -130,6 +184,8 @@ class JobWorker:
         self._reduce_state: Dict[Any, Any] = {}
         self._sink_results: List[Any] = []
         self._out_buffers: Dict[int, List[Any]] = defaultdict(list)
+        self._native_readers: Dict[str, Tuple[Any, Any]] = {}
+        self._native_errors: Dict[str, bool] = {}
         self.records_in = 0
         self.records_out = 0
 
@@ -148,6 +204,50 @@ class JobWorker:
 
     # ---- data plane ----
 
+    def open_native_channel(self, channel_id: str, shm_name: str) -> bool:
+        """Consumer half of the native-transport handshake: attach to the
+        producer's shm ring and drain it on a dedicated thread (the
+        reference's DataReader loop). Returns False when the segment is
+        unreachable (producer on another host) — sender falls back to
+        actor-call pushes."""
+        import pickle as _pickle
+
+        try:
+            from .._native.channel import (
+                ChannelClosed, ChannelReader, ChannelTimeout,
+            )
+
+            # The writer created the segment BEFORE this call, so a local
+            # open succeeds immediately and ENOENT means cross-host — a
+            # long retry here would only stall wiring (0.5s covers fs
+            # visibility jitter, nothing more).
+            reader = ChannelReader(shm_name, open_timeout=0.5)
+        except Exception:  # noqa: BLE001 - cross-host or lib unavailable
+            return False
+
+        def drain():
+            while True:
+                try:
+                    items = _pickle.loads(reader.read(timeout=60.0))
+                except ChannelTimeout:
+                    continue        # idle source; the ring is still live
+                except ChannelClosed:
+                    return
+                except Exception:  # noqa: BLE001 - corrupt frame/teardown
+                    import traceback
+
+                    traceback.print_exc()
+                    self._native_errors[channel_id] = True
+                    return
+                with self._lock:
+                    self._process(items)
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name=f"chan-{channel_id[-12:]}")
+        t.start()
+        self._native_readers[channel_id] = (reader, t)
+        return True
+
     def push(self, channel_id: str, seq: int, items: List[Any]) -> int:
         """Receive one batch; process synchronously (the actor's ordered
         queue is the inbound buffer; credits bound its depth)."""
@@ -156,6 +256,24 @@ class JobWorker:
         return seq  # ack
 
     def push_eof(self, channel_id: str) -> bool:
+        native = self._native_readers.pop(channel_id, None)
+        if native is not None:
+            # The sender closed the ring before this call; the drain thread
+            # exits once the backlog is fully consumed. Joining it here
+            # guarantees EOF ordering behind every data batch.
+            reader, thread = native
+            thread.join(timeout=300.0)
+            if thread.is_alive():
+                # Join timed out: closing would unmap the ring under the
+                # live drain thread (segfault). Leak the mapping instead
+                # and surface the stall.
+                raise RuntimeError(
+                    f"native channel {channel_id} still draining after "
+                    f"300s; refusing EOF")
+            reader.close()
+            if self._native_errors.pop(channel_id, None):
+                raise RuntimeError(
+                    f"native channel {channel_id} reader failed mid-stream")
         with self._lock:
             self._eof_inputs.add(channel_id)
             if self._eof_inputs >= self._expected_inputs:

@@ -63,3 +63,38 @@ def test_cli_submit_exec_stack(tmp_path):
         assert "Thread" in stack.stdout or "File" in stack.stdout
     finally:
         _cli(env, "stop", timeout=30)
+
+
+@pytest.mark.slow
+def test_cli_up_down(tmp_path):
+    """Cluster-from-config (reference: ray up/down): head + a worker node
+    group come up, are visible via status, and tear down cleanly."""
+    import json
+
+    env = _cli_env(tmp_path)
+    cfg = tmp_path / "cluster.json"
+    cfg.write_text(json.dumps({
+        "head": {"resources": {"CPU": 2}, "num_workers": 1},
+        "worker_nodes": [
+            {"resources": {"CPU": 2, "pool": 1}, "count": 2,
+             "num_workers": 1},
+        ],
+    }))
+    up = _cli(env, "up", str(cfg))
+    assert up.returncode == 0, (up.stdout, up.stderr)
+    assert "cluster up:" in up.stdout
+    try:
+        # status must eventually show the head + both worker nodes alive
+        import time as _time
+
+        deadline = _time.time() + 60
+        alive = 0
+        while _time.time() < deadline and alive < 3:
+            st = _cli(env, "status")
+            if st.returncode == 0 and "nodes:" in st.stdout:
+                alive = int(st.stdout.split("nodes:")[1].split()[0])
+            _time.sleep(1.0)
+        assert alive >= 3, st.stdout
+    finally:
+        down = _cli(env, "down", timeout=60)
+        assert down.returncode == 0

@@ -1,0 +1,147 @@
+"""Native SPSC shm channel (reference: streaming/src/channel.h +
+ring_buffer.cc): framing, wrap handling, cross-process transport, close
+semantics, and the JobWorker native-transport handshake."""
+
+import os
+import pickle
+import threading
+
+import pytest
+
+from ray_tpu._native.channel import (
+    ChannelClosed,
+    ChannelReader,
+    ChannelTimeout,
+    ChannelWriter,
+)
+
+
+def _drain(reader, out):
+    while True:
+        try:
+            out.append(reader.read(timeout=15))
+        except ChannelClosed:
+            return
+
+
+def test_roundtrip_with_wraps():
+    """Tiny capacity forces constant wrap-marker traffic; every message
+    must survive byte-exact and in order."""
+    w = ChannelWriter("rtch-ut1", capacity=2048)
+    r = ChannelReader("rtch-ut1")
+    msgs = [os.urandom(50 + (i * 61) % 700) for i in range(300)]
+    got = []
+    t = threading.Thread(target=_drain, args=(r, got))
+    t.start()
+    for m in msgs:
+        w.write(m, timeout=15)
+    w.close()
+    t.join(20)
+    assert got == msgs
+    assert r.total_messages() == len(msgs)
+    r.close()
+
+
+def test_backpressure_blocks_writer():
+    w = ChannelWriter("rtch-ut2", capacity=1024)
+    r = ChannelReader("rtch-ut2")
+    w.write(b"x" * 400)
+    w.write(b"y" * 400)
+    with pytest.raises(ChannelTimeout):
+        w.write(b"z" * 400, timeout=0.2)   # ring full, nobody draining
+    assert r.read(timeout=5) == b"x" * 400
+    w.write(b"z" * 400, timeout=5)          # drained: fits now
+    w.close(unlink=False)
+    assert r.read(timeout=5) == b"y" * 400
+    assert r.read(timeout=5) == b"z" * 400
+    with pytest.raises(ChannelClosed):
+        r.read(timeout=5)
+    r.close()
+
+
+def test_message_larger_than_capacity_rejected():
+    w = ChannelWriter("rtch-ut3", capacity=1024)
+    r = ChannelReader("rtch-ut3")
+    with pytest.raises(ValueError):
+        w.write(b"a" * 4096)
+    w.close()
+    r.close()
+
+
+def test_reader_buffer_grows_for_large_messages():
+    w = ChannelWriter("rtch-ut4", capacity=8 << 20)
+    r = ChannelReader("rtch-ut4")
+    big = os.urandom(3 << 20)  # larger than the reader's initial 1MiB buf
+    w.write(big)
+    assert r.read(timeout=10) == big
+    w.close()
+    r.close()
+
+
+@pytest.mark.slow
+def test_cross_process_transport():
+    name = "rtch-ut5"
+    w = ChannelWriter(name, capacity=1 << 20)
+    pid = os.fork()
+    if pid == 0:  # child: writer
+        try:
+            for i in range(2000):
+                w.write(pickle.dumps((i, b"p" * 256)))
+            w.close()
+        finally:
+            os._exit(0)
+    r = ChannelReader(name)
+    seen = 0
+    while True:
+        try:
+            i, _ = pickle.loads(r.read(timeout=20))
+        except ChannelClosed:
+            break
+        assert i == seen
+        seen += 1
+    os.waitpid(pid, 0)
+    assert seen == 2000
+    r.close()
+
+
+def test_jobworker_native_handshake_end_to_end():
+    """The consumer-side handshake + drain thread deliver batches and the
+    EOF join preserves ordering (no actor machinery: direct JobWorker)."""
+    import cloudpickle
+
+    from ray_tpu.streaming.worker import JobWorker, _chan_shm_name
+
+    sink = JobWorker("sink", None, 0, 1)
+    channel_id = "ut-edge:0->0"
+    sink.expect_input(channel_id)
+    name = _chan_shm_name(channel_id)
+    w = ChannelWriter(name, capacity=1 << 20)
+    assert sink.open_native_channel(channel_id, name)
+    for chunk in range(20):
+        w.write(pickle.dumps(list(range(chunk * 10, chunk * 10 + 10))))
+    w.close()
+    assert sink.push_eof(channel_id)       # joins the drain thread
+    assert sorted(sink.sink_results()) == list(range(200))
+    assert sink.stats()["records_in"] == 200
+
+
+def test_large_message_at_wrap_position_makes_progress():
+    """A message > cap/2 landing at an unlucky wrap position must not
+    deadlock: the writer emits the wrap marker as its own step so the
+    reader can free the burned bytes first."""
+    cap = 1 << 20
+    w = ChannelWriter("rtch-ut6", capacity=cap)
+    r = ChannelReader("rtch-ut6")
+    # Advance tail to ~0.4*cap so the next big message straddles the end.
+    first = os.urandom(int(cap * 0.4))
+    big = os.urandom(int(cap * 0.7))
+    got = []
+    t = threading.Thread(target=_drain, args=(r, got))
+    t.start()
+    w.write(first, timeout=10)
+    w.write(big, timeout=10)     # wraps; would wedge with a fused check
+    w.write(first, timeout=10)
+    w.close()
+    t.join(15)
+    assert got == [first, big, first]
+    r.close()

@@ -500,3 +500,54 @@ def test_ddpg_learns_continuous_control(local_ray):
         assert result["episode_reward_mean"] >= -0.18, result
     finally:
         trainer.cleanup()
+
+
+def test_model_catalog_convnet_lstm_distributions():
+    """Catalog depth (reference: rllib/models/): visionnet conv stack,
+    LSTM-over-time scan, and action distributions."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import (
+        Categorical, DiagGaussian, apply_convnet, apply_lstm, init_convnet,
+        init_lstm,
+    )
+
+    key = jax.random.PRNGKey(0)
+    # ConvNet: shapes flow, gradient exists
+    cp, strides = init_convnet(key, (16, 16, 3), num_outputs=5)
+    imgs = jax.random.normal(key, (4, 16, 16, 3))
+    out = apply_convnet(cp, imgs, strides)
+    assert out.shape == (4, 5)
+    g = jax.grad(lambda p: apply_convnet(p, imgs, strides).sum())(cp)
+    assert jax.tree_util.tree_leaves(g)
+
+    # LSTM: sequence output + state carry; carrying state continues the seq
+    lp = init_lstm(key, 6, hidden=8, num_outputs=3)
+    xs = jax.random.normal(key, (2, 10, 6))
+    ys, (h, c) = apply_lstm(lp, xs)
+    assert ys.shape == (2, 10, 3) and h.shape == (2, 8)
+    ys2, _ = apply_lstm(lp, xs[:, 5:], state=None)
+    ys_cont, _ = apply_lstm(lp, xs[:, 5:],
+                            state=apply_lstm(lp, xs[:, :5])[1])
+    import numpy as np
+    np.testing.assert_allclose(ys_cont, ys[:, 5:], atol=1e-5)
+    assert not np.allclose(ys2, ys[:, 5:], atol=1e-5)  # state matters
+
+    # Distributions: logp of the argmax beats a random action; entropy >= 0
+    logits = jnp.array([[2.0, 0.0, -1.0]])
+    a = Categorical.sample(jax.random.PRNGKey(1), logits)
+    assert Categorical.logp(logits, jnp.array([0])) > \
+        Categorical.logp(logits, jnp.array([2]))
+    assert Categorical.entropy(logits)[0] >= 0
+    assert a.shape == (1,)
+
+    mean = jnp.zeros((3, 2))
+    log_std = jnp.full((3, 2), -1.0)
+    acts = DiagGaussian.sample(jax.random.PRNGKey(2), mean, log_std)
+    assert acts.shape == (3, 2)
+    assert DiagGaussian.logp(mean, log_std, mean).shape == (3,)
+    # logp is maximized at the mean
+    assert (DiagGaussian.logp(mean, log_std, mean)
+            > DiagGaussian.logp(mean, log_std, mean + 1.0)).all()
+    assert DiagGaussian.entropy(log_std).shape == (3,)
