@@ -60,6 +60,8 @@ class ChannelWriter:
         self.name = name
 
     def write(self, payload: bytes, timeout: Optional[float] = 30.0) -> None:
+        if not self._h:
+            raise ChannelClosed(self.name)  # guard: NULL into C segfaults
         rc = self._lib.tch_write(
             self._h, payload, len(payload),
             0 if timeout is None else int(timeout * 1000))
@@ -115,9 +117,13 @@ class ChannelReader:
             self._buf = ctypes.create_string_buffer(int(needed.value))
 
     def pending_bytes(self) -> int:
+        if not self._h:
+            raise ChannelClosed(self.name)
         return self._lib.tch_pending_bytes(self._h)
 
     def total_messages(self) -> int:
+        if not self._h:
+            raise ChannelClosed(self.name)
         return self._lib.tch_total_messages(self._h)
 
     def close(self) -> None:

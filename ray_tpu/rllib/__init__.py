@@ -2,13 +2,17 @@
 
 Policies are jitted pure-jax functions; rollout workers are actors with
 vectorized envs; training loops compose the execution ops the way the
-reference's execution plans do. Algorithms: PPO, DD-PPO, DQN (prioritized
-replay), IMPALA-style async learner, ES.
+reference's execution plans do. Algorithms: PPO, APPO, DD-PPO, A2C/PG,
+DQN (+prioritized replay), APEX, IMPALA (+tree aggregation), SAC, DDPG/TD3,
+QMIX, MARWIL, ES, ARS. Envs: vectorized discrete/continuous, MultiAgentEnv
+with policy mapping, ExternalEnv serving.
 """
 
 from .agents import (  # noqa: F401
     A2CTrainer,
     ApexTrainer,
+    APPOTrainer,
+    ARSTrainer,
     DDPGTrainer,
     DDPPOTrainer,
     DQNTrainer,

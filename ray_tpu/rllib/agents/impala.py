@@ -144,3 +144,21 @@ class ImpalaTrainer(Trainer):
         for agg in self.aggregators:
             ray_tpu.kill(agg)
         super().cleanup()
+
+
+APPO_CONFIG = dict(
+    IMPALA_CONFIG,
+    num_sgd_iter=1,
+    clip_param=0.4,
+)
+
+
+class APPOTrainer(ImpalaTrainer):
+    """Asynchronous PPO (reference: rllib/agents/ppo/appo.py): IMPALA's
+    async sampling architecture with PPO's clipped-surrogate loss — which
+    is exactly what this IMPALA implementation computes (the clipped-ratio
+    form replaces v-trace; see the module docstring), so APPO is the same
+    engine with APPO's default hyperparameters."""
+
+    _name = "APPO"
+    _default_config = APPO_CONFIG

@@ -69,6 +69,9 @@ class QMIXPolicy:
         self.num_actions = num_actions
         key = jax.random.PRNGKey(config.get("seed", 0))
         kp, self._act_key = jax.random.split(key)
+        # Dedicated exploration RNG: the global np.random would make the
+        # epsilon-greedy trajectory depend on unrelated process history.
+        self._np_rng = np.random.RandomState(config.get("seed", 0) * 31 + 7)
         hid = list(config.get("hiddens", [32, 32]))
         embed = config.get("mixing_embed", 16)
         self.params = _init_qmix_params(
@@ -138,10 +141,10 @@ class QMIXPolicy:
             cfg = self.config
             frac = min(1.0, self.steps / max(cfg["epsilon_timesteps"], 1))
             self.epsilon = 1.0 + frac * (cfg["final_epsilon"] - 1.0)
-            mask = np.random.rand(self.n_agents) < self.epsilon
+            mask = self._np_rng.rand(self.n_agents) < self.epsilon
             actions = np.where(
                 mask,
-                np.random.randint(self.num_actions, size=self.n_agents),
+                self._np_rng.randint(self.num_actions, size=self.n_agents),
                 actions)
             self.steps += self.n_agents
         return actions

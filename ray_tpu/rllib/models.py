@@ -98,6 +98,10 @@ def apply_convnet(params: Dict, x: jnp.ndarray,
     """x: [B, H, W, C] float -> [B, num_outputs]."""
     if strides is None:
         strides = [s for _, _, s in DEFAULT_FILTERS]
+    if len(strides) != len(params["conv"]):
+        raise ValueError(
+            f"{len(params['conv'])} conv layers but {len(strides)} strides "
+            f"— pass the strides returned by init_convnet")
     for (w, b), stride in zip(params["conv"], strides):
         x = jax.lax.conv_general_dilated(
             x, w, window_strides=(stride, stride), padding="SAME",
@@ -176,12 +180,15 @@ class Categorical:
 
 
 class DiagGaussian:
-    """mean/log_std parameterization; optional tanh squash to [-1, 1]."""
+    """mean/log_std parameterization. Deliberately NO tanh-squash option:
+    a squashed sample needs the -log(1 - a^2) Jacobian term in logp, which
+    this plain-Gaussian logp does not apply (SAC-style policies squash
+    explicitly and correct their own logp; DDPG/TD3 use a deterministic
+    tanh actor with additive noise, no density needed)."""
 
     @staticmethod
-    def sample(key, mean, log_std, squash: bool = False):
-        a = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
-        return jnp.tanh(a) if squash else a
+    def sample(key, mean, log_std):
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
 
     @staticmethod
     def logp(mean, log_std, actions):

@@ -306,6 +306,18 @@ def cmd_exec(args) -> None:
     raise SystemExit(proc.returncode)
 
 
+def cmd_attach(args) -> None:
+    """Open an interactive shell wired to the running cluster (reference:
+    ray attach, scripts.py:781 — ssh to the head; locally, a subshell with
+    RAY_TPU_ADDRESS exported so ray_tpu.init() connects)."""
+    env = _driver_env(args.address)
+    shell = os.environ.get("SHELL", "/bin/bash")
+    print(f"attached to {env['RAY_TPU_ADDRESS']} — ray_tpu.init() connects; "
+          f"exit the shell to detach")
+    proc = subprocess.run([shell, "-i"], env=env)
+    raise SystemExit(proc.returncode)
+
+
 def _descendants(pid: int) -> List[int]:
     out = [pid]
     try:
@@ -448,6 +460,10 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     sp = sub.add_parser("stack", help="dump stacks of cluster processes")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("attach", help="interactive shell on the cluster")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_attach)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")

@@ -118,8 +118,22 @@ class _OutChannel:
         if self._writer is not None:
             import pickle as _pickle
 
-            self._writer.write(_pickle.dumps(items, protocol=5),
-                               timeout=120.0)
+            # Block indefinitely on a full ring — backpressure from a slow
+            # but healthy consumer is normal operation, exactly like the
+            # actor path blocking on its oldest ack.
+            payload = _pickle.dumps(items, protocol=5)
+            try:
+                self._writer.write(payload, timeout=None)
+            except ValueError:
+                # Batch pickles larger than the ring: split and retry so
+                # ordering stays on the ring. A single unsplittable item
+                # bigger than the ring is a genuine error.
+                if len(items) <= 1:
+                    raise
+                mid = len(items) // 2
+                self.send(items[:mid])
+                self.send(items[mid:])
+                return
             self.seq += 1
             return
         if len(self.inflight) >= CHANNEL_CREDITS:
@@ -229,18 +243,22 @@ class JobWorker:
             while True:
                 try:
                     items = _pickle.loads(reader.read(timeout=60.0))
+                    with self._lock:
+                        # Inside the try: a user-fn or downstream-send
+                        # failure must be RECORDED, not silently end the
+                        # thread (push_eof raises on the flag — the actor
+                        # path surfaces the same error via its ack).
+                        self._process(items)
                 except ChannelTimeout:
                     continue        # idle source; the ring is still live
                 except ChannelClosed:
                     return
-                except Exception:  # noqa: BLE001 - corrupt frame/teardown
+                except Exception:  # noqa: BLE001 - fn error/corrupt frame
                     import traceback
 
                     traceback.print_exc()
                     self._native_errors[channel_id] = True
                     return
-                with self._lock:
-                    self._process(items)
 
         t = threading.Thread(target=drain, daemon=True,
                              name=f"chan-{channel_id[-12:]}")

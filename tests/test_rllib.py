@@ -551,3 +551,38 @@ def test_model_catalog_convnet_lstm_distributions():
     assert (DiagGaussian.logp(mean, log_std, mean)
             > DiagGaussian.logp(mean, log_std, mean + 1.0)).all()
     assert DiagGaussian.entropy(log_std).shape == (3,)
+
+
+def test_ars_improves_bandit(local_ray):
+    """ARS (reference: rllib/agents/ars): top-direction selection +
+    reward-std scaling improves the bandit policy."""
+    from ray_tpu.rllib import ARSTrainer
+
+    trainer = ARSTrainer({
+        "env": "StatelessBandit", "num_workers": 2,
+        "episodes_per_batch": 16, "top_directions": 4,
+        "sigma": 0.1, "step_size": 0.2, "max_episode_steps": 4,
+        "hiddens": [8], "seed": 0})
+    try:
+        result = None
+        for _ in range(25):
+            result = trainer.train()
+            if result["eval_return"] >= 1.0:
+                break
+        assert result["eval_return"] >= 1.0, result
+    finally:
+        trainer.cleanup()
+
+
+def test_appo_learns_bandit(local_ray):
+    """APPO (reference: rllib/agents/ppo/appo.py): async PPO engine."""
+    from ray_tpu.rllib import APPOTrainer
+
+    _reward_of(
+        APPOTrainer,
+        {"env": "StatelessBandit", "num_workers": 2,
+         "num_envs_per_worker": 4, "rollout_fragment_length": 8,
+         "train_batch_size": 64, "sgd_minibatch_size": 32,
+         "lr": 0.02, "hiddens": [16], "seed": 1,
+         "entropy_coeff": 0.001},
+        iters=40, min_reward=0.85)
