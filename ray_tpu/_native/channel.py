@@ -73,6 +73,11 @@ class ChannelWriter:
             raise ChannelClosed(self.name)
         raise ValueError(f"message larger than channel capacity: {self.name}")
 
+    def pending_bytes(self) -> int:
+        if not self._h:
+            raise ChannelClosed(self.name)
+        return self._lib.tch_pending_bytes(self._h)
+
     def close(self, unlink: bool = False) -> None:
         """Reader normally owns the unlink; pass unlink=True when no reader
         ever attached (failed handshake) so the segment doesn't leak."""

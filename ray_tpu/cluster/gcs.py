@@ -1119,6 +1119,25 @@ class GcsServer:
                 if nid in self.nodes and self.nodes[nid].alive
             }}
 
+        @s.handler("ref_table")
+        async def ref_table(msg, conn):
+            """Per-object reference accounting (reference: the dashboard's
+            memory.py ref/obj table + `ray memory`): who holds each object,
+            how many task pins, containment children."""
+            limit = msg.get("limit", 1000)
+            out = {}
+            oids = set(self.objects) | set(self._ref_holders) \
+                | set(self._dep_pins)
+            for oid in list(oids)[:limit]:
+                out[oid.hex()] = {
+                    "holders": sorted(self._ref_holders.get(oid, ())),
+                    "task_pins": self._dep_pins.get(oid, 0),
+                    "contained_children": len(self._contained.get(oid, ())),
+                    "size": self.objects.get(oid, {}).get("size", 0),
+                    "in_directory": oid in self.objects,
+                }
+            return {"ok": True, "refs": out}
+
         @s.handler("ref_update")
         async def ref_update(msg, conn):
             worker = msg["worker"]

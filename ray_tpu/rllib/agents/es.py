@@ -22,6 +22,13 @@ from ..models import apply_mlp, flatten_params, init_mlp, unflatten_like
 from .trainer import Trainer
 
 
+def _noise_for(seed, size: int) -> np.ndarray:
+    """THE perturbation generator: trainer-side gradient reconstruction is
+    only valid if this reproduces byte-for-byte the noise the worker
+    applied, so both sides MUST call this one function."""
+    return np.random.RandomState(seed).randn(size).astype(np.float32)
+
+
 def _rank_transform(returns: np.ndarray) -> np.ndarray:
     """Centered rank in [-0.5, 0.5] (reference es.py compute_centered_ranks)."""
     ranks = np.empty(len(returns), dtype=np.float32)
@@ -64,8 +71,7 @@ class _ESWorker:
         seeds = self.rng.randint(0, 2**31 - 1, size=num_pairs)
         pos, neg = [], []
         for s in seeds:
-            noise = np.random.RandomState(s).randn(
-                self.flat.size).astype(np.float32)
+            noise = _noise_for(s, self.flat.size)
             pos.append(self._episode_return(
                 jnp.asarray(self.flat + self.sigma * noise), max_steps))
             neg.append(self._episode_return(
@@ -133,10 +139,6 @@ class ESTrainer(Trainer):
         return float(ray_tpu.get(self._es_workers[0].eval_current.remote(
             self.raw_config["max_episode_steps"])))
 
-    @staticmethod
-    def _noise_for(seed, size: int) -> np.ndarray:
-        return np.random.RandomState(seed).randn(size).astype(np.float32)
-
     def step(self) -> Dict:
         cfg = self.raw_config
         seeds, pos, neg = self._evaluate_population()
@@ -146,7 +148,7 @@ class ESTrainer(Trainer):
         pos_r, neg_r = ranks[:len(pos)], ranks[len(pos):]
         grad = np.zeros_like(self.flat)
         for s, rp, rn in zip(seeds, pos_r, neg_r):
-            grad += (rp - rn) * self._noise_for(s, self.flat.size)
+            grad += (rp - rn) * _noise_for(s, self.flat.size)
         grad /= (2 * len(seeds) * cfg["sigma"])
         self.flat += cfg["step_size"] * grad - cfg["l2_coeff"] * self.flat
 
@@ -202,7 +204,7 @@ class ARSTrainer(ESTrainer):
         reward_std = float(np.concatenate([pos[top], neg[top]]).std()) + 1e-8
         grad = np.zeros_like(self.flat)
         for idx in top:
-            grad += (pos[idx] - neg[idx]) * self._noise_for(
+            grad += (pos[idx] - neg[idx]) * _noise_for(
                 seeds[idx], self.flat.size)
         self.flat += (cfg["step_size"] / (k * reward_std)) * grad
 

@@ -12,8 +12,9 @@ vmap over whole parameter pytrees. Networks:
                gate matmul, scanned over time)
 
 Action distributions (rllib/models/action_dist.py): Categorical for
-discrete policies and DiagGaussian (tanh-squashed option) for continuous —
-sample/logp/entropy as pure functions, usable inside any jitted loss.
+discrete policies and DiagGaussian (plain Gaussian — squashing policies
+must correct their own logp) for continuous — sample/logp/entropy as pure
+functions, usable inside any jitted loss.
 """
 
 from __future__ import annotations

@@ -243,6 +243,21 @@ def cmd_status(args) -> None:
 def cmd_memory(args) -> None:
     gcs = _gcs_client(args.address)
     try:
+        if getattr(args, "refs", False):
+            # Reference accounting view (reference: `ray memory` backed by
+            # the dashboard memory.py ref table).
+            refs = gcs.call({"type": "ref_table",
+                             "limit": args.limit})["refs"]
+            print(f"{len(refs)} tracked objects")
+            print(f"{'OBJECT_ID':<44} {'SIZE':>10} {'PINS':>5} "
+                  f"{'NESTED':>6}  HOLDERS")
+            for oid, info in sorted(refs.items(),
+                                    key=lambda kv: -kv[1]["size"]):
+                holders = ",".join(h[:14] for h in info["holders"]) or "-"
+                print(f"{oid:<44} {info['size']:>10} "
+                      f"{info['task_pins']:>5} "
+                      f"{info['contained_children']:>6}  {holders}")
+            return
         objs = gcs.call({"type": "list_objects", "limit": args.limit})["objects"]
         print(f"{len(objs)} objects in the cluster object table")
         print(f"{'OBJECT_ID':<44} {'SIZE':>12}  LOCATIONS")
@@ -445,6 +460,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         sp.add_argument("--address")
         if name == "memory":
             sp.add_argument("--limit", type=int, default=1000)
+            sp.add_argument("--refs", action="store_true",
+                            help="reference-accounting view (holders/pins)")
         sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("submit", help="run a driver script on the cluster")

@@ -34,6 +34,9 @@ CHANNEL_CREDITS = 4  # max unacked batches per channel before sender blocks
 # shm arena / native C++ transfer plane (reference: streaming/src/channel.h
 # data plane on plasma queues), and the actor call carries only the ref.
 PUSH_INLINE_MAX = 32 * 1024
+# Native-ring backpressure probe window: a full ring with zero reader
+# progress across two consecutive windows means the consumer is dead.
+BACKPRESSURE_WINDOW_S = 60.0
 
 
 def _approx_nbytes(items: List[Any]) -> int:
@@ -114,16 +117,33 @@ class _OutChannel:
         else:
             writer.close(unlink=True)  # no reader ever attached
 
+    def _write_with_backpressure(self, payload: bytes) -> None:
+        """Block while the consumer makes progress; raise only when the ring
+        is full AND the reader's position hasn't moved for two consecutive
+        windows (dead drain thread) — a slow-but-healthy consumer can take
+        arbitrarily long, like the actor path blocking on its oldest ack."""
+        from .._native.channel import ChannelTimeout
+
+        stalled = 0
+        last_pending = -1
+        while True:
+            try:
+                self._writer.write(payload, timeout=BACKPRESSURE_WINDOW_S)
+                return
+            except ChannelTimeout:
+                pending = self._writer.pending_bytes()
+                stalled = stalled + 1 if pending == last_pending else 0
+                last_pending = pending
+                if stalled >= 2:
+                    raise
+
     def send(self, items: List[Any]) -> None:
         if self._writer is not None:
             import pickle as _pickle
 
-            # Block indefinitely on a full ring — backpressure from a slow
-            # but healthy consumer is normal operation, exactly like the
-            # actor path blocking on its oldest ack.
             payload = _pickle.dumps(items, protocol=5)
             try:
-                self._writer.write(payload, timeout=None)
+                self._write_with_backpressure(payload)
             except ValueError:
                 # Batch pickles larger than the ring: split and retry so
                 # ordering stays on the ring. A single unsplittable item

@@ -98,3 +98,33 @@ def test_cli_up_down(tmp_path):
     finally:
         down = _cli(env, "down", timeout=60)
         assert down.returncode == 0
+
+
+@pytest.mark.slow
+def test_cli_memory_refs_view(tmp_path):
+    """`memory --refs` surfaces the GCS reference table (holders + pins)."""
+    env = _cli_env(tmp_path)
+    started = _cli(env, "start", "--head", "--num-workers", "1")
+    assert started.returncode == 0, started.stderr
+    try:
+        script = tmp_path / "holder.py"
+        script.write_text(
+            "import numpy as np\n"
+            "import ray_tpu\n"
+            "ray_tpu.init()\n"
+            "ref = ray_tpu.put(np.zeros(100_000))\n"
+            "print('HELD', ref.hex())\n"
+            "import subprocess, sys, os\n"
+            "out = subprocess.run(\n"
+            "    [sys.executable, '-m', 'ray_tpu.scripts.cli', 'memory',\n"
+            "     '--refs'], env=dict(os.environ), capture_output=True,\n"
+            "    text=True, timeout=60)\n"
+            "print(out.stdout)\n"
+            "assert ref.hex() in out.stdout\n"
+            "ray_tpu.shutdown()\n"
+        )
+        sub = _cli(env, "submit", str(script))
+        assert sub.returncode == 0, (sub.stdout, sub.stderr)
+        assert "HELD" in sub.stdout and "HOLDERS" in sub.stdout
+    finally:
+        _cli(env, "stop", timeout=30)

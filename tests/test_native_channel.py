@@ -145,3 +145,34 @@ def test_large_message_at_wrap_position_makes_progress():
     t.join(15)
     assert got == [first, big, first]
     r.close()
+
+
+def test_outchannel_detects_dead_consumer(monkeypatch):
+    """A full ring with zero reader progress across two probe windows must
+    raise (a dead drain thread), while a slow-but-moving reader keeps the
+    writer blocked-but-alive."""
+    from ray_tpu.streaming import worker as wmod
+    from ray_tpu.streaming.worker import _OutChannel
+
+    monkeypatch.setattr(wmod, "BACKPRESSURE_WINDOW_S", 0.25)
+
+    ch = _OutChannel.__new__(_OutChannel)  # transport-only: skip handshake
+    ch._writer = ChannelWriter("rtch-ut7", capacity=4096)
+    ch.seq = 0
+    r = ChannelReader("rtch-ut7")
+    try:
+        # Nobody draining: fill the ring, then the stall detector fires.
+        with pytest.raises(ChannelTimeout):
+            for _ in range(100):
+                ch.send([b"x" * 400])
+        # A reader that makes progress clears the stall accounting.
+        drained = []
+        t = threading.Thread(target=_drain, args=(r, drained))
+        t.start()
+        for _ in range(20):
+            ch.send([b"y" * 400])
+        ch._writer.close()
+        t.join(10)
+        assert len(drained) >= 20
+    finally:
+        r.close()
