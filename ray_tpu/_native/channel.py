@@ -42,6 +42,9 @@ def _load():
                                  ctypes.POINTER(ctypes.c_uint64)]
         lib.tch_pending_bytes.restype = ctypes.c_uint64
         lib.tch_pending_bytes.argtypes = [ctypes.c_void_p]
+        lib.tch_mark_reader_dead.argtypes = [ctypes.c_void_p]
+        lib.tch_reader_dead.restype = ctypes.c_int
+        lib.tch_reader_dead.argtypes = [ctypes.c_void_p]
         lib.tch_total_messages.restype = ctypes.c_uint64
         lib.tch_total_messages.argtypes = [ctypes.c_void_p]
         lib.tch_close_write.argtypes = [ctypes.c_void_p]
@@ -77,6 +80,12 @@ class ChannelWriter:
         if not self._h:
             raise ChannelClosed(self.name)
         return self._lib.tch_pending_bytes(self._h)
+
+    def reader_dead(self) -> bool:
+        """Did the consumer declare it will never drain again?"""
+        if not self._h:
+            raise ChannelClosed(self.name)
+        return bool(self._lib.tch_reader_dead(self._h))
 
     def close(self, unlink: bool = False) -> None:
         """Reader normally owns the unlink; pass unlink=True when no reader
@@ -125,6 +134,12 @@ class ChannelReader:
         if not self._h:
             raise ChannelClosed(self.name)
         return self._lib.tch_pending_bytes(self._h)
+
+    def mark_dead(self) -> None:
+        """Consumer error path: unblock a writer waiting on ring space by
+        declaring this reader permanently gone."""
+        if self._h:
+            self._lib.tch_mark_reader_dead(self._h)
 
     def total_messages(self) -> int:
         if not self._h:

@@ -38,6 +38,7 @@ struct ChanHeader {
   std::atomic<uint64_t> head;           // read offset  (consumer-owned)
   std::atomic<uint64_t> tail;           // write offset (producer-owned)
   std::atomic<uint32_t> closed;         // writer finished
+  std::atomic<uint32_t> reader_dead;    // consumer gave up (error path)
   std::atomic<uint64_t> messages;       // total messages written (stats)
   uint8_t pad[16];
 };
@@ -98,6 +99,7 @@ void* tch_create(const char* name, uint64_t capacity) {
   hdr->head.store(0);
   hdr->tail.store(0);
   hdr->closed.store(0);
+  hdr->reader_dead.store(0);
   hdr->messages.store(0);
   __sync_synchronize();
   hdr->magic = kChanMagic;
@@ -235,6 +237,19 @@ uint64_t tch_total_messages(void* handle) {
 void tch_close_write(void* handle) {
   static_cast<ChanHandle*>(handle)
       ->hdr->closed.store(1, std::memory_order_release);
+}
+
+// Consumer error path: tells the (possibly blocked) writer that no one
+// will ever drain this ring again.
+void tch_mark_reader_dead(void* handle) {
+  static_cast<ChanHandle*>(handle)
+      ->hdr->reader_dead.store(1, std::memory_order_release);
+}
+
+int tch_reader_dead(void* handle) {
+  return static_cast<int>(
+      static_cast<ChanHandle*>(handle)
+          ->hdr->reader_dead.load(std::memory_order_acquire));
 }
 
 // Unmap; the reader side unlinks the segment (it outlives the writer).

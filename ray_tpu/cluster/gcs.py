@@ -1128,7 +1128,13 @@ class GcsServer:
             out = {}
             oids = set(self.objects) | set(self._ref_holders) \
                 | set(self._dep_pins)
-            for oid in list(oids)[:limit]:
+            # Largest objects first BEFORE the cap: the view exists to find
+            # who pins the big allocations, so truncation must never drop
+            # them (set order is arbitrary).
+            ordered = sorted(
+                oids,
+                key=lambda o: -self.objects.get(o, {}).get("size", 0))
+            for oid in ordered[:limit]:
                 out[oid.hex()] = {
                     "holders": sorted(self._ref_holders.get(oid, ())),
                     "task_pins": self._dep_pins.get(oid, 0),

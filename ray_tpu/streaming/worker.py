@@ -118,24 +118,23 @@ class _OutChannel:
             writer.close(unlink=True)  # no reader ever attached
 
     def _write_with_backpressure(self, payload: bytes) -> None:
-        """Block while the consumer makes progress; raise only when the ring
-        is full AND the reader's position hasn't moved for two consecutive
-        windows (dead drain thread) — a slow-but-healthy consumer can take
-        arbitrarily long, like the actor path blocking on its oldest ack."""
-        from .._native.channel import ChannelTimeout
+        """Block indefinitely under backpressure — a slow consumer (or one
+        itself blocked on ITS downstream) is normal operation, exactly like
+        the actor path blocking on its oldest ack. The only escape is the
+        consumer explicitly declaring itself dead (drain thread's error
+        path sets the ring's reader_dead flag) — an explicit signal, not a
+        progress heuristic, so cascaded backpressure can never be
+        misdiagnosed as death."""
+        from .._native.channel import ChannelClosed, ChannelTimeout
 
-        stalled = 0
-        last_pending = -1
         while True:
             try:
                 self._writer.write(payload, timeout=BACKPRESSURE_WINDOW_S)
                 return
             except ChannelTimeout:
-                pending = self._writer.pending_bytes()
-                stalled = stalled + 1 if pending == last_pending else 0
-                last_pending = pending
-                if stalled >= 2:
-                    raise
+                if self._writer.reader_dead():
+                    raise ChannelClosed(
+                        f"consumer of {self.channel_id} died")
 
     def send(self, items: List[Any]) -> None:
         if self._writer is not None:
@@ -278,6 +277,7 @@ class JobWorker:
 
                     traceback.print_exc()
                     self._native_errors[channel_id] = True
+                    reader.mark_dead()  # unblock a backpressured producer
                     return
 
         t = threading.Thread(target=drain, daemon=True,
