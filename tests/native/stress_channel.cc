@@ -1,0 +1,154 @@
+// ASAN/UBSAN stress for the SPSC shm channel (channel.cc): concurrent
+// writer/reader churn across wrap boundaries, SIGKILL of a writer
+// mid-stream (reader must drain the intact prefix and see close-or-stall,
+// never corruption), reader-death release, and close/unlink hygiene.
+//
+// Built and run by tests/test_shm_stress.py next to the store stress.
+
+#include "../../ray_tpu/_native/src/channel.cc"
+
+#include <signal.h>
+#include <sys/wait.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace {
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+void fill_payload(std::vector<uint8_t>& buf, uint32_t i) {
+  for (size_t p = 0; p < buf.size(); ++p)
+    buf[p] = static_cast<uint8_t>(i * 31u + p * 7u + 1u);
+}
+
+// ---- 1. threaded churn across wraps with integrity checks ---------------
+void churn() {
+  void* w = tch_create("rtch-stress1", 8192);
+  CHECK(w != nullptr);
+  void* r = tch_open("rtch-stress1");
+  CHECK(r != nullptr);
+  constexpr uint32_t kMsgs = 20000;
+
+  std::thread reader([r] {
+    std::vector<uint8_t> buf(4096);
+    std::vector<uint8_t> want(4096);
+    for (uint32_t i = 0; i < kMsgs; ++i) {
+      uint64_t needed = 0;
+      int64_t n = tch_read(r, buf.data(), buf.size(), 30000, &needed);
+      CHECK(n >= 0);
+      uint64_t len = 64 + (i * 131) % 2000;
+      CHECK(static_cast<uint64_t>(n) == len);
+      want.resize(len);
+      fill_payload(want, i);
+      CHECK(std::memcmp(buf.data(), want.data(), len) == 0);
+    }
+    // after the writer closes, the ring drains to ChannelClosed
+    uint64_t needed = 0;
+    CHECK(tch_read(r, buf.data(), buf.size(), 30000, &needed) == -2);
+  });
+
+  std::vector<uint8_t> payload(4096);
+  for (uint32_t i = 0; i < kMsgs; ++i) {
+    uint64_t len = 64 + (i * 131) % 2000;
+    payload.resize(len);
+    fill_payload(payload, i);
+    CHECK(tch_write(w, payload.data(), len, 30000) == 0);
+  }
+  tch_close_write(w);
+  reader.join();
+  CHECK(tch_total_messages(r) == kMsgs);
+  tch_close(w, 0);
+  tch_close(r, 1);
+  std::printf("churn ok\n");
+}
+
+// ---- 2. SIGKILL a writer mid-stream -------------------------------------
+void kill_writer() {
+  void* w0 = tch_create("rtch-stress2", 1 << 20);
+  CHECK(w0 != nullptr);
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    void* w = tch_open("rtch-stress2");
+    if (w == nullptr) _exit(2);
+    std::vector<uint8_t> payload(512);
+    for (uint32_t i = 0;; ++i) {
+      fill_payload(payload, i);
+      tch_write(w, payload.data(), payload.size(), 1000);
+    }
+  }
+  usleep(150 * 1000);
+  CHECK(kill(pid, SIGKILL) == 0);
+  waitpid(pid, nullptr, 0);
+
+  // Every fully-written message must read back intact; the stream then
+  // goes quiet (timeout) — never a torn frame.
+  void* r = tch_open("rtch-stress2");
+  CHECK(r != nullptr);
+  std::vector<uint8_t> buf(4096);
+  std::vector<uint8_t> want(512);
+  uint32_t i = 0;
+  for (;;) {
+    uint64_t needed = 0;
+    int64_t n = tch_read(r, buf.data(), buf.size(), 200, &needed);
+    if (n == -1) break;  // drained: writer died, ring idle
+    CHECK(n == 512);
+    want.assign(512, 0);
+    fill_payload(want, i);
+    CHECK(std::memcmp(buf.data(), want.data(), 512) == 0);
+    ++i;
+  }
+  CHECK(i > 0);
+  std::printf("kill_writer ok (%u intact messages)\n", i);
+  tch_close(r, 1);
+  tch_close(w0, 0);
+}
+
+// ---- 3. reader-death flag releases a blocked writer ---------------------
+void reader_death() {
+  void* w = tch_create("rtch-stress3", 4096);
+  CHECK(w != nullptr);
+  void* r = tch_open("rtch-stress3");
+  CHECK(r != nullptr);
+  std::vector<uint8_t> payload(1024, 0xAB);
+  // fill until the ring is full
+  while (tch_write(w, payload.data(), payload.size(), 50) == 0) {
+  }
+  std::thread killer([r] {
+    usleep(100 * 1000);
+    tch_mark_reader_dead(r);
+  });
+  // blocked write; the flag doesn't unblock tch_write itself (the python
+  // layer polls it between timeouts) — emulate that loop here.
+  int rc;
+  for (;;) {
+    rc = tch_write(w, payload.data(), payload.size(), 100);
+    if (rc != -1) break;
+    if (tch_reader_dead(w)) break;
+  }
+  CHECK(tch_reader_dead(w) == 1);
+  killer.join();
+  tch_close(w, 0);
+  tch_close(r, 1);
+  std::printf("reader_death ok\n");
+}
+
+}  // namespace
+
+int main() {
+  churn();
+  kill_writer();
+  reader_death();
+  std::printf("ALL OK\n");
+  return 0;
+}

@@ -1,7 +1,9 @@
-"""Sanitizer stress run of the native shm store (reference:
-ci/asan_tests/run_asan_tests.sh). Builds tests/native/stress_shm.cc with
-ASAN+UBSAN and runs it: concurrent churn, SIGKILL-while-holding-the-mutex
-robust recovery, mid-put kills, and full-arena allocator churn."""
+"""Sanitizer stress runs of the native components (reference:
+ci/asan_tests/run_asan_tests.sh). Builds the C++ stress harnesses with
+ASAN+UBSAN and runs them: shm store (concurrent churn,
+SIGKILL-while-holding-the-mutex robust recovery, mid-put kills, allocator
+churn) and the SPSC channel (wrap-boundary churn, mid-stream writer kill,
+reader-death release)."""
 
 import os
 import subprocess
@@ -9,15 +11,14 @@ import subprocess
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SRC = os.path.join(REPO, "tests", "native", "stress_shm.cc")
 
 
-@pytest.mark.slow
-def test_shm_store_asan_stress(tmp_path):
-    binary = str(tmp_path / "stress_shm")
+def _build_and_run(tmp_path, src_name: str):
+    src = os.path.join(REPO, "tests", "native", src_name)
+    binary = str(tmp_path / src_name.replace(".cc", ""))
     build = subprocess.run(
         ["g++", "-fsanitize=address,undefined", "-g", "-O1", "-std=c++17",
-         "-o", binary, SRC, "-lpthread", "-lrt"],
+         "-o", binary, src, "-lpthread", "-lrt"],
         capture_output=True, text=True, timeout=180,
     )
     assert build.returncode == 0, build.stderr
@@ -29,3 +30,13 @@ def test_shm_store_asan_stress(tmp_path):
     assert "ALL OK" in run.stdout
     assert "ERROR: AddressSanitizer" not in run.stderr
     assert "runtime error" not in run.stderr  # UBSAN
+
+
+@pytest.mark.slow
+def test_shm_store_asan_stress(tmp_path):
+    _build_and_run(tmp_path, "stress_shm.cc")
+
+
+@pytest.mark.slow
+def test_channel_asan_stress(tmp_path):
+    _build_and_run(tmp_path, "stress_channel.cc")
