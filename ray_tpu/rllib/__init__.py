@@ -4,20 +4,25 @@ Policies are jitted pure-jax functions; rollout workers are actors with
 vectorized envs; training loops compose the execution ops the way the
 reference's execution plans do. Algorithms: PPO, APPO, DD-PPO, A2C/PG,
 DQN (+prioritized replay), APEX, IMPALA (+tree aggregation), SAC, DDPG/TD3,
-QMIX, MARWIL, ES, ARS. Envs: vectorized discrete/continuous, MultiAgentEnv
-with policy mapping, ExternalEnv serving.
+QMIX, MARWIL, ES, ARS, A3C (async hogwild grads), MAML (second-order
+meta-gradient via nested jax.grad), Dyna (learned dynamics + imagined
+replay). Envs: vectorized discrete/continuous, MultiAgentEnv with policy
+mapping, ExternalEnv serving, TaskBandit task distribution for meta-RL.
 """
 
 from .agents import (  # noqa: F401
     A2CTrainer,
+    A3CTrainer,
     ApexTrainer,
     APPOTrainer,
     ARSTrainer,
     DDPGTrainer,
     DDPPOTrainer,
     DQNTrainer,
+    DynaTrainer,
     ESTrainer,
     ImpalaTrainer,
+    MAMLTrainer,
     MARWILTrainer,
     PGTrainer,
     PPOTrainer,
@@ -37,6 +42,7 @@ from .env import (  # noqa: F401
     MultiAgentBandit,
     MultiAgentEnv,
     StatelessBandit,
+    TaskBandit,
     TwoStepGame,
     VectorEnv,
     make_env,

@@ -9,3 +9,6 @@ from .marwil import MARWILTrainer  # noqa: F401
 from .sac import SACTrainer  # noqa: F401
 from .qmix import QMIXTrainer  # noqa: F401
 from .ddpg import DDPGTrainer, TD3Trainer  # noqa: F401
+from .a3c import A3CTrainer  # noqa: F401
+from .maml import MAMLTrainer  # noqa: F401
+from .dyna import DynaTrainer  # noqa: F401

@@ -1,0 +1,124 @@
+"""A3C: asynchronous advantage actor-critic (reference: rllib/agents/a3c/a3c.py).
+
+The reference's A3C has each rollout worker compute gradients against its own
+(slightly stale) weights and ship them to the driver, which applies them to the
+central params as they arrive — no barrier, no batch concat. Here the gradient
+computation is one jitted pure function on the worker (actor-critic loss →
+``jax.grad``), the pytree of numpy gradients rides the object store back, and
+the driver's ``optax`` update is a second jitted step. Fresh weights go back to
+exactly the worker whose gradient was consumed (the hogwild pattern), so one
+slow worker never stalls the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from ..sample_batch import ACTIONS, ADVANTAGES, OBS, VALUE_TARGETS
+from .pg import A2CPolicy
+from .trainer import Trainer
+
+A3C_CONFIG = {
+    "rollout_fragment_length": 32,
+    "use_gae": True,
+    "use_critic": True,
+    "lambda": 1.0,
+    "entropy_coeff": 0.01,
+    "hiddens": [64, 64],
+    "grads_per_step": 4,   # async gradient applications per train iteration
+}
+
+
+class A3CPolicy(A2CPolicy):
+    """A2C loss split into compute_gradients / apply_gradients halves so the
+    two ends can run on different processes (reference:
+    rllib/policy/policy.py compute_gradients, a3c.py apply_gradients)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, config: Dict[str, Any]):
+        super().__init__(obs_dim, num_actions, config)
+
+        def grads_fn(params, batch):
+            # Same surrogate as the fused A2C update (self._loss_fn is built
+            # by A2CPolicy from this config's vf/entropy/use_critic knobs).
+            (_, stats), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, batch)
+            return grads, stats
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grads = jax.jit(grads_fn)
+        self._apply = jax.jit(apply_fn)
+
+    def compute_gradients(self, batch):
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, ADVANTAGES, VALUE_TARGETS)}
+        grads, stats = self._grads(self.params, dev)
+        return (jax.device_get(grads),
+                {k: float(v) for k, v in stats.items()})
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, grads)
+
+
+def _sample_and_grads(worker):
+    """Runs on the rollout worker: one fragment → gradient pytree."""
+    batch = worker.sample()
+    grads, stats = worker.policy.compute_gradients(batch)
+    return grads, stats, batch.count
+
+
+class A3CTrainer(Trainer):
+    _policy_cls = A3CPolicy
+    _default_config = A3C_CONFIG
+    _name = "A3C"
+
+    def _build(self, config: Dict) -> None:
+        self._inflight: Dict = {}  # ObjectRef -> worker
+        # Workers start from different random inits; the hogwild contract is
+        # "gradients at *stale driver* weights", so align everyone first.
+        self.workers.sync_weights()
+
+    def _train_step(self) -> Dict:
+        remote = self.workers.remote_workers()
+        local = self.workers.local_worker()
+        if not remote:
+            # Degenerate synchronous mode (num_workers=0): still exercises the
+            # grads/apply split so the two paths can't drift apart.
+            batch = local.sample()
+            grads, stats = local.policy.compute_gradients(batch)
+            local.policy.apply_gradients(grads)
+            self._steps_sampled += batch.count
+            self._steps_trained += batch.count
+            return stats
+
+        # Keep every worker busy; consume whichever gradient lands first.
+        for w in remote:
+            if w not in self._inflight.values():
+                self._inflight[w.apply.remote(_sample_and_grads)] = w
+        stats: Dict = {}
+        for _ in range(self.raw_config["grads_per_step"]):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            ref = ready[0]
+            w = self._inflight.pop(ref)
+            grads, stats, n = ray_tpu.get(ref)
+            local.policy.apply_gradients(grads)
+            self._steps_sampled += n
+            self._steps_trained += n
+            # Ship fresh weights to the worker we just drained, then rearm it.
+            w.set_weights.remote(local.get_weights())
+            self._inflight[w.apply.remote(_sample_and_grads)] = w
+        return stats
+
+    def cleanup(self) -> None:
+        self._inflight.clear()
+        super().cleanup()

@@ -74,5 +74,8 @@ class DQNTrainer(Trainer):
 
         if self._iteration % cfg["target_network_update_freq"] == 0:
             policy.update_target()
+        # The learner never acts: drive its epsilon clock from the global
+        # sampled-step count so the broadcast carries a schedule that moves.
+        policy.steps = max(policy.steps, self._steps_sampled)
         self.workers.sync_weights()
         return stats

@@ -1,0 +1,179 @@
+"""Dyna: model-based RL with imagined transitions
+(reference: rllib's DYNA lineage — learn a dynamics model from real
+transitions, then train the value-based policy on a mixture of real and
+model-generated experience; Sutton 1991).
+
+TPU-first shape: the dynamics model is one MLP ``f(s, onehot(a)) ->
+(Δs, r, done_logit)`` trained by a jitted regression step, and imagination
+is a single batched forward pass — sample B states from replay, roll every
+candidate action (or an epsilon-greedy pick) through the model at once, and
+feed the synthetic batch to the same jitted DQN update the real batches use.
+No per-step Python loop: one imagined batch = one fused XLA call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from ..execution import ReplayBuffer
+from ..models import apply_mlp, init_mlp
+from ..policy import DQNPolicy
+from ..sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+from .trainer import Trainer
+
+DYNA_CONFIG = {
+    "rollout_fragment_length": 32,
+    "train_batch_size": 64,
+    "buffer_size": 50000,
+    "learning_starts": 200,
+    "target_network_update_freq": 10,
+    "num_train_batches_per_step": 2,
+    "imagined_batches_per_step": 4,   # the Dyna ratio: model steps per real
+    "model_train_batches_per_step": 4,
+    "model_lr": 1e-3,
+    "model_hiddens": [64, 64],
+    "lr": 1e-3,
+    "initial_epsilon": 1.0,
+    "final_epsilon": 0.05,
+    "epsilon_timesteps": 3000,
+    "hiddens": [64, 64],
+}
+
+
+class _DynamicsModel:
+    """Deterministic one-step model: predicts (next_obs - obs, reward,
+    done logit) from (obs, onehot action). One jitted train step, one jitted
+    batched rollout."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 config: Dict[str, Any]):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        hid = config.get("model_hiddens", [64, 64])
+        key = jax.random.PRNGKey(config.get("seed", 0) + 17)
+        self.params = init_mlp(
+            key, [obs_dim + num_actions] + hid + [obs_dim + 2])
+        self.opt = optax.adam(config.get("model_lr", 1e-3))
+        self.opt_state = self.opt.init(self.params)
+
+        def forward(params, obs, act_onehot):
+            out = apply_mlp(params, jnp.concatenate(
+                [obs, act_onehot], axis=-1))
+            delta, rew, done_logit = (out[..., :obs_dim],
+                                      out[..., obs_dim],
+                                      out[..., obs_dim + 1])
+            return obs + delta, rew, done_logit
+
+        def train_step(params, opt_state, batch):
+            def loss_fn(params):
+                onehot = jax.nn.one_hot(
+                    batch[ACTIONS].astype(jnp.int32), num_actions)
+                pred_next, pred_rew, done_logit = forward(
+                    params, batch[OBS], onehot)
+                obs_loss = jnp.mean((pred_next - batch[NEXT_OBS]) ** 2)
+                rew_loss = jnp.mean((pred_rew - batch[REWARDS]) ** 2)
+                done_loss = jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(
+                        done_logit, batch[DONES]))
+                return obs_loss + rew_loss + done_loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        def imagine(params, obs, actions):
+            onehot = jax.nn.one_hot(actions.astype(jnp.int32), num_actions)
+            next_obs, rew, done_logit = forward(params, obs, onehot)
+            return next_obs, rew, jax.nn.sigmoid(done_logit)
+
+        self._train = jax.jit(train_step)
+        self._imagine = jax.jit(imagine)
+
+    def train_on_batch(self, batch: SampleBatch) -> float:
+        dev = {k: jnp.asarray(np.asarray(batch[k]).astype(np.float32))
+               for k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)}
+        self.params, self.opt_state, loss = self._train(
+            self.params, self.opt_state, dev)
+        return float(loss)
+
+    def imagine_batch(self, obs: np.ndarray,
+                      actions: np.ndarray) -> SampleBatch:
+        next_obs, rew, done_p = self._imagine(
+            self.params, jnp.asarray(obs, jnp.float32),
+            jnp.asarray(actions, jnp.float32))
+        return SampleBatch({
+            OBS: np.asarray(obs, dtype=np.float32),
+            ACTIONS: np.asarray(actions, dtype=np.float32),
+            REWARDS: np.asarray(rew),
+            # Hard-threshold the done head: DQN's (1-done) bootstrap mask
+            # wants {0,1}, and a soft 0.5 would leak half a bootstrap.
+            DONES: (np.asarray(done_p) > 0.5).astype(np.float32),
+            NEXT_OBS: np.asarray(next_obs),
+        })
+
+
+class DynaTrainer(Trainer):
+    _policy_cls = DQNPolicy
+    _default_config = DYNA_CONFIG
+    _name = "Dyna"
+
+    def _build(self, config: Dict) -> None:
+        self.replay = ReplayBuffer(config["buffer_size"],
+                                   seed=config["seed"])
+        local = self.workers.local_worker()
+        self.model = _DynamicsModel(
+            local.vec_env.observation_dim, local.vec_env.num_actions, config)
+        self._model_rng = np.random.RandomState(config["seed"] + 29)
+
+    def _train_step(self) -> Dict:
+        cfg = self.raw_config
+        remote = self.workers.remote_workers()
+        if remote:
+            batches = ray_tpu.get([w.sample.remote() for w in remote])
+        else:
+            batches = [self.workers.local_worker().sample()]
+        for b in batches:
+            self.replay.add_batch(b)
+            self._steps_sampled += b.count
+
+        stats: Dict = {"buffer_size": len(self.replay)}
+        if self._steps_sampled < cfg["learning_starts"]:
+            return stats
+
+        for _ in range(cfg["model_train_batches_per_step"]):
+            batch = self.replay.sample(cfg["train_batch_size"])
+            stats["model_loss"] = self.model.train_on_batch(batch)
+
+        policy: DQNPolicy = self.workers.local_worker().policy
+        for _ in range(cfg["num_train_batches_per_step"]):
+            batch = self.replay.sample(cfg["train_batch_size"])
+            stats.update(policy.learn_on_batch(batch))
+            self._steps_trained += batch.count
+
+        # Imagination: replayed states, random candidate actions, model
+        # transitions — trained with the same jitted TD update.
+        num_actions = self.model.num_actions
+        for _ in range(cfg["imagined_batches_per_step"]):
+            seed_batch = self.replay.sample(cfg["train_batch_size"])
+            obs = np.asarray(seed_batch[OBS], dtype=np.float32)
+            actions = self._model_rng.randint(num_actions, size=len(obs))
+            imagined = self.model.imagine_batch(obs, actions)
+            im_stats = policy.learn_on_batch(imagined)
+            stats["imagined_loss"] = im_stats["loss"]
+            self._steps_trained += imagined.count
+
+        if self._iteration % cfg["target_network_update_freq"] == 0:
+            policy.update_target()
+        # As in dqn.py: advance the learner's epsilon clock from globally
+        # sampled steps before broadcasting to the acting workers.
+        policy.steps = max(policy.steps, self._steps_sampled)
+        self.workers.sync_weights()
+        return stats

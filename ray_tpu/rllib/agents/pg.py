@@ -25,6 +25,32 @@ from ..sample_batch import (
 from .trainer import Trainer
 
 
+def make_a2c_loss(vf_coeff: float, ent_coeff: float, use_baseline: bool):
+    """The shared actor-critic surrogate: REINFORCE term on normalized
+    advantages + value regression + entropy bonus. Returns
+    ``loss_fn(params, batch) -> (loss, stats)`` — used by both the fused
+    A2C update and A3C's split compute/apply gradient path."""
+
+    def loss_fn(params, batch):
+        logits = apply_mlp(params["pi"], batch[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        acts = batch[ACTIONS].astype(jnp.int32)
+        logp = logp_all[jnp.arange(acts.shape[0]), acts]
+        adv = batch[ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg_loss = -jnp.mean(logp * adv)
+        vf = apply_mlp(params["vf"], batch[OBS])[..., 0]
+        vf_loss = jnp.mean((vf - batch[VALUE_TARGETS]) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pg_loss - ent_coeff * entropy
+        if use_baseline:
+            total = total + vf_coeff * vf_loss
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss_fn
+
+
 class A2CPolicy(Policy):
     """Actor-critic with one fused jitted update (no ratio clipping —
     the batch is always on-policy)."""
@@ -40,9 +66,10 @@ class A2CPolicy(Policy):
         }
         self.opt = optax.adam(config.get("lr", 5e-4))
         self.opt_state = self.opt.init(self.params)
-        vf_coeff = config.get("vf_loss_coeff", 0.5)
-        ent_coeff = config.get("entropy_coeff", 0.01)
-        use_baseline = config.get("use_critic", True)
+        self._loss_fn = make_a2c_loss(
+            config.get("vf_loss_coeff", 0.5),
+            config.get("entropy_coeff", 0.01),
+            config.get("use_critic", True))
 
         def sample_action(params, obs, key):
             logits = apply_mlp(params["pi"], obs)
@@ -56,26 +83,8 @@ class A2CPolicy(Policy):
             return jnp.argmax(apply_mlp(params["pi"], obs), axis=-1)
 
         def update(params, opt_state, batch):
-            def loss_fn(params):
-                logits = apply_mlp(params["pi"], batch[OBS])
-                logp_all = jax.nn.log_softmax(logits)
-                acts = batch[ACTIONS].astype(jnp.int32)
-                logp = logp_all[jnp.arange(acts.shape[0]), acts]
-                adv = batch[ADVANTAGES]
-                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-                pg_loss = -jnp.mean(logp * adv)
-                vf = apply_mlp(params["vf"], batch[OBS])[..., 0]
-                vf_loss = jnp.mean((vf - batch[VALUE_TARGETS]) ** 2)
-                entropy = -jnp.mean(
-                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
-                total = pg_loss - ent_coeff * entropy
-                if use_baseline:
-                    total = total + vf_coeff * vf_loss
-                return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                               "entropy": entropy}
-
             (_, stats), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                self._loss_fn, has_aux=True)(params, batch)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, stats
 

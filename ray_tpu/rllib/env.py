@@ -94,6 +94,40 @@ class StatelessBandit(Env):
         return np.zeros(1, dtype=np.float32), reward, True, {}
 
 
+class TaskBandit(Env):
+    """A task-distribution bandit for meta-RL (reference: the TaskSettableEnv
+    protocol MAML trains over, rllib/env/env_context.py + maml's env reqs).
+
+    A *task* is which arm pays out. ``sample_tasks(n)`` draws tasks,
+    ``set_task(t)`` switches the env. A meta-learned policy cannot do better
+    than uniform before adaptation (the task is unobservable) but should
+    adapt to any task from one small support batch.
+    """
+
+    observation_dim = 1
+    num_actions = 4
+
+    def __init__(self, task: int = 0):
+        self.task = task
+        self.rng = np.random.RandomState(0)
+
+    def seed(self, seed: int) -> None:
+        self.rng = np.random.RandomState(seed)
+
+    def sample_tasks(self, n: int) -> List[int]:
+        return [int(t) for t in self.rng.randint(self.num_actions, size=n)]
+
+    def set_task(self, task: int) -> None:
+        self.task = int(task)
+
+    def reset(self) -> np.ndarray:
+        return np.zeros(1, dtype=np.float32)
+
+    def step(self, action: int):
+        reward = 1.0 if int(action) == self.task else 0.0
+        return np.zeros(1, dtype=np.float32), reward, True, {}
+
+
 class ContinuousEnv(Env):
     """Continuous-action env protocol: ``action_dim`` replaces
     ``num_actions``; actions are float arrays in [-1, 1]^action_dim
@@ -286,6 +320,7 @@ _ENV_REGISTRY = {
     "StatelessBandit": StatelessBandit,
     "MoveToTarget": MoveToTarget,
     "MultiAgentBandit": MultiAgentBandit,
+    "TaskBandit": TaskBandit,
     "TwoStepGame": TwoStepGame,
 }
 

@@ -262,6 +262,11 @@ class DQNPolicy(Policy):
                                "steps": self.steps})
 
     def set_weights(self, weights) -> None:
+        # Exact restore (checkpoint semantics). Learner-side trainers that
+        # broadcast to sampling actors must advance their own counter from
+        # globally sampled steps first (see DQN/Dyna/Apex _train_step), or
+        # the sync would reset every actor's epsilon schedule to the
+        # never-acting learner's zero.
         self.params = jax.device_put(weights["params"])
         self.target_params = jax.device_put(weights["target"])
         self.steps = weights.get("steps", self.steps)

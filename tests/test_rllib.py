@@ -586,3 +586,80 @@ def test_appo_learns_bandit(local_ray):
          "lr": 0.02, "hiddens": [16], "seed": 1,
          "entropy_coeff": 0.001},
         iters=40, min_reward=0.85)
+
+
+def test_a3c_learns_bandit(local_ray):
+    """A3C (reference: rllib/agents/a3c/a3c.py): workers compute gradients
+    against stale weights; the driver applies them as they arrive."""
+    from ray_tpu.rllib import A3CTrainer
+
+    _reward_of(
+        A3CTrainer,
+        {"env": "StatelessBandit", "num_workers": 2,
+         "num_envs_per_worker": 8, "rollout_fragment_length": 8,
+         "grads_per_step": 4, "lr": 0.02, "hiddens": [16], "seed": 1,
+         "entropy_coeff": 0.001},
+        iters=40, min_reward=0.85)
+
+
+def test_maml_adapts_to_new_tasks(local_ray):
+    """MAML (reference: rllib/agents/maml): post-adaptation reward on tasks
+    unseen this meta-step must beat the (necessarily ~chance) pre-adaptation
+    reward — the task is unobservable, so all the signal is in adaptability."""
+    from ray_tpu.rllib import MAMLTrainer
+
+    trainer = MAMLTrainer(
+        {"env": "TaskBandit", "num_workers": 0,
+         "num_envs_per_worker": 8, "rollout_fragment_length": 8,
+         "meta_batch_size": 8, "inner_lr": 3.0, "meta_lr": 0.03,
+         "hiddens": [16], "seed": 1})
+    try:
+        result = None
+        for _ in range(50):
+            result = trainer.train()
+            if result["post_adapt_reward_mean"] >= 0.6:
+                break
+        assert result["post_adapt_reward_mean"] >= 0.6, result
+        # The task is unobservable pre-adaptation: pre-reward stays near
+        # chance (0.25) while post-adaptation jumps — the MAML signature.
+        assert (result["post_adapt_reward_mean"]
+                - result["pre_adapt_reward_mean"]) >= 0.2, result
+
+        # Held-out check: adapt the meta-trained init to a fixed fresh task
+        # from one support batch and verify the greedy action is that arm.
+        local = trainer.workers.local_worker()
+        policy = trainer.get_policy()
+        theta = policy.get_weights()
+        for env in local.vec_env.envs:
+            env.set_task(3)
+        support = local.sample()
+        policy.set_params(policy.adapt(support))
+        greedy, _, _ = policy.compute_actions(
+            np.zeros((1, 1), np.float32), explore=False)
+        assert int(greedy[0]) == 3
+        policy.set_weights(theta)
+    finally:
+        trainer.cleanup()
+
+
+def test_dyna_learns_bandit_from_model(local_ray):
+    """Dyna: the learned dynamics model supplies most of the TD updates
+    (imagined_batches > real batches) and the policy still learns."""
+    from ray_tpu.rllib import DynaTrainer
+
+    result = _reward_of(
+        DynaTrainer,
+        {"env": "StatelessBandit", "num_workers": 0,
+         "num_envs_per_worker": 4, "rollout_fragment_length": 8,
+         "train_batch_size": 32, "learning_starts": 64,
+         "num_train_batches_per_step": 2, "imagined_batches_per_step": 6,
+         "model_train_batches_per_step": 6,
+         "epsilon_timesteps": 300, "final_epsilon": 0.02,
+         "lr": 0.01, "model_lr": 0.01, "hiddens": [16],
+         "model_hiddens": [16], "seed": 0},
+        iters=50, min_reward=0.8)
+    # The one-step model must actually be fitting the bandit (reward head
+    # MSE starts near 0.25 for a zero predictor on ~p=0.25 Bernoulli reward;
+    # the loop breaks as soon as the reward target is hit, so only require
+    # clear progress, not convergence).
+    assert result["model_loss"] < 0.15, result
