@@ -105,19 +105,23 @@ class A3CTrainer(Trainer):
         for w in remote:
             if w not in self._inflight.values():
                 self._inflight[w.apply.remote(_sample_and_grads)] = w
-        stats: Dict = {}
+        collected: list = []
         for _ in range(self.raw_config["grads_per_step"]):
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
             ref = ready[0]
             w = self._inflight.pop(ref)
             grads, stats, n = ray_tpu.get(ref)
+            collected.append(stats)
             local.policy.apply_gradients(grads)
             self._steps_sampled += n
             self._steps_trained += n
             # Ship fresh weights to the worker we just drained, then rearm it.
             w.set_weights.remote(local.get_weights())
             self._inflight[w.apply.remote(_sample_and_grads)] = w
-        return stats
+        # Mean over the gradients consumed this iteration, not a single
+        # last-to-land snapshot.
+        return {k: float(np.mean([s[k] for s in collected]))
+                for k in collected[0]} if collected else {}
 
     def cleanup(self) -> None:
         self._inflight.clear()

@@ -142,12 +142,7 @@ class ApexTrainer(Trainer):
         if self._train_calls % cfg["target_network_update_freq"] == 0:
             policy.update_target()
         if self._train_calls % cfg["broadcast_interval"] == 0:
-            # The learner's policy never samples, so its epsilon step count
-            # stays 0 — broadcasting it verbatim would reset every worker's
-            # exploration schedule each round. Advance it to the cluster-wide
-            # sampled-step count first.
-            policy.steps = max(policy.steps, self._steps_sampled)
-            self.workers.sync_weights()
+            self.workers.sync_weights(global_steps=self._steps_sampled)
         shard_sizes = ray_tpu.get(
             [ra.stats.remote() for ra in self.replay_actors])
         stats["replay_shard_sizes"] = [s["len"] for s in shard_sizes]

@@ -74,8 +74,5 @@ class DQNTrainer(Trainer):
 
         if self._iteration % cfg["target_network_update_freq"] == 0:
             policy.update_target()
-        # The learner never acts: drive its epsilon clock from the global
-        # sampled-step count so the broadcast carries a schedule that moves.
-        policy.steps = max(policy.steps, self._steps_sampled)
-        self.workers.sync_weights()
+        self.workers.sync_weights(global_steps=self._steps_sampled)
         return stats

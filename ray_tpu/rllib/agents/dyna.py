@@ -172,8 +172,5 @@ class DynaTrainer(Trainer):
 
         if self._iteration % cfg["target_network_update_freq"] == 0:
             policy.update_target()
-        # As in dqn.py: advance the learner's epsilon clock from globally
-        # sampled steps before broadcasting to the acting workers.
-        policy.steps = max(policy.steps, self._steps_sampled)
-        self.workers.sync_weights()
+        self.workers.sync_weights(global_steps=self._steps_sampled)
         return stats

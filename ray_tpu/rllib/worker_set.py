@@ -29,11 +29,21 @@ class WorkerSet:
     def remote_workers(self) -> List:
         return list(self._remote)
 
-    def sync_weights(self) -> None:
+    def sync_weights(self, global_steps: Optional[int] = None) -> None:
         """Broadcast learner weights to all rollout workers. The weights ref
-        is put once and shared (reference worker_set.sync_weights)."""
+        is put once and shared (reference worker_set.sync_weights).
+
+        ``global_steps``: for policies with a step-driven exploration
+        schedule (DQN family), the learner never acts, so its counter would
+        broadcast as ~0 and reset every actor's epsilon clock. Passing the
+        trainer's globally-sampled step count advances the learner's counter
+        before the snapshot — centralized here so no trainer can forget it.
+        """
         if not self._remote:
             return
+        pol = self._local.policy
+        if global_steps is not None and hasattr(pol, "steps"):
+            pol.steps = max(pol.steps, int(global_steps))
         weights = ray_tpu.put(self._local.get_weights())
         ray_tpu.get([w.set_weights.remote(weights) for w in self._remote])
 

@@ -68,6 +68,22 @@ class DataStream:
             raise ValueError("reduce requires key_by upstream")
         return self._chain("reduce", fn, parallelism, KEY_HASH, keyed=True)
 
+    def union(self, *streams: "DataStream",
+              parallelism: int = 1) -> "DataStream":
+        """Merge this stream with others into one interleaved stream
+        (reference: datastream.py:197 union). The result is keyed only if
+        every input is keyed (so a downstream reduce stays legal)."""
+        for s in streams:
+            if s._ctx is not self._ctx:
+                raise ValueError("union requires streams from one context")
+        keyed = self._keyed and all(s._keyed for s in streams)
+        op = self._ctx._add_op("union", None, parallelism)
+        for s in (self, *streams):
+            partition = getattr(s, "_force_partition",
+                                s._default_partition())
+            self._ctx.graph.add_edge(s._op_id, op.op_id, partition)
+        return DataStream(self._ctx, op.op_id, keyed)
+
     def broadcast(self) -> "DataStream":
         out = DataStream(self._ctx, self._op_id, self._keyed)
         out._force_partition = BROADCAST
@@ -121,10 +137,13 @@ class StreamingContext:
         ray_tpu.get([w.ready.remote()
                      for ws in self._workers.values() for w in ws])
         # wire edges: senders learn handles, receivers learn channel ids
-        for edge in self.graph.edges:
+        for eidx, edge in enumerate(self.graph.edges):
             src_ws = self._workers[edge.src_id]
             dst_ws = self._workers[edge.dst_id]
-            prefix = f"{self._job_uid}:e{edge.src_id}-{edge.dst_id}"
+            # The edge index keeps channel ids unique even for duplicate
+            # (src, dst) pairs — e.g. s.union(s) — where a shared prefix
+            # would collide shm ring names and dedupe expected inputs.
+            prefix = f"{self._job_uid}:e{eidx}:{edge.src_id}-{edge.dst_id}"
             calls = []
             for i, sw in enumerate(src_ws):
                 calls.append(sw.add_output.remote(

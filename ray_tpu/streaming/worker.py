@@ -352,6 +352,11 @@ class JobWorker:
                     self._reduce_state[key] = fn(self._reduce_state[key], value)
                 else:
                     self._reduce_state[key] = value
+        elif kind == "union":
+            # Pass-through merge point: records from every upstream edge
+            # land here and continue downstream interleaved
+            # (reference: datastream.py union -> UnionStream).
+            self._emit(list(items))
         elif kind == "sink":
             for x in items:
                 if fn is not None:

@@ -1,0 +1,159 @@
+"""Stress suite (reference: ci/regression_test/stress_tests/test_many_tasks.py
+stages 0-3 and test_dead_actors.py, scaled from a 100-node cluster to this
+1-vCPU container).
+
+The reference runs these as standalone drivers against a real cluster; here
+the same shapes run in-process (local mode) and against the multi-process
+Cluster fixture, sized so each test stays in tens of seconds. The *shapes*
+are what matter: a flat burst (scheduler queue pressure), a layered
+dependency lattice (dependency-manager fan-in/fan-out), many deep chains
+(sequential latency), and actor churn with kills (restart machinery under
+sustained death).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+
+pytestmark = pytest.mark.cluster
+
+
+class TestLocalStress:
+    """Local-mode stages (reference stress stage 0/1 shapes)."""
+
+    def test_flat_burst_many_noop_tasks(self, local_ray):
+        @ray_tpu.remote
+        def noop():
+            return 1
+
+        refs = [noop.remote() for _ in range(20_000)]
+        assert sum(ray_tpu.get(refs)) == 20_000
+
+    def test_layered_dependency_lattice(self, local_ray):
+        """100-wide x 20-deep: every task consumes the whole previous layer
+        (the reference's stage-3 500-wide chain shape, with full fan-in so
+        the dependency manager tracks W^2 edges per layer)."""
+
+        @ray_tpu.remote
+        def merge(*parts):
+            return sum(parts) + 1
+
+        width, depth = 100, 20
+        layer = [merge.remote() for _ in range(width)]
+        for _ in range(depth - 1):
+            # Each new task depends on 8 spread-out parents from the prior
+            # layer (full W-way fan-in at W=100 would pickle 100 refs per
+            # task x 100 tasks x 20 layers — shape, not volume, is the test).
+            layer = [
+                merge.remote(*[layer[(i + 13 * j) % width] for j in range(8)])
+                for i in range(width)
+            ]
+        out = ray_tpu.get(layer)
+        assert len(out) == width and all(isinstance(v, int) for v in out)
+
+    def test_many_deep_chains(self, local_ray):
+        """200 independent chains, each 50 deep (reference stage-2 shape):
+        pure sequential-latency pressure, no available parallelism."""
+
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        chains = []
+        for _ in range(200):
+            ref = inc.remote(0)
+            for _ in range(49):
+                ref = inc.remote(ref)
+            chains.append(ref)
+        assert ray_tpu.get(chains) == [50] * 200
+
+    def test_large_object_churn(self, local_ray):
+        """Sustained put/get of store-sized payloads forces eviction cycling
+        in the object store (reference: stress via object spill pressure)."""
+        mb = np.zeros(1 << 20, dtype=np.uint8)
+        for round_ in range(40):
+            refs = [ray_tpu.put(mb) for _ in range(4)]
+            for r in refs:
+                got = ray_tpu.get(r)
+                assert got.nbytes == mb.nbytes
+            del refs
+
+
+@pytest.fixture(scope="module")
+def stress_cluster():
+    c = Cluster(head_resources={"CPU": 2}, num_workers=2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def stress_driver(stress_cluster):
+    ray_tpu.init(address=stress_cluster.address, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestClusterStress:
+    def test_cluster_task_burst(self, stress_driver):
+        """A multi-process burst: every task pays real RPC + shm traffic."""
+
+        @ray_tpu.remote
+        def noop(i):
+            return i
+
+        refs = [noop.remote(i) for i in range(2_000)]
+        out = ray_tpu.get(refs, timeout=180)
+        assert out == list(range(2_000))
+
+    def test_cluster_wide_chain(self, stress_driver):
+        """50-wide x 10-deep lattice across nodes: inter-node dependency
+        staging on every layer boundary."""
+
+        @ray_tpu.remote
+        def merge(*parts):
+            return sum(parts) + 1
+
+        width = 50
+        layer = [merge.remote() for _ in range(width)]
+        for _ in range(9):
+            layer = [
+                merge.remote(layer[i], layer[(i + width // 2) % width])
+                for i in range(width)
+            ]
+        out = ray_tpu.get(layer, timeout=180)
+        assert len(out) == width
+
+    def test_dead_actors_churn(self, stress_driver):
+        """reference test_dead_actors.py: keep killing actors while calling
+        the survivors; the cluster must neither hang nor misroute."""
+
+        @ray_tpu.remote(max_restarts=0)
+        class Pinger:
+            def __init__(self, idx):
+                self.idx = idx
+
+            def ping(self):
+                return self.idx
+
+        rng = np.random.RandomState(0)
+        actors = [Pinger.remote(i) for i in range(10)]
+        alive = list(range(10))
+        for round_ in range(5):
+            victim_pos = int(rng.randint(len(alive)))
+            victim_idx = alive.pop(victim_pos)
+            ray_tpu.kill(actors[victim_idx])
+            # Survivors all still answer.
+            got = ray_tpu.get(
+                [actors[i].ping.remote() for i in alive], timeout=60)
+            assert got == alive
+            # Dead actor fails fast, not hangs.
+            with pytest.raises(Exception):
+                ray_tpu.get(actors[victim_idx].ping.remote(), timeout=30)
+            # Replace the dead one to keep population constant.
+            # Replace in idx order so list position == idx stays true.
+            new_idx = 10 + round_
+            actors.append(Pinger.remote(new_idx))
+            alive.append(new_idx)
+        assert len(alive) == 10
