@@ -1,0 +1,80 @@
+"""Hyperparameter search with Tune + ASHA
+(reference: doc/examples/hyperparameter/ — tune.run over a training function).
+
+Trains a tiny jax MLP on a synthetic two-moons-style classification task;
+ASHA kills underperforming learning rates early.
+
+Run:  python examples/hyperparameter_search.py [--smoke]
+"""
+
+import argparse
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+
+
+def make_blobs(seed=0, n=256):
+    rng = np.random.RandomState(seed)
+    x0 = rng.randn(n // 2, 2).astype(np.float32) + np.array([2.0, 0.0])
+    x1 = rng.randn(n // 2, 2).astype(np.float32) + np.array([-2.0, 0.0])
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def train_mlp(config):
+    x, y = make_blobs()
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    params = {
+        "w1": jax.random.normal(k1, (2, 16)) * 0.5, "b1": jnp.zeros(16),
+        "w2": jax.random.normal(k2, (16, 2)) * 0.5, "b2": jnp.zeros(2),
+    }
+    opt = optax.sgd(config["lr"])
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            h = jax.nn.relu(x @ p["w1"] + p["b1"])
+            logits = h @ p["w2"] + p["b2"]
+            return -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(len(y)), y])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for i in range(20):
+        params, opt_state, loss = step(params, opt_state)
+        tune.report(loss=float(loss), training_iteration=i + 1)
+
+
+def main(smoke: bool = False):
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    lrs = [0.001, 0.1] if smoke else [0.0001, 0.001, 0.01, 0.1, 0.5, 1.0]
+    analysis = tune.run(
+        train_mlp,
+        config={"lr": tune.grid_search(lrs)},
+        scheduler=AsyncHyperBandScheduler(
+            metric="loss", mode="min", max_t=20, grace_period=5),
+        local_dir=tempfile.mkdtemp(prefix="ray_tpu_tune_"),
+        verbose=0,
+    )
+    best = analysis.get_best_config("loss", mode="min")
+    print(f"best lr: {best['lr']}  "
+          f"(final loss {analysis.get_best_trial('loss', mode='min').last_result['loss']:.4f})")
+    return best
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    main(p.parse_args().smoke)

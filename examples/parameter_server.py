@@ -1,0 +1,97 @@
+"""Parameter-server training, sync and async
+(reference: doc/examples/parameter_server/ — the canonical Ray actor demo).
+
+One ParameterServer actor owns the weights; worker tasks compute gradients
+against the current weights and the server applies them — synchronously
+(barrier per round) or asynchronously (apply-as-they-arrive). The model is a
+jax linear regression so each gradient is one jitted call.
+
+Run:  python examples/parameter_server.py [--async] [--smoke]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+
+
+def make_data(seed: int = 0, n: int = 512, d: int = 8):
+    # One shared ground truth; each shard (seed) samples its own inputs.
+    w_true = np.random.RandomState(1234).randn(d).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+    return x, y, w_true
+
+
+@jax.jit
+def grad_fn(w, x, y):
+    return jax.grad(lambda w: jnp.mean((x @ w - y) ** 2))(w)
+
+
+@ray_tpu.remote
+class ParameterServer:
+    def __init__(self, dim: int, lr: float):
+        self.w = np.zeros(dim, dtype=np.float32)
+        self.lr = lr
+
+    def apply_gradient(self, grad):
+        self.w -= self.lr * np.asarray(grad)
+        return self.w
+
+    def get_weights(self):
+        return self.w
+
+
+@ray_tpu.remote
+def compute_grad(w, shard_seed):
+    x, y, _ = make_data(seed=shard_seed)
+    return np.asarray(grad_fn(jnp.asarray(w), x, y))
+
+
+def train_sync(num_workers: int, rounds: int, lr: float = 0.1) -> float:
+    ps = ParameterServer.remote(8, lr)
+    for _ in range(rounds):
+        w = ps.get_weights.remote()
+        grads = [compute_grad.remote(w, s) for s in range(num_workers)]
+        for g in grads:  # barrier: all gradients of this round
+            ps.apply_gradient.remote(g)
+    return final_loss(ray_tpu.get(ps.get_weights.remote()))
+
+
+def train_async(num_workers: int, rounds: int, lr: float = 0.05) -> float:
+    ps = ParameterServer.remote(8, lr)
+    inflight = {compute_grad.remote(ps.get_weights.remote(), s): s
+                for s in range(num_workers)}
+    for _ in range(rounds * num_workers):
+        [done], _ = ray_tpu.wait(list(inflight), num_returns=1)
+        shard = inflight.pop(done)
+        w = ps.apply_gradient.remote(done)  # apply, no barrier
+        inflight[compute_grad.remote(w, shard)] = shard
+    return final_loss(ray_tpu.get(ps.get_weights.remote()))
+
+
+def final_loss(w) -> float:
+    x, y, _ = make_data(seed=0)
+    return float(jnp.mean((x @ jnp.asarray(w) - y) ** 2))
+
+
+def main(use_async: bool = False, smoke: bool = False) -> float:
+    rounds = 5 if smoke else 50
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    loss = (train_async if use_async else train_sync)(4, rounds)
+    mode = "async" if use_async else "sync"
+    print(f"parameter server ({mode}): final loss {loss:.4f}")
+    return loss
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--async", dest="use_async", action="store_true")
+    p.add_argument("--smoke", action="store_true")
+    a = p.parse_args()
+    main(a.use_async, a.smoke)

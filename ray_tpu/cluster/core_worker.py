@@ -19,6 +19,7 @@ import os
 import pickle
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -52,6 +53,8 @@ class ClusterCoreWorker:
         self._thread_scope_counter = itertools.count(1 << 31)
         self._ser = get_context()
         self._exported_fns: set = set()
+        self._fn_id_by_obj: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
         self._fn_lock = threading.Lock()
         self._controllers: Dict[Tuple[str, int], RpcClient] = {}
         self._controller_lock = threading.Lock()
@@ -250,6 +253,17 @@ class ClusterCoreWorker:
         raise ClusterUnavailableError("no reachable nodes in cluster")
 
     def _export_fn(self, fn: Callable) -> bytes:
+        # Export-once semantics (reference: FunctionActorManager exports at
+        # decoration time, not per call): the same function object submitted
+        # N times must not pay N cloudpickles — at cluster task rates the
+        # serialization dominates driver CPU. Keyed by object identity;
+        # a WeakKeyDictionary so defining-and-dropping lambdas can't leak.
+        try:
+            cached = self._fn_id_by_obj.get(fn)
+        except TypeError:  # unhashable/unweakreferenceable callable
+            cached = None
+        if cached is not None:
+            return cached
         blob = cloudpickle.dumps(fn)
         fn_id = hashlib.blake2b(blob, digest_size=16).digest()
         with self._fn_lock:
@@ -257,6 +271,10 @@ class ClusterCoreWorker:
                 self.gcs.call({"type": "put_function", "fn_id": fn_id,
                                "blob": blob})
                 self._exported_fns.add(fn_id)
+        try:
+            self._fn_id_by_obj[fn] = fn_id
+        except TypeError:
+            pass
         return fn_id
 
     def _pack_value(self, value: Any,

@@ -192,6 +192,7 @@ class DQNPolicy(Policy):
         self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
         self.opt = optax.adam(config.get("lr", 1e-3))
         self.opt_state = self.opt.init(self.params)
+        self.initial_epsilon = config.get("initial_epsilon", 1.0)
         self.final_epsilon = config.get("final_epsilon", 0.02)
         self.epsilon_timesteps = config.get("epsilon_timesteps", 10000)
         self.steps = 0
@@ -231,7 +232,8 @@ class DQNPolicy(Policy):
         """Schedule-derived (not cached at act time): the learner's reported
         epsilon stays honest even though only rollout actors ever act."""
         frac = min(1.0, self.steps / max(self.epsilon_timesteps, 1))
-        return 1.0 + frac * (self.final_epsilon - 1.0)
+        return (self.initial_epsilon
+                + frac * (self.final_epsilon - self.initial_epsilon))
 
     def compute_actions(self, obs: np.ndarray, explore: bool = True):
         q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))

@@ -1,0 +1,53 @@
+"""Every example runs end-to-end in smoke mode (reference: doc/examples are
+exercised in CI via doc tests)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_parameter_server_sync(local_ray):
+    from examples.parameter_server import main
+
+    assert main(use_async=False, smoke=True) < 1.0
+
+
+def test_parameter_server_async(local_ray):
+    from examples.parameter_server import main
+
+    assert main(use_async=True, smoke=True) < 1.0
+
+
+def test_mapreduce_wordcount(local_ray):
+    from examples.mapreduce_wordcount import main
+
+    counts = main(smoke=True)
+    assert counts["the"] > 0
+
+
+def test_hyperparameter_search(local_ray):
+    from examples.hyperparameter_search import main
+
+    best = main(smoke=True)
+    assert best["lr"] == 0.1  # the sane lr beats 0.001 in 20 iters
+
+
+def test_cartpole_ppo(local_ray):
+    from examples.cartpole_ppo import main
+
+    result = main(smoke=True)
+    assert result["timesteps_total"] > 0
+
+
+def test_serve_model(local_ray):
+    from examples.serve_model import main
+
+    main(smoke=True)
+
+
+def test_pipelined_transformer():
+    from examples.pipelined_transformer import main
+
+    loss = main(smoke=True)
+    assert loss > 0

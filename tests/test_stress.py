@@ -17,11 +17,12 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster import Cluster
 
-pytestmark = pytest.mark.cluster
-
 
 class TestLocalStress:
-    """Local-mode stages (reference stress stage 0/1 shapes)."""
+    """Local-mode stages (reference stress stage 0/1 shapes).
+
+    Deliberately NOT marked ``cluster``: these run in-process and must stay
+    selected in a fast ``-m "not cluster"`` lane."""
 
     def test_flat_burst_many_noop_tasks(self, local_ray):
         @ray_tpu.remote
@@ -95,6 +96,7 @@ def stress_driver(stress_cluster):
     ray_tpu.shutdown()
 
 
+@pytest.mark.cluster
 class TestClusterStress:
     def test_cluster_task_burst(self, stress_driver):
         """A multi-process burst: every task pays real RPC + shm traffic."""
