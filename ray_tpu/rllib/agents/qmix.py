@@ -140,7 +140,8 @@ class QMIXPolicy:
         if explore:
             cfg = self.config
             frac = min(1.0, self.steps / max(cfg["epsilon_timesteps"], 1))
-            self.epsilon = 1.0 + frac * (cfg["final_epsilon"] - 1.0)
+            eps0 = cfg.get("initial_epsilon", 1.0)
+            self.epsilon = eps0 + frac * (cfg["final_epsilon"] - eps0)
             mask = self._np_rng.rand(self.n_agents) < self.epsilon
             actions = np.where(
                 mask,

@@ -1,0 +1,246 @@
+"""Long-running soak workloads
+(reference: ci/long_running_tests/workloads/ — many_tasks.py, actor_deaths.py,
+node_failures.py, serve_failure.py, pbt.py run for hours against a cluster).
+
+Each workload loops until --duration expires and must hold two invariants:
+no error escapes, and per-iteration progress never stalls (an iteration
+taking > 20x the trailing median fails the run — the reference's soak
+failures are almost always hangs, not crashes).
+
+Run:  python scripts/soak.py --workload many_tasks --duration 60
+      python scripts/soak.py --all --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def _loop(name: str, duration_s: float, body, setup=None, teardown=None):
+    """Drive one workload; returns iterations completed."""
+    state = setup() if setup else None
+    iters = 0
+    times = []
+    deadline = time.time() + duration_s
+    try:
+        while time.time() < deadline:
+            t0 = time.time()
+            body(state, iters)
+            dt = time.time() - t0
+            times.append(dt)
+            iters += 1
+            if len(times) >= 8:
+                med = statistics.median(times[-50:])
+                if dt > max(20 * med, 5.0):
+                    raise RuntimeError(
+                        f"{name}: iteration {iters} took {dt:.1f}s "
+                        f"(median {med:.2f}s) — stall")
+    finally:
+        if teardown:
+            teardown(state)
+    rate = iters / max(duration_s, 1e-9)
+    print(f"[soak] {name}: {iters} iterations ({rate:.1f}/s), "
+          f"median {statistics.median(times):.3f}s" if times else
+          f"[soak] {name}: 0 iterations")
+    return iters
+
+
+# --------------------------------------------------------------- workloads
+
+def many_tasks(duration_s: float) -> int:
+    """Waves of dependent fan-out (reference workloads/many_tasks.py)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def child(i):
+        return i
+
+    @ray_tpu.remote
+    def merge(*xs):
+        return sum(xs)
+
+    def body(_, i):
+        kids = [child.remote(j) for j in range(100)]
+        total = ray_tpu.get(merge.remote(*kids), timeout=60)
+        assert total == sum(range(100))
+
+    return _loop("many_tasks", duration_s, body)
+
+
+def actor_deaths(duration_s: float) -> int:
+    """Constant actor churn with kills (reference workloads/actor_deaths.py)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, idx):
+            self.idx = idx
+
+        def work(self, x):
+            return x + self.idx
+
+    rng = np.random.RandomState(0)
+
+    def setup():
+        return {"actors": [Worker.remote(i) for i in range(8)]}
+
+    def body(state, i):
+        actors = state["actors"]
+        victim = int(rng.randint(len(actors)))
+        ray_tpu.kill(actors[victim])
+        actors[victim] = Worker.remote(victim)
+        # all (incl. the fresh replacement) must answer
+        out = ray_tpu.get(
+            [a.work.remote(100) for a in actors], timeout=60)
+        assert sorted(out) == [100 + j for j in range(len(actors))]
+
+    return _loop("actor_deaths", duration_s, body, setup=setup)
+
+
+def node_failures(duration_s: float) -> int:
+    """Kill and re-add worker nodes while tasks flow
+    (reference workloads/node_failures.py)."""
+    import ray_tpu
+    from ray_tpu.cluster.testing import Cluster
+
+    @ray_tpu.remote
+    def work(i):
+        return i * i
+
+    def setup():
+        c = Cluster(head_resources={"CPU": 2}, num_workers=1)
+        c.add_node(resources={"CPU": 1}, num_workers=1)
+        ray_tpu.init(address=c.address, ignore_reinit_error=True)
+        return {"cluster": c}
+
+    def body(state, i):
+        c = state["cluster"]
+        out = ray_tpu.get([work.remote(j) for j in range(50)], timeout=120)
+        assert out == [j * j for j in range(50)]
+        if i % 3 == 2:
+            # Cycle the non-head node (nodes[0] is the head: killing it
+            # would take the GCS down with it).
+            c.remove_node(c.nodes[-1])
+            c.add_node(resources={"CPU": 1}, num_workers=1)
+            c.wait_for_nodes(2, timeout=60)
+
+    def teardown(state):
+        ray_tpu.shutdown()
+        state["cluster"].shutdown()
+
+    return _loop("node_failures", duration_s, body,
+                 setup=setup, teardown=teardown)
+
+
+def serve_failure(duration_s: float) -> int:
+    """Random replica/master kills under steady query load
+    (reference workloads/serve_failure.py)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    rng = np.random.RandomState(0)
+
+    def setup():
+        serve.init()
+        serve.create_backend("soak:v1", lambda x=None: {"v": x})
+        serve.create_endpoint("soak", backend="soak:v1")
+        return {"handle": serve.get_handle("soak")}
+
+    def body(state, i):
+        h = state["handle"]
+        out = ray_tpu.get([h.remote(j) for j in range(20)], timeout=60)
+        assert [o["v"] for o in out] == list(range(20))
+        if i % 5 == 4:
+            # Kill the control plane; max_restarts=-1 + checkpoint restore
+            # must bring it back without dropping the endpoint.
+            try:
+                from ray_tpu.serve.master import MASTER_NAME
+                master = ray_tpu.get_actor(MASTER_NAME)
+                ray_tpu.kill(master, no_restart=False)
+                time.sleep(0.5)
+            except Exception:
+                pass
+
+    def teardown(state):
+        serve.shutdown()
+
+    return _loop("serve_failure", duration_s, body,
+                 setup=setup, teardown=teardown)
+
+
+def pbt(duration_s: float) -> int:
+    """Repeated short PBT runs (reference workloads/pbt.py)."""
+    import tempfile
+
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+    def objective(config):
+        x = 0.0
+        for i in range(5):
+            x += config["lr"]
+            tune.report(score=x, training_iteration=i + 1)
+
+    def body(_, i):
+        analysis = tune.run(
+            objective,
+            config={"lr": tune.sample_from(
+                lambda _: float(np.random.uniform(0.1, 1.0)))},
+            num_samples=4,
+            scheduler=PopulationBasedTraining(
+                metric="score", mode="max", time_attr="training_iteration",
+                perturbation_interval=2,
+                hyperparam_mutations={"lr": tune.sample_from(
+                    lambda _: float(np.random.uniform(0.1, 1.0)))}),
+            local_dir=tempfile.mkdtemp(prefix="soak_pbt_"),
+            verbose=0,
+        )
+        assert len(analysis.trials) == 4
+
+    return _loop("pbt", duration_s, body)
+
+
+WORKLOADS = {
+    "many_tasks": many_tasks,
+    "actor_deaths": actor_deaths,
+    "node_failures": node_failures,
+    "serve_failure": serve_failure,
+    "pbt": pbt,
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", choices=sorted(WORKLOADS))
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--duration", type=float, default=60.0,
+                   help="seconds per workload")
+    a = p.parse_args(argv)
+    names = sorted(WORKLOADS) if a.all else [a.workload]
+    if names == [None]:
+        p.error("pass --workload NAME or --all")
+
+    import ray_tpu
+    results = {}
+    for name in names:
+        # node_failures manages its own cluster; others run local mode.
+        # A leftover local-mode runtime would make the cluster connect a
+        # silent no-op (ignore_reinit), so tear it down first.
+        standalone = name == "node_failures"
+        if standalone:
+            if ray_tpu.is_initialized():
+                ray_tpu.shutdown()
+        elif not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=4)
+        results[name] = WORKLOADS[name](a.duration)
+    print("[soak] all workloads completed:", results)
+    return results
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

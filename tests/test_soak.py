@@ -1,0 +1,24 @@
+"""Short-mode runs of the soak workloads (reference: ci/long_running_tests/
+workloads are smoke-run in CI before being left to soak for hours)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import soak  # noqa: E402
+
+
+def test_soak_local_workloads(local_ray):
+    assert soak.many_tasks(3.0) > 0
+    assert soak.actor_deaths(3.0) > 0
+    assert soak.pbt(3.0) > 0
+    assert soak.serve_failure(3.0) > 0
+
+
+@pytest.mark.cluster
+def test_soak_node_failures():
+    # Manages its own Cluster + driver connection.
+    assert soak.node_failures(10.0) >= 3

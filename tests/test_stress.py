@@ -85,6 +85,7 @@ class TestLocalStress:
 @pytest.fixture(scope="module")
 def stress_cluster():
     c = Cluster(head_resources={"CPU": 2}, num_workers=2)
+    c.add_node(resources={"CPU": 2}, num_workers=2)  # a real second node
     yield c
     c.shutdown()
 
