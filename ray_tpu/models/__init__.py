@@ -8,3 +8,14 @@ from .transformer import (  # noqa: F401
     make_train_step,
     param_shardings,
 )
+# moe_transformer deliberately NOT re-exported here: its public names
+# (init_params/forward/loss_fn/...) intentionally mirror transformer's and
+# would shadow them — import via ray_tpu.models.moe_transformer.
+from .vision import (  # noqa: F401
+    VisionConfig,
+    init_vision_params,
+    vision_accuracy,
+    vision_apply,
+    vision_loss,
+    vision_param_shardings,
+)

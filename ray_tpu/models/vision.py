@@ -1,0 +1,149 @@
+"""Vision model family: ResNet-style convnet, TPU-first.
+
+Convolutions are MXU work exactly like matmuls (XLA tiles NHWC convs onto
+the systolic array), so the design rules match the transformer flagship:
+plain jax pytrees, static shapes, GroupNorm instead of BatchNorm (no running
+state threading through pjit), scan-friendly blocks, dp sharding = batch
+split + GSPMD-psum'd gradients with replicated params.
+
+The reference framework has no vision models of its own (RLlib's catalog
+wraps torch/TF); this module gives the trainer library (ray_tpu/train) and
+serve a second first-class model family beside the transformer LM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 32
+    in_channels: int = 3
+    num_classes: int = 10
+    widths: Tuple[int, ...] = (32, 64, 128)   # one stage per entry, stride 2
+    blocks_per_stage: int = 2
+    groups: int = 8                            # GroupNorm groups
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        for w in self.widths:
+            g = min(self.groups, w)
+            if w % g:
+                raise ValueError(
+                    f"width {w} not divisible by GroupNorm groups {g}; "
+                    f"pick widths that are multiples of groups={self.groups}")
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    scale = jnp.sqrt(2.0 / (kh * kw * cin))
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * scale).astype(dtype)
+
+
+def init_vision_params(key: jax.Array, cfg: VisionConfig) -> Params:
+    # stem + head + up to (2 convs + 1 proj) per block, sized to the config.
+    n_keys = 2 + 3 * len(cfg.widths) * cfg.blocks_per_stage
+    keys = iter(jax.random.split(key, n_keys))
+    pd = cfg.param_dtype
+    params: Params = {
+        "stem": _conv_init(next(keys), 3, 3, cfg.in_channels,
+                           cfg.widths[0], pd),
+    }
+    cin = cfg.widths[0]
+    for s, width in enumerate(cfg.widths):
+        stage = []
+        for b in range(cfg.blocks_per_stage):
+            # GroupNorm1 acts on the block INPUT (cin channels,
+            # pre-activation layout); everything after conv1 is `width`.
+            block = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, width, pd),
+                "conv2": _conv_init(next(keys), 3, 3, width, width, pd),
+                "scale1": jnp.ones(cin, pd), "bias1": jnp.zeros(cin, pd),
+                "scale2": jnp.ones(width, pd), "bias2": jnp.zeros(width, pd),
+            }
+            downsamples = b == 0 and s > 0
+            if cin != width or downsamples:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, width, pd)
+            stage.append(block)
+            cin = width
+        params[f"stage{s}"] = stage
+    params["head_w"] = (jax.random.normal(next(keys),
+                                          (cfg.widths[-1], cfg.num_classes))
+                        * 0.01).astype(pd)
+    params["head_b"] = jnp.zeros(cfg.num_classes, pd)
+    return params
+
+
+def _group_norm(x, scale, bias, groups: int, eps: float = 1e-5):
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xg = x.reshape(n, h, w, g, c // g)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(n, h, w, c) * scale + bias
+
+
+def _conv(x, w, stride: int = 1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _block(x, p, cfg: VisionConfig, stride: int):
+    """Pre-activation residual block (He 2016 v2)."""
+    h = _group_norm(x, p["scale1"], p["bias1"], cfg.groups)
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv1"], stride)
+    h = _group_norm(h, p["scale2"], p["bias2"], cfg.groups)
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv2"])
+    if "proj" in p:
+        x = _conv(x, p["proj"], stride)
+    return x + h
+
+
+def vision_apply(params: Params, images: jnp.ndarray,
+                 cfg: VisionConfig) -> jnp.ndarray:
+    """images [N, H, W, C] -> logits [N, num_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"])
+    for s in range(len(cfg.widths)):
+        for b, block in enumerate(params[f"stage{s}"]):
+            stride = 2 if (b == 0 and s > 0) else 1
+            x = _block(x, block, cfg, stride)
+    x = x.mean(axis=(1, 2))  # global average pool
+    return x @ params["head_w"] + params["head_b"]
+
+
+def vision_loss(params: Params, batch: Dict[str, jnp.ndarray],
+                cfg: VisionConfig) -> jnp.ndarray:
+    logits = vision_apply(params, batch["images"], cfg)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(logp[jnp.arange(labels.shape[0]), labels])
+
+
+def vision_accuracy(params: Params, batch: Dict[str, jnp.ndarray],
+                    cfg: VisionConfig) -> jnp.ndarray:
+    logits = vision_apply(params, batch["images"], cfg)
+    return jnp.mean(
+        (jnp.argmax(logits, -1) == batch["labels"]).astype(jnp.float32))
+
+
+def vision_param_shardings(cfg: VisionConfig, mesh: Mesh):
+    """dp training: params replicated, batch split — convs this small are
+    compute-bound per example, so dp is the right first axis; GSPMD inserts
+    the gradient psum."""
+    replicated = NamedSharding(mesh, P())
+    shapes = jax.eval_shape(
+        lambda k: init_vision_params(k, cfg), jax.random.PRNGKey(0))
+    return jax.tree_util.tree_map(lambda _: replicated, shapes)

@@ -25,6 +25,9 @@ from .api import (  # noqa: F401
 )
 from .config import BackendConfig  # noqa: F401
 from .handle import ServeHandle  # noqa: F401
+from .metric import (  # noqa: F401
+    ExporterInterface, InMemoryExporter, PrometheusExporter,
+)
 
 __all__ = [
     "init",
@@ -43,4 +46,7 @@ __all__ = [
     "http_address",
     "BackendConfig",
     "ServeHandle",
+    "ExporterInterface",
+    "InMemoryExporter",
+    "PrometheusExporter",
 ]

@@ -107,8 +107,26 @@ def get_handle(endpoint: str) -> ServeHandle:
     return ServeHandle(router, endpoint)
 
 
-def stat() -> dict:
-    return ray_tpu.get(_require_master().stat.remote())
+def stat(exporter=None):
+    """Routing stats + per-endpoint/backend latency metrics
+    (reference: serve/api.py:377 stat + serve/metric/ exporters).
+
+    ``exporter``: an ``ExporterInterface`` deciding the render format —
+    default ``InMemoryExporter`` (plain dict); ``PrometheusExporter()``
+    returns the text exposition format.
+    """
+    from .metric import InMemoryExporter
+
+    master = _require_master()
+    router = ray_tpu.get(master.get_router.remote())[0]
+    rendered = (exporter or InMemoryExporter()).export(
+        ray_tpu.get(router.metric_snapshot.remote()))
+    if isinstance(rendered, dict):
+        # Dict renders merge with the routing stats; text renders (e.g.
+        # Prometheus scrapes) skip the extra control-plane RPC entirely.
+        base = ray_tpu.get(master.stat.remote())
+        return {**base, "metrics": rendered}
+    return rendered
 
 
 def accept_batch(fn: Callable) -> Callable:

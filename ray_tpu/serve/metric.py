@@ -1,0 +1,107 @@
+"""Serve metrics: per-endpoint/backend counters + latency distributions
+(reference: python/ray/serve/metric/ — MetricClient with InMemoryExporter /
+PrometheusExporter, surfaced through serve.stat()).
+
+The reference pushes metrics from replicas to an exporter actor; here the
+router IS the single data-plane chokepoint, so it records in place (no extra
+actor, no push RPCs) and exporters are just render strategies over the
+router's state — ``serve.stat()`` fetches one snapshot.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List
+
+
+class LatencyWindow:
+    """Fixed-size reservoir of recent latencies (seconds) + total counters."""
+
+    def __init__(self, maxlen: int = 2048):
+        self.samples: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.errors = 0
+        self.started = time.time()
+
+    def record(self, latency_s: float, error: bool = False) -> None:
+        self.samples.append(latency_s)
+        self.count += 1
+        if error:
+            self.errors += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        xs: List[float] = sorted(self.samples)
+        n = len(xs)
+
+        def pct(p: float) -> float:
+            if not n:
+                return 0.0
+            return xs[min(n - 1, int(p * n))]
+
+        elapsed = max(time.time() - self.started, 1e-9)
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "qps": round(self.count / elapsed, 2),
+            "latency_ms_mean": round(1e3 * sum(xs) / n, 3) if n else 0.0,
+            "latency_ms_p50": round(1e3 * pct(0.50), 3),
+            "latency_ms_p90": round(1e3 * pct(0.90), 3),
+            "latency_ms_p99": round(1e3 * pct(0.99), 3),
+        }
+
+
+class MetricRecorder:
+    """Lives inside the router; one LatencyWindow per endpoint and backend."""
+
+    def __init__(self):
+        self.endpoints: Dict[str, LatencyWindow] = {}
+        self.backends: Dict[str, LatencyWindow] = {}
+
+    def record(self, endpoint: str, backend: str, latency_s: float,
+               error: bool = False) -> None:
+        self.endpoints.setdefault(endpoint, LatencyWindow()).record(
+            latency_s, error)
+        self.backends.setdefault(backend, LatencyWindow()).record(
+            latency_s, error)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "endpoints": {k: w.snapshot() for k, w in self.endpoints.items()},
+            "backends": {k: w.snapshot() for k, w in self.backends.items()},
+        }
+
+
+class ExporterInterface:
+    """Render strategy over a metrics snapshot (reference
+    serve/metric/exporter.py ExporterInterface)."""
+
+    def export(self, snapshot: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class InMemoryExporter(ExporterInterface):
+    """Returns the snapshot dict verbatim (reference InMemoryExporter)."""
+
+    def export(self, snapshot: Dict[str, Any]):
+        return snapshot
+
+
+class PrometheusExporter(ExporterInterface):
+    """Renders the Prometheus text exposition format — no client library,
+    the format is just lines (reference PrometheusExporter)."""
+
+    def export(self, snapshot: Dict[str, Any]) -> str:
+        lines: List[str] = []
+
+        def emit(scope: str, name: str, stats: Dict[str, float]) -> None:
+            label = f'{{{scope}="{name}"}}'
+            for key, val in stats.items():
+                metric = f"ray_serve_{scope}_{key}"
+                lines.append(f"{metric}{label} {val}")
+
+        for ep, stats in snapshot.get("endpoints", {}).items():
+            emit("endpoint", ep, stats)
+        for b, stats in snapshot.get("backends", {}).items():
+            emit("backend", b, stats)
+        return "\n".join(lines) + "\n"

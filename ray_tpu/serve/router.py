@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -35,10 +36,13 @@ class Router:
     """Routes (endpoint, query) -> backend replica. Runs as an asyncio actor."""
 
     def __init__(self):
+        from .metric import MetricRecorder
+
         self.backends: Dict[str, _Backend] = {}
         self.traffic: Dict[str, Dict[str, float]] = {}  # endpoint -> backend -> w
         self.num_routed: Dict[str, int] = {}
         self.num_errors: Dict[str, int] = {}
+        self.metrics = MetricRecorder()
 
     # ---- control plane (called by ServeMaster) ----
 
@@ -91,12 +95,18 @@ class Router:
     async def remove_backend(self, backend_tag: str) -> None:
         self._drain(self.backends.pop(backend_tag, None), None,
                     f"backend {backend_tag!r} was deleted")
+        # Drop its metric window too, or churn leaks one window (and one
+        # forever-reported Prometheus series) per ever-seen tag.
+        self.metrics.backends.pop(backend_tag, None)
 
     async def set_traffic(self, endpoint: str, traffic: Dict[str, float]) -> None:
         self.traffic[endpoint] = dict(traffic)
 
     async def remove_endpoint(self, endpoint: str) -> None:
         self.traffic.pop(endpoint, None)
+        self.metrics.endpoints.pop(endpoint, None)
+        self.num_routed.pop(endpoint, None)
+        self.num_errors.pop(endpoint, None)
 
     # ---- data plane ----
 
@@ -111,15 +121,21 @@ class Router:
             raise RuntimeError(
                 f"backend {backend_tag!r} for endpoint {endpoint!r} has no replicas")
         self.num_routed[endpoint] = self.num_routed.get(endpoint, 0) + 1
+        t0 = time.monotonic()
         try:
             if b.queue is not None:
                 fut = asyncio.get_event_loop().create_future()
                 await b.queue.put((method, args, kwargs, fut))
-                return await fut
-            return await self._call_one(b, method, args, kwargs)
+                result = await fut
+            else:
+                result = await self._call_one(b, method, args, kwargs)
         except Exception:
             self.num_errors[endpoint] = self.num_errors.get(endpoint, 0) + 1
+            self.metrics.record(endpoint, backend_tag,
+                                time.monotonic() - t0, error=True)
             raise
+        self.metrics.record(endpoint, backend_tag, time.monotonic() - t0)
+        return result
 
     def _pick_backend(self, traffic: Dict[str, float]) -> str:
         tags = list(traffic.keys())
@@ -213,3 +229,6 @@ class Router:
                 for tag, b in self.backends.items()
             },
         }
+
+    async def metric_snapshot(self) -> dict:
+        return self.metrics.snapshot()

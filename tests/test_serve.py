@@ -182,3 +182,36 @@ def test_http_ingress(local_ray):
             assert e.code == 404
     finally:
         serve.shutdown()
+
+
+def test_serve_metrics_and_exporters(serve_instance):
+    """serve.stat() carries per-endpoint/backend latency distributions
+    (reference: serve/metric/ MetricClient + InMemory/Prometheus exporters)."""
+    from ray_tpu.serve import PrometheusExporter
+
+    serve.create_backend("met:v1", lambda x=None: x)
+    serve.create_endpoint("met", backend="met:v1")
+    h = serve.get_handle("met")
+    ray_tpu.get([h.remote(i) for i in range(25)])
+
+    s = serve.stat()
+    ep = s["metrics"]["endpoints"]["met"]
+    assert ep["count"] == 25 and ep["errors"] == 0
+    assert ep["latency_ms_p50"] > 0
+    assert ep["latency_ms_p99"] >= ep["latency_ms_p50"]
+    be = s["metrics"]["backends"]["met:v1"]
+    assert be["count"] == 25
+
+    # error accounting
+    serve.create_backend("boom:v1", lambda x=None: 1 / 0)
+    serve.create_endpoint("boom", backend="boom:v1")
+    hb = serve.get_handle("boom")
+    with pytest.raises(Exception):
+        ray_tpu.get(hb.remote(1))
+    s = serve.stat()
+    assert s["metrics"]["endpoints"]["boom"]["errors"] == 1
+
+    # prometheus text format
+    text = serve.stat(exporter=PrometheusExporter())
+    assert 'ray_serve_endpoint_count{endpoint="met"} 25' in text
+    assert 'ray_serve_backend_latency_ms_p50{backend="met:v1"}' in text
