@@ -46,8 +46,19 @@ async def run_head(port: int, resources: dict, num_workers: int,
     from ray_tpu._private.config import get_config
     from ray_tpu.cluster.gcs import GcsServer
 
+    import os
+
     config = get_config()
     gcs = GcsServer(config, port=port, persist_path=persist)
+    # RAY_TPU_PROFILE_GCS=<path>: cProfile the GCS event loop, dump pstats
+    # at shutdown (the server-side complement of profiling the driver).
+    profiler = None
+    prof_path = os.environ.get("RAY_TPU_PROFILE_GCS")
+    if prof_path:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
     gcs_port = await gcs.start()
     print(json.dumps({"event": "gcs_started", "port": gcs_port}), flush=True)
     node_stop = None
@@ -70,6 +81,11 @@ async def run_head(port: int, resources: dict, num_workers: int,
     try:
         await stop.wait()
     finally:
+        # Dump the profile FIRST (sync, cannot be cancelled): a failing or
+        # cancelled shutdown below must not discard the session's data.
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(prof_path)
         if node_stop is not None:
             # Wake the colocated controller's loop so its finally block
             # (worker terminate + arena unlink) actually runs.

@@ -19,3 +19,6 @@ from .vision import (  # noqa: F401
     vision_loss,
     vision_param_shardings,
 )
+# generate deliberately NOT re-exported: `from .generate import generate`
+# would shadow the ray_tpu.models.generate submodule itself — import via
+# ray_tpu.models.generate (same rule as moe_transformer above).

@@ -1,0 +1,165 @@
+"""KV-cache autoregressive generation for the flagship transformer.
+
+TPU-first decode loop: the cache is a pair of preallocated [L, B, S, KH, Dh]
+buffers (static shapes — no concat-growing arrays, which would retrace and
+re-tile every step), the per-step update is one `dynamic_update_slice`, and
+the whole generation runs as a single `lax.scan` under jit: one compiled
+program regardless of token count. Sampling is greedy at temperature 0,
+categorical otherwise, with the PRNG key threaded through the scan carry.
+
+The reference framework serves models but has no generation engine of its
+own (Ray 0.9 predates LLM serving); this module is what `ray_tpu.serve`
+backends call for text generation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (
+    Params, TransformerConfig, _mlp, _rms_norm, _rope,
+)
+
+KVCache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    shape = (L, batch, max_len, KH, Dh)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _gqa_attend(q, buf_k, buf_v, mask):
+    """q [B, T, H, Dh] against cache buffers [B, S, KH, Dh];
+    mask [T, S] True where attendable."""
+    B, T, H, Dh = q.shape
+    KH = buf_k.shape[2]
+    G = H // KH
+    qg = q.reshape(B, T, KH, G, Dh)
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg, buf_k) / jnp.sqrt(Dh)
+    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(q.dtype), buf_v)
+    return out.reshape(B, T, H, Dh)
+
+
+def _cached_block(x, layer, ck, cv, positions, mask, cfg: TransformerConfig):
+    """One decoder block over cached KV. x [B, T, E]; ck/cv [B, S, KH, Dh]
+    already containing this chunk's keys/values at `positions`."""
+    B, T, _ = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = _rope((h @ layer["wq"].astype(dt)).reshape(B, T, H, Dh),
+              positions, cfg.rope_theta)
+    attn = _gqa_attend(q, ck, cv, mask).reshape(B, T, H * Dh)
+    h = x + attn @ layer["wo"].astype(dt)
+    return h + _mlp(_rms_norm(h, layer["mlp_norm"], cfg.norm_eps), layer, cfg)
+
+
+def _write_and_attend(x, layer, ck, cv, start, positions, mask,
+                      cfg: TransformerConfig):
+    """Project this chunk's K/V, write them into the layer cache at `start`,
+    then run the block. Returns (x_out, ck, cv)."""
+    B, T, _ = x.shape
+    KH, Dh = cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    k = _rope((h @ layer["wk"].astype(dt)).reshape(B, T, KH, Dh),
+              positions, cfg.rope_theta)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, T, KH, Dh)
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+    return _cached_block(x, layer, ck, cv, positions, mask, cfg), ck, cv
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt [B, T0] through the model, filling cache[0:T0].
+    Returns (last-position logits [B, V], cache with length=T0)."""
+    B, T0 = tokens.shape
+    S = cache["k"].shape[2]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(T0)
+    mask = (jnp.arange(S)[None, :] <= positions[:, None])  # causal into cache
+
+    def block(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = _write_and_attend(
+            x, layer, ck, cv, 0, positions, mask, cfg)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x[:, -1] @ params["embed"].astype(cfg.dtype).T
+    return logits, {"k": new_k, "v": new_v,
+                    "length": jnp.asarray(T0, jnp.int32)}
+
+
+def decode_step(params: Params, token: jax.Array, cfg: TransformerConfig,
+                cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """One token [B] -> next-token logits [B, V]; cache advances by one."""
+    B = token.shape[0]
+    S = cache["k"].shape[2]
+    pos = cache["length"]
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, E]
+    positions = jnp.full((1,), pos, jnp.int32)
+    mask = (jnp.arange(S)[None, :] <= pos)                     # [1, S]
+
+    def block(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = _write_and_attend(
+            x, layer, ck, cv, pos, positions, mask, cfg)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x[:, -1] @ params["embed"].astype(cfg.dtype).T
+    return logits, {"k": new_k, "v": new_v, "length": pos + 1}
+
+
+def _pick(logits, temperature: float, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
+             max_new_tokens: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, T0] int32 -> generated tokens [B, max_new_tokens].
+
+    One jitted program: prefill + a lax.scan of decode steps. Compiles once
+    per (B, T0, max_new_tokens) shape; the cache buffer is sized exactly
+    T0 + max_new_tokens.
+    """
+    B, T0 = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, T0 + max_new_tokens)
+    logits, cache = prefill(params, prompt, cfg, cache)
+    key, sub = jax.random.split(key)
+    first = _pick(logits, temperature, sub)
+
+    def step(carry, _):
+        token, cache, key = carry
+        logits, cache = decode_step(params, token, cfg, cache)
+        key, sub = jax.random.split(key)
+        nxt = _pick(logits, temperature, sub)
+        return (nxt, cache, key), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, cache, key), None, length=max_new_tokens)
+    return jnp.swapaxes(tokens, 0, 1)                          # [B, N]

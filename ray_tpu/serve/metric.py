@@ -31,13 +31,17 @@ class LatencyWindow:
             self.errors += 1
 
     def snapshot(self) -> Dict[str, float]:
+        import math
+
         xs: List[float] = sorted(self.samples)
         n = len(xs)
 
         def pct(p: float) -> float:
+            # Nearest-rank: ceil(p*n)-1, NOT int(p*n) — the latter is one
+            # rank high (p99 of 100 samples would report the max).
             if not n:
                 return 0.0
-            return xs[min(n - 1, int(p * n))]
+            return xs[max(0, min(n - 1, math.ceil(p * n) - 1))]
 
         elapsed = max(time.time() - self.started, 1e-9)
         return {
@@ -91,11 +95,17 @@ class PrometheusExporter(ExporterInterface):
     """Renders the Prometheus text exposition format — no client library,
     the format is just lines (reference PrometheusExporter)."""
 
+    @staticmethod
+    def _escape(value: str) -> str:
+        """Prometheus label-value escaping: backslash, quote, newline."""
+        return (value.replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def export(self, snapshot: Dict[str, Any]) -> str:
         lines: List[str] = []
 
         def emit(scope: str, name: str, stats: Dict[str, float]) -> None:
-            label = f'{{{scope}="{name}"}}'
+            label = f'{{{scope}="{self._escape(name)}"}}'
             for key, val in stats.items():
                 metric = f"ray_serve_{scope}_{key}"
                 lines.append(f"{metric}{label} {val}")
