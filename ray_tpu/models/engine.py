@@ -3,31 +3,33 @@ jitted decode program.
 
 The TPU constraint shapes the design: no dynamic shapes, so the engine owns
 a FIXED pool of batch slots over preallocated caches [L, slots, S, KH, Dh].
-Requests claim a free slot (prefill writes that slot's cache region),
-every `step()` decodes ALL slots in one batched jitted call with per-slot
-positions and masks (idle slots compute garbage that is ignored — lockstep
-compute is cheaper than ragged dispatch on the MXU), and finished slots are
-immediately reusable by queued requests — continuous batching, not
-wait-for-the-whole-batch.
+Requests claim a free slot (prefill writes that slot's cache region in
+place), every `step()` decodes ALL slots in one batched jitted call with
+per-slot positions and masks (idle slots compute garbage that is ignored —
+lockstep compute is cheaper than ragged dispatch on the MXU), and finished
+slots are immediately reusable by queued requests — continuous batching,
+not wait-for-the-whole-batch.
 
-Compiled programs: one batched decode step (cache buffers donated — XLA
-aliases them in place instead of copying the pool every token) + one
-jitted prefill per DISTINCT prompt length (cache buffers are always
-full-size, so only the token shape varies). Nothing retraces as requests
-come and go. Reference framework counterpart: none (Ray 0.9 predates LLM
-serving); this is the engine a `ray_tpu.serve` LM backend wraps.
+Compiled programs: one batched decode step + one prefill per power-of-2
+prompt-length BUCKET (prompts right-pad to the bucket; the pad region's
+cache rows are garbage that decode overwrites before it is ever attended,
+and the first-token logits are read at the real last position). Both
+donate the cache pools, so XLA aliases them in place — no pool-sized copy
+per token or per admission. Nothing retraces as requests come and go.
+Reference framework counterpart: none (Ray 0.9 predates LLM serving); this
+is the engine a `ray_tpu.serve` LM backend (`serve/lm.py`) wraps.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import init_cache, prefill
+from .generate import _gqa_attend
 from .transformer import Params, TransformerConfig, _mlp, _rms_norm, _rope
 
 
@@ -51,10 +53,9 @@ def _batched_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
     B = tokens.shape[0]
     S = cache_k.shape[2]
     H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    G = H // KH
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens][:, None, :]          # [B, 1, E]
-    mask = jnp.arange(S)[None, :] <= lengths[:, None]           # [B, S]
+    mask = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, :]  # [B,1,S]
 
     def write_slot(buf, kv, pos):
         # buf [S, KH, Dh], kv [1, KH, Dh]
@@ -70,11 +71,7 @@ def _batched_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
         v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KH, Dh)
         ck = jax.vmap(write_slot)(ck, k, lengths)
         cv = jax.vmap(write_slot)(cv, v, lengths)
-        qg = q.reshape(B, KH, G, Dh)
-        scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck) / jnp.sqrt(Dh)
-        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(dt)
-        attn = jnp.einsum("bkgs,bskd->bkgd", probs, cv).reshape(B, 1, H * Dh)
+        attn = _gqa_attend(q, ck, cv, mask).reshape(B, 1, H * Dh)
         h2 = x + attn @ layer["wo"].astype(dt)
         out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
                         layer, cfg)
@@ -87,24 +84,67 @@ def _batched_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
     return logits, new_k, new_v
 
 
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache_k", "cache_v"))
+def _prefill_into_slot(params: Params, tokens: jax.Array,
+                       real_len: jax.Array, slot: jax.Array,
+                       cache_k: jax.Array, cache_v: jax.Array,
+                       cfg: TransformerConfig):
+    """Prompt [1, Tb] (right-padded to a power-of-2 bucket) -> logits [V]
+    at position real_len-1, with the slot's cache rows [0:Tb) written in
+    place (donated pools). Pad rows hold garbage K/V beyond real_len —
+    safe: prompt positions only attend causally at <= their own index, and
+    decode overwrites row `length` before each attend reaches it.
+    Compiles once per bucket length Tb."""
+    _, Tb = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]                       # [1, Tb, E]
+    positions = jnp.arange(Tb)
+    causal = positions[None, :] <= positions[:, None]            # [Tb, Tb]
+
+    def block(x, xs):
+        layer, ck, cv = xs                              # ck [slots, S, KH, Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope((h @ layer["wq"].astype(dt)).reshape(1, Tb, H, Dh),
+                  positions, cfg.rope_theta)
+        k = _rope((h @ layer["wk"].astype(dt)).reshape(1, Tb, KH, Dh),
+                  positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(1, Tb, KH, Dh)
+        ck = jax.lax.dynamic_update_slice(ck, k, (slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (slot, 0, 0, 0))
+        attn = _gqa_attend(q, k, v, causal).reshape(1, Tb, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache_k, cache_v))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], real_len - 1, axis=0,
+                                        keepdims=False)          # [E]
+    logits = last @ params["embed"].astype(dt).T                 # [V]
+    return logits, new_k, new_v
+
+
 class _Request:
-    __slots__ = ("req_id", "prompt", "max_new_tokens", "out", "slot")
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "out")
 
     def __init__(self, req_id: int, prompt: List[int], max_new_tokens: int):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.out: List[int] = []
-        self.slot: Optional[int] = None
 
 
 class GenerationEngine:
     """Greedy continuous-batching decode over a fixed slot pool.
 
     ``submit()`` queues a request; ``step()`` admits queued requests into
-    free slots (bucketed prefill) and advances every active slot by one
-    token; ``run_until_done()`` drains everything. Results are exact: each
-    request's output equals single-request `generate()` on the same model.
+    free slots (bucketed in-place prefill) and advances every active slot
+    by one token; ``run_until_done()`` drains everything. Results are
+    exact: each request's output equals single-request `generate()`.
     """
 
     def __init__(self, params: Params, cfg: TransformerConfig, *,
@@ -125,12 +165,12 @@ class GenerationEngine:
         self.queue: List[_Request] = []
         self.done: Dict[int, List[int]] = {}
         self._next_id = 0
-        # One compiled prefill per distinct prompt length (cfg static).
-        self._prefill = jax.jit(prefill, static_argnames=("cfg",))
 
     # ---- public API ----
 
-    def submit(self, prompt: List[int], max_new_tokens: int) -> int:
+    def validate(self, prompt: List[int], max_new_tokens: int) -> None:
+        """Raise ValueError if this request can never be served — callers
+        submitting several requests atomically validate ALL first."""
         if not prompt:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -139,6 +179,9 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds max_seq {self.max_seq}")
+
+    def submit(self, prompt: List[int], max_new_tokens: int) -> int:
+        self.validate(prompt, max_new_tokens)
         req = _Request(self._next_id, prompt, max_new_tokens)
         self._next_id += 1
         self.queue.append(req)
@@ -189,7 +232,6 @@ class GenerationEngine:
         for slot in range(self.slots):
             while self.queue and self.active[slot] is None:
                 req = self.queue.pop(0)
-                req.slot = slot
                 done = self._prefill_slot(slot, req)
                 events.append((req.req_id, req.out[0], done))
                 if not done:
@@ -197,27 +239,25 @@ class GenerationEngine:
         return events
 
     def _prefill_slot(self, slot: int, req: _Request) -> bool:
-        """Run the prompt through the model into this slot's cache region;
-        the first generated token comes from the prefill logits. Prompts
-        compile one prefill program per distinct length (cache buffers are
-        always full-size, so only the token shape varies). Returns True if
-        the request finished at prefill (max_new_tokens == 1 or EOS)."""
-        prompt = jnp.asarray(req.prompt, jnp.int32)[None]       # [1, T0]
-        cache = init_cache(self.cfg, 1, self.max_seq)
-        logits, cache = self._prefill(self.params, prompt, cfg=self.cfg,
-                                      cache=cache)
-        first = int(np.asarray(jnp.argmax(logits, -1))[0])
-        # Copy the slot-sized cache into the pool at `slot`.
-        self.cache_k = self.cache_k.at[:, slot].set(cache["k"][:, 0])
-        self.cache_v = self.cache_v.at[:, slot].set(cache["v"][:, 0])
+        """Bucketed in-place prefill of this slot's cache region; the first
+        generated token comes from the real-last-position logits. Returns
+        True if the request finished at prefill (one token or EOS)."""
+        T0 = len(req.prompt)
+        bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
+        padded = req.prompt + [0] * (bucket - T0)
+        tokens = jnp.asarray(padded, jnp.int32)[None]           # [1, Tb]
+        logits, self.cache_k, self.cache_v = _prefill_into_slot(
+            self.params, tokens, jnp.asarray(T0, jnp.int32),
+            jnp.asarray(slot, jnp.int32), self.cache_k, self.cache_v,
+            self.cfg)
+        first = int(np.asarray(jnp.argmax(logits, -1)))
         req.out.append(first)
         # Next decode for this slot attends from `first` at position T0.
-        self.lengths[slot] = len(req.prompt)
+        self.lengths[slot] = T0
         self.tokens[slot] = first
         if (len(req.out) >= req.max_new_tokens
                 or (self.eos_id is not None and first == self.eos_id)):
             self.done[req.req_id] = req.out
             self.lengths[slot] = 0
-            req.slot = None
             return True
         return False

@@ -39,13 +39,17 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
 
 def _gqa_attend(q, buf_k, buf_v, mask):
     """q [B, T, H, Dh] against cache buffers [B, S, KH, Dh];
-    mask [T, S] True where attendable."""
+    mask [T, S] (shared across batch) or [B, T, S] (per-slot), True where
+    attendable. The single copy of the decode-attention math — the
+    continuous-batching engine reuses it with per-slot masks."""
     B, T, H, Dh = q.shape
     KH = buf_k.shape[2]
     G = H // KH
+    if mask.ndim == 2:
+        mask = mask[None]
     qg = q.reshape(B, T, KH, G, Dh)
     scores = jnp.einsum("btkgd,bskd->btkgs", qg, buf_k) / jnp.sqrt(Dh)
-    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(q.dtype), buf_v)
     return out.reshape(B, T, H, Dh)

@@ -28,6 +28,7 @@ from .handle import ServeHandle  # noqa: F401
 from .metric import (  # noqa: F401
     ExporterInterface, InMemoryExporter, PrometheusExporter,
 )
+from .lm import LMBackend  # noqa: F401
 
 __all__ = [
     "init",
@@ -49,4 +50,5 @@ __all__ = [
     "ExporterInterface",
     "InMemoryExporter",
     "PrometheusExporter",
+    "LMBackend",
 ]

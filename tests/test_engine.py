@@ -74,3 +74,29 @@ def test_single_token_request_finishes_at_prefill():
     results = eng.run_until_done()
     assert results[rid] == _ref(params, cfg, [3, 4, 5], 1)
     assert all(r is None for r in eng.active)
+
+
+def test_lm_backend_cross_batches_behind_serve(local_ray):
+    """Concurrent serve calls share engine decode steps via router batching
+    and every caller still gets its exact greedy continuation."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    serve.init()
+    try:
+        serve.create_backend(
+            "lm:v1", LMBackend, params, cfg,
+            config=BackendConfig(max_batch_size=4, batch_wait_timeout_s=0.05,
+                                 max_concurrent_queries=8))
+        serve.create_endpoint("gen", backend="lm:v1")
+        h = serve.get_handle("gen")
+        prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+        refs = [h.remote(p, max_new_tokens=4) for p in prompts]
+        outs = ray_tpu.get(refs, timeout=300)
+        for p, out in zip(prompts, outs):
+            assert out == _ref(params, cfg, p, 4), (p, out)
+    finally:
+        serve.shutdown()
