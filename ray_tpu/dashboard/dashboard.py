@@ -101,6 +101,23 @@ def _collect(endpoint: str):
         from ..metrics import collect_all
 
         return collect_all()
+    if endpoint == "serve":
+        # Live serve stats when a control plane exists in this cluster
+        # (reference: the dashboard's serve tab); {} otherwise. Queries
+        # through a LOCAL handle — writing serve.api._master from here
+        # would cache a handle this process never invalidates (a dead one
+        # would poison serve.init() in this process forever).
+        try:
+            import ray_tpu
+            from ..serve.master import MASTER_NAME
+
+            master = ray_tpu.get_actor(MASTER_NAME)
+            base = ray_tpu.get(master.stat.remote())
+            router = ray_tpu.get(master.get_router.remote())[0]
+            snapshot = ray_tpu.get(router.metric_snapshot.remote())
+            return {**base, "metrics": snapshot}
+        except Exception:  # noqa: BLE001 - no serve instance running
+            return {}
     raise KeyError(endpoint)
 
 

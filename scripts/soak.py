@@ -137,6 +137,51 @@ def node_failures(duration_s: float) -> int:
                  setup=setup, teardown=teardown)
 
 
+_DRIVER_SCRIPT = """
+import sys
+import ray_tpu
+ray_tpu.init(address=sys.argv[1])
+@ray_tpu.remote
+def sq(x):
+    return x * x
+out = ray_tpu.get([sq.remote(i) for i in range(20)], timeout=60)
+assert out == [i * i for i in range(20)], out
+ray_tpu.shutdown()
+"""
+
+
+def many_drivers(duration_s: float) -> int:
+    """Short-lived driver processes connect, run work, disconnect — over
+    and over against one cluster (reference workloads/many_drivers.py).
+    Exercises per-driver state cleanup: leaked refs/exports from dead
+    drivers would eventually wedge the GCS."""
+    import subprocess
+    import sys as _sys
+
+    from ray_tpu.cluster.testing import Cluster, _subprocess_env
+
+    def setup():
+        return {"cluster": Cluster(head_resources={"CPU": 2},
+                                   num_workers=2)}
+
+    def body(state, i):
+        proc = subprocess.run(
+            [_sys.executable, "-c", _DRIVER_SCRIPT,
+             state["cluster"].address],
+            env=_subprocess_env(), capture_output=True, text=True,
+            timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"driver {i} failed rc={proc.returncode}:\n"
+                f"{proc.stderr[-2000:]}")
+
+    def teardown(state):
+        state["cluster"].shutdown()
+
+    return _loop("many_drivers", duration_s, body,
+                 setup=setup, teardown=teardown)
+
+
 def serve_failure(duration_s: float) -> int:
     """Random replica/master kills under steady query load
     (reference workloads/serve_failure.py)."""
@@ -207,11 +252,15 @@ def pbt(duration_s: float) -> int:
 
 WORKLOADS = {
     "many_tasks": many_tasks,
+    "many_drivers": many_drivers,
     "actor_deaths": actor_deaths,
     "node_failures": node_failures,
     "serve_failure": serve_failure,
     "pbt": pbt,
 }
+# Workloads that own their cluster; a leftover local-mode runtime would
+# make their cluster connect a silent no-op.
+_STANDALONE = {"node_failures", "many_drivers"}
 
 
 def main(argv=None):
@@ -228,11 +277,7 @@ def main(argv=None):
     import ray_tpu
     results = {}
     for name in names:
-        # node_failures manages its own cluster; others run local mode.
-        # A leftover local-mode runtime would make the cluster connect a
-        # silent no-op (ignore_reinit), so tear it down first.
-        standalone = name == "node_failures"
-        if standalone:
+        if name in _STANDALONE:
             if ray_tpu.is_initialized():
                 ray_tpu.shutdown()
         elif not ray_tpu.is_initialized():

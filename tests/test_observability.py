@@ -160,3 +160,33 @@ def test_cluster_node_reporter_feeds_dashboard():
         except Exception:  # noqa: BLE001
             pass
         cluster.shutdown()
+
+
+def test_dashboard_serve_endpoint(local_ray):
+    """/api/serve surfaces live serve routing + latency metrics when a
+    control plane is up, and {} when none exists."""
+    import urllib.request as _rq
+
+    from ray_tpu import serve
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard()
+    try:
+        def get(path):
+            with _rq.urlopen(f"{dash.url}{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        assert get("/api/serve") == {}  # no serve instance yet
+
+        serve.init()
+        try:
+            serve.create_backend("dash:v1", lambda x=None: x)
+            serve.create_endpoint("dash", backend="dash:v1")
+            h = serve.get_handle("dash")
+            ray_tpu.get([h.remote(i) for i in range(5)])
+            s = get("/api/serve")
+            assert s["metrics"]["endpoints"]["dash"]["count"] == 5
+        finally:
+            serve.shutdown()
+    finally:
+        dash.stop()

@@ -22,3 +22,9 @@ def test_soak_local_workloads(local_ray):
 def test_soak_node_failures():
     # Manages its own Cluster + driver connection.
     assert soak.node_failures(10.0) >= 3
+
+
+@pytest.mark.cluster
+def test_soak_many_drivers():
+    # Manages its own Cluster; drivers are subprocesses.
+    assert soak.many_drivers(10.0) >= 3
