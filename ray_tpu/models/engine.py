@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import _gqa_attend
+from ..ops.attention import decode_attention, masked_gqa_attention
 from .transformer import Params, TransformerConfig, _mlp, _rms_norm, _rope
 
 
@@ -51,11 +51,9 @@ def _batched_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
     ignore logits of inactive slots.
     """
     B = tokens.shape[0]
-    S = cache_k.shape[2]
     H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens][:, None, :]          # [B, 1, E]
-    mask = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, :]  # [B,1,S]
 
     def write_slot(buf, kv, pos):
         # buf [S, KH, Dh], kv [1, KH, Dh]
@@ -71,7 +69,10 @@ def _batched_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
         v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KH, Dh)
         ck = jax.vmap(write_slot)(ck, k, lengths)
         cv = jax.vmap(write_slot)(cv, v, lengths)
-        attn = _gqa_attend(q, ck, cv, mask).reshape(B, 1, H * Dh)
+        # Pallas flash-decode on TPU (per-slot length masks in-kernel;
+        # compute skipped past each length); XLA reference elsewhere.
+        attn = decode_attention(q[:, 0], ck, cv, lengths).reshape(
+            B, 1, H * Dh)
         h2 = x + attn @ layer["wo"].astype(dt)
         out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
                         layer, cfg)
@@ -113,7 +114,7 @@ def _prefill_into_slot(params: Params, tokens: jax.Array,
         v = (h @ layer["wv"].astype(dt)).reshape(1, Tb, KH, Dh)
         ck = jax.lax.dynamic_update_slice(ck, k, (slot, 0, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (slot, 0, 0, 0))
-        attn = _gqa_attend(q, k, v, causal).reshape(1, Tb, H * Dh)
+        attn = masked_gqa_attention(q, k, v, causal).reshape(1, Tb, H * Dh)
         h2 = x + attn @ layer["wo"].astype(dt)
         out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
                         layer, cfg)

@@ -20,6 +20,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.attention import masked_gqa_attention
 from .transformer import (
     Params, TransformerConfig, _mlp, _rms_norm, _rope,
 )
@@ -37,24 +38,6 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
     }
 
 
-def _gqa_attend(q, buf_k, buf_v, mask):
-    """q [B, T, H, Dh] against cache buffers [B, S, KH, Dh];
-    mask [T, S] (shared across batch) or [B, T, S] (per-slot), True where
-    attendable. The single copy of the decode-attention math — the
-    continuous-batching engine reuses it with per-slot masks."""
-    B, T, H, Dh = q.shape
-    KH = buf_k.shape[2]
-    G = H // KH
-    if mask.ndim == 2:
-        mask = mask[None]
-    qg = q.reshape(B, T, KH, G, Dh)
-    scores = jnp.einsum("btkgd,bskd->btkgs", qg, buf_k) / jnp.sqrt(Dh)
-    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(q.dtype), buf_v)
-    return out.reshape(B, T, H, Dh)
-
-
 def _cached_block(x, layer, ck, cv, positions, mask, cfg: TransformerConfig):
     """One decoder block over cached KV. x [B, T, E]; ck/cv [B, S, KH, Dh]
     already containing this chunk's keys/values at `positions`."""
@@ -64,7 +47,7 @@ def _cached_block(x, layer, ck, cv, positions, mask, cfg: TransformerConfig):
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = _rope((h @ layer["wq"].astype(dt)).reshape(B, T, H, Dh),
               positions, cfg.rope_theta)
-    attn = _gqa_attend(q, ck, cv, mask).reshape(B, T, H * Dh)
+    attn = masked_gqa_attention(q, ck, cv, mask).reshape(B, T, H * Dh)
     h = x + attn @ layer["wo"].astype(dt)
     return h + _mlp(_rms_norm(h, layer["mlp_norm"], cfg.norm_eps), layer, cfg)
 

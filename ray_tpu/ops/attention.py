@@ -444,3 +444,142 @@ def flash_attention(
     if on_tpu and tiles:
         return _flash(q, k, v, causal, block_q, block_k)
     return attention_reference(q, k, v, causal=causal)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention (single query position per sequence, KV cache + lengths)
+# ---------------------------------------------------------------------------
+
+
+def masked_gqa_attention(q, buf_k, buf_v, mask):
+    """q [B, T, H, Dh] against cache buffers [B, S, KH, Dh]; mask [T, S]
+    (shared) or [B, T, S] (per-sequence), True where attendable. The
+    canonical XLA decode/cached-attention math — generate/engine delegate
+    here so there is exactly one copy."""
+    B, T, H, Dh = q.shape
+    KH = buf_k.shape[2]
+    G = H // KH
+    if mask.ndim == 2:
+        mask = mask[None]
+    qg = q.reshape(B, T, KH, G, Dh)
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg, buf_k) / jnp.sqrt(Dh)
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("btkgs,bskd->btkgd", probs.astype(q.dtype), buf_v)
+    return out.reshape(B, T, H, Dh)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, scale: float, block_k: int):
+    """One (batch*kv_head, k_block) grid step. The G query heads sharing one
+    KV head ride the sublane axis (rows), so the per-block matmul is
+    [G, D] @ [D, block_k] — MXU work even though T == 1. KV axis is the last
+    grid dim: sequential sweep with online-softmax state in VMEM scratch.
+    Compute for blocks entirely beyond the sequence's length is skipped;
+    note the block DMA still runs for the full sweep — truncating the HBM
+    traffic itself would need a scalar-prefetch grid with a length-dependent
+    extent (future work)."""
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[0, 0]                      # inclusive attend bound
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki * block_k <= length)
+    def _compute():
+        q = q_ref[0]                            # [G, D]
+        k = k_ref[0, :, 0, :]                   # [block_k, D]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [G, block_k]
+        G = s.shape[0]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (G, block_k), 1)
+        s = jnp.where(k_pos <= length, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _flash_decode(q, k, v, lengths, block_k: int):
+    """q [B, H, D], k/v [B, S, KH, D], lengths [B] -> out [B, H, D]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    G = H // KH
+    scale = D ** -0.5
+    # Pack group heads as rows: head h = kh * G + g (matches _repeat_kv).
+    # K/V keep their native [B, S, KH, D] layout — blocks are sliced per
+    # (batch, kv-head) by the index map, so the cache pool is never
+    # transposed/copied (it is the large buffer here).
+    qf = q.reshape(B * KH, G, D)
+    lens = lengths.astype(jnp.int32).reshape(B, 1)
+    grid = (B * KH, S // block_k)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda r, ki, kh=KH: (r // kh, 0)),
+            pl.BlockSpec((1, G, D), lambda r, ki: (r, 0, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda r, ki, kh=KH: (r // kh, ki, r % kh, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda r, ki, kh=KH: (r // kh, ki, r % kh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda r, ki: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * KH, G, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(lens, qf, k, v)
+    return out.reshape(B, H, D)
+
+
+def decode_attention(q, k, v, lengths, *, block_k: int = 512):
+    """Single-position cached attention with per-sequence lengths
+    (attends to cache rows 0..lengths[b] inclusive).
+
+    q [B, H, D]; k/v [B, S, KH, D]; lengths [B] int32 -> [B, H, D].
+    Pallas flash-decode kernel on TPU when shapes tile (group heads ride
+    the MXU sublanes; compute for KV blocks beyond the length is skipped,
+    the DMA sweep is not); XLA reference otherwise — identical math.
+    """
+    B, H, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    bk = min(block_k, S)
+    G = H // max(KH, 1)
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    # G rides the sublane axis: require full 8-row tiles (same rule as
+    # flash_attention's block_q % 8) — small-group GQA/MHA configs take
+    # the XLA path rather than risk an untileable (1, G, D) block.
+    tiles = (S % bk == 0 and D % 128 == 0 and bk % 128 == 0
+             and H % KH == 0 and G % 8 == 0)
+    if on_tpu and tiles:
+        return _flash_decode(q, k, v, lengths, bk)
+    mask = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, :]
+    return masked_gqa_attention(q[:, None], k, v, mask)[:, 0]
