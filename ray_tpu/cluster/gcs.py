@@ -120,12 +120,25 @@ class GcsServer:
         run inline: messages on a connection are processed sequentially, so a
         blocking handler would starve heartbeats queued behind it and falsely
         kill the node.
+
+        Completion wall time is recorded into handler_stats under a
+        ``bg:<type>`` key — without this, the heaviest RPCs would show ~0s
+        in debug_stats (the inline dispatch only spawns the task).
         """
+        import time as _time
+
+        label = f"bg:{msg.get('type')}"
+        t_start = _time.monotonic()
+
         async def work():
             try:
                 resp = await coro
             except Exception as e:  # noqa: BLE001
                 resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            finally:
+                cell = self.server.handler_stats.setdefault(label, [0, 0.0])
+                cell[0] += 1
+                cell[1] += _time.monotonic() - t_start
             if resp is not None and "rpc_id" in msg:
                 resp.setdefault("ok", True)
                 resp["rpc_id"] = msg["rpc_id"]
@@ -892,6 +905,17 @@ class GcsServer:
         @s.handler("ping")
         async def ping(msg, conn):
             return {"ok": True}
+
+        @s.handler("debug_stats")
+        async def debug_stats(msg, conn):
+            """Per-RPC-type count + cumulative event-loop seconds (the
+            cProfile-free view of where GCS cycles go; `cli status -v` /
+            dashboards read this)."""
+            return {"ok": True, "handlers": {
+                k: {"count": c, "total_s": round(t, 4)}
+                for k, (c, t) in sorted(
+                    s.handler_stats.items(),
+                    key=lambda kv: -kv[1][1])}}
 
         @s.handler("submit_batch")
         async def submit_batch(msg, conn):

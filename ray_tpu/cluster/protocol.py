@@ -67,6 +67,9 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._on_disconnect: Optional[Callable] = None
         self._conns: set = set()
+        # Per-message-type {count, cumulative seconds}: the cProfile-free
+        # answer to "where do this service's event-loop cycles go".
+        self.handler_stats: Dict[str, list] = {}
 
     def handler(self, msg_type: str):
         def deco(fn):
@@ -96,10 +99,12 @@ class RpcServer:
                 msg = await read_message(reader)
                 if msg is None:
                     break
-                handler = self._handlers.get(msg.get("type"))
+                mtype = msg.get("type")
+                handler = self._handlers.get(mtype)
                 if handler is None:
-                    resp = {"ok": False, "error": f"unknown type {msg.get('type')}"}
+                    resp = {"ok": False, "error": f"unknown type {mtype}"}
                 else:
+                    t0 = time.monotonic()
                     try:
                         resp = await handler(msg, conn)
                     except Exception as e:  # noqa: BLE001 - reported to caller
@@ -107,6 +112,12 @@ class RpcServer:
                         resp = {"ok": False,
                                 "error": f"{type(e).__name__}: {e}",
                                 "traceback": traceback.format_exc()}
+                    finally:
+                        cell = self.handler_stats.get(mtype)
+                        if cell is None:
+                            cell = self.handler_stats[mtype] = [0, 0.0]
+                        cell[0] += 1
+                        cell[1] += time.monotonic() - t0
                 if "rpc_id" in msg and resp is not None:
                     resp["rpc_id"] = msg["rpc_id"]
                     await conn.send(resp)

@@ -279,3 +279,22 @@ def test_small_ref_args_are_inlined():
         except Exception:  # noqa: BLE001
             pass
         cluster.shutdown()
+
+
+def test_gcs_debug_stats(driver):
+    """debug_stats: per-RPC-type counts + cumulative handler seconds."""
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    assert ray_tpu.get([one.remote() for _ in range(20)], timeout=60) == [1] * 20
+    from ray_tpu._private.worker import global_worker
+
+    stats = global_worker().core.gcs.call({"type": "debug_stats"})
+    handlers = stats["handlers"]
+    assert handlers["submit_batch"]["count"] >= 1
+    assert handlers["submit_batch"]["total_s"] >= 0
+    # the busiest handlers are sorted first
+    totals = [v["total_s"] for v in handlers.values()]
+    assert totals == sorted(totals, reverse=True)

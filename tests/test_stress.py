@@ -84,10 +84,23 @@ class TestLocalStress:
 
 @pytest.fixture(scope="module")
 def stress_cluster():
+    import os
+
+    # On this 1-core host a concurrently-loaded full-suite run can
+    # deschedule a node process for many seconds; the default 3 s death
+    # threshold (100 ms x 30, reference defaults) then produces FALSE node
+    # deaths mid-test. Stress tests are about load, not failure detection,
+    # so give the detector starvation margin.
+    old = os.environ.get("RAY_TPU_NUM_HEARTBEATS_TIMEOUT")
+    os.environ["RAY_TPU_NUM_HEARTBEATS_TIMEOUT"] = "300"  # 30 s
     c = Cluster(head_resources={"CPU": 2}, num_workers=2)
     c.add_node(resources={"CPU": 2}, num_workers=2)  # a real second node
     yield c
     c.shutdown()
+    if old is None:
+        os.environ.pop("RAY_TPU_NUM_HEARTBEATS_TIMEOUT", None)
+    else:
+        os.environ["RAY_TPU_NUM_HEARTBEATS_TIMEOUT"] = old
 
 
 @pytest.fixture()
@@ -107,7 +120,7 @@ class TestClusterStress:
             return i
 
         refs = [noop.remote(i) for i in range(2_000)]
-        out = ray_tpu.get(refs, timeout=180)
+        out = ray_tpu.get(refs, timeout=300)
         assert out == list(range(2_000))
 
     def test_cluster_wide_chain(self, stress_driver):
@@ -125,7 +138,7 @@ class TestClusterStress:
                 merge.remote(layer[i], layer[(i + width // 2) % width])
                 for i in range(width)
             ]
-        out = ray_tpu.get(layer, timeout=180)
+        out = ray_tpu.get(layer, timeout=300)
         assert len(out) == width
 
     def test_dead_actors_churn(self, stress_driver):
