@@ -130,22 +130,43 @@ def _prefill_into_slot(params: Params, tokens: jax.Array,
 
 
 class _Request:
-    __slots__ = ("req_id", "prompt", "max_new_tokens", "out")
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "out", "temperature",
+                 "rng")
 
-    def __init__(self, req_id: int, prompt: List[int], max_new_tokens: int):
+    def __init__(self, req_id: int, prompt: List[int], max_new_tokens: int,
+                 temperature: float = 0.0, seed: Optional[int] = None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.out: List[int] = []
+        self.temperature = float(temperature)
+        # Per-request stream: an explicit seed -> same sampled continuation
+        # regardless of batch composition; no seed -> fresh OS entropy
+        # (req_id would repeat identically across engine restarts).
+        self.rng = np.random.default_rng(seed)
+
+    def pick(self, logits_row: np.ndarray) -> int:
+        """Greedy at temperature 0; softmax-sample otherwise (host-side,
+        per-request PRNG — the jitted decode stays sampling-free)."""
+        if self.temperature == 0.0:
+            return int(np.argmax(logits_row))
+        z = logits_row.astype(np.float64) / self.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self.rng.choice(len(p), p=p))
 
 
 class GenerationEngine:
-    """Greedy continuous-batching decode over a fixed slot pool.
+    """Continuous-batching decode over a fixed slot pool.
 
     ``submit()`` queues a request; ``step()`` admits queued requests into
     free slots (bucketed in-place prefill) and advances every active slot
-    by one token; ``run_until_done()`` drains everything. Results are
-    exact: each request's output equals single-request `generate()`.
+    by one token; ``run_until_done()`` drains everything. At the default
+    temperature 0 results are exact — each request's output equals
+    single-request `generate()`; sampled requests (temperature > 0) are
+    seed-reproducible but draw from a host-side per-request PRNG, not
+    generate()'s jax stream.
     """
 
     def __init__(self, params: Params, cfg: TransformerConfig, *,
@@ -169,9 +190,13 @@ class GenerationEngine:
 
     # ---- public API ----
 
-    def validate(self, prompt: List[int], max_new_tokens: int) -> None:
+    def validate(self, prompt: List[int], max_new_tokens: int,
+                 temperature: float = 0.0, seed=None) -> None:
         """Raise ValueError if this request can never be served — callers
-        submitting several requests atomically validate ALL first."""
+        submitting several requests atomically validate ALL first (submit
+        raising mid-batch would orphan the already-queued batch-mates)."""
+        import math
+
         if not prompt:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -180,10 +205,21 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds max_seq {self.max_seq}")
+        t = float(temperature)
+        if not (math.isfinite(t) and t >= 0):
+            raise ValueError(f"temperature must be finite and >= 0, got {t}")
+        if seed is not None and not isinstance(seed, (int, np.integer)):
+            raise ValueError(f"seed must be an int, got {type(seed).__name__}")
 
-    def submit(self, prompt: List[int], max_new_tokens: int) -> int:
-        self.validate(prompt, max_new_tokens)
-        req = _Request(self._next_id, prompt, max_new_tokens)
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               temperature: float = 0.0, seed: Optional[int] = None) -> int:
+        """temperature 0 = greedy (bit-exact vs generate()); > 0 samples
+        host-side from the same logits with a per-request PRNG (same seed
+        -> same continuation; not bit-matched to generate()'s jax-PRNG
+        stream)."""
+        self.validate(prompt, max_new_tokens, temperature, seed)
+        req = _Request(self._next_id, prompt, max_new_tokens,
+                       temperature=temperature, seed=seed)
         self._next_id += 1
         self.queue.append(req)
         return req.req_id
@@ -199,11 +235,18 @@ class GenerationEngine:
         logits, self.cache_k, self.cache_v = _batched_decode(
             self.params, jnp.asarray(self.tokens),
             jnp.asarray(self.lengths), self.cache_k, self.cache_v, self.cfg)
-        nxt = np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        # Hot path stays device-side for the (default) all-greedy case:
+        # transfer [B] int32 argmaxes, not the [B, V] logits matrix.
+        sampling = any(r is not None and r.temperature > 0
+                       for r in self.active)
+        logits_np = np.asarray(logits) if sampling else None
+        nxt = (None if sampling
+               else np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32)))
         for slot, req in enumerate(self.active):
             if req is None:
                 continue
-            token = int(nxt[slot])
+            token = (req.pick(logits_np[slot]) if sampling
+                     else int(nxt[slot]))
             req.out.append(token)
             self.lengths[slot] += 1
             self.tokens[slot] = token
@@ -251,7 +294,7 @@ class GenerationEngine:
             self.params, tokens, jnp.asarray(T0, jnp.int32),
             jnp.asarray(slot, jnp.int32), self.cache_k, self.cache_v,
             self.cfg)
-        first = int(np.asarray(jnp.argmax(logits, -1)))
+        first = req.pick(np.asarray(logits))
         req.out.append(first)
         # Next decode for this slot attends from `first` at position T0.
         self.lengths[slot] = T0

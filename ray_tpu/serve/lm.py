@@ -54,7 +54,9 @@ class LMBackend:
         else:
             n = int(r.kwargs.get("max_new_tokens",
                                  self.default_max_new_tokens))
-        return prompt, n
+        temperature = float(r.kwargs.get("temperature", 0.0))
+        seed = r.kwargs.get("seed")
+        return prompt, n, temperature, seed
 
     @accept_batch
     def __call__(self, requests: List[ServeRequest]) -> List[List[int]]:
@@ -62,9 +64,10 @@ class LMBackend:
         # Validate every request BEFORE submitting any: a bad one must not
         # leave its batch-mates orphaned inside the engine (they would keep
         # decoding with no caller and leak into engine.done forever).
-        for prompt, n in parsed:
-            self.engine.validate(prompt, n)
-        ids = [self.engine.submit(p, n) for p, n in parsed]
+        for prompt, n, t, sd in parsed:
+            self.engine.validate(prompt, n, t, sd)
+        ids = [self.engine.submit(p, n, temperature=t, seed=s)
+               for p, n, t, s in parsed]
         pending = set(ids)
         while pending:
             self.engine.step()
