@@ -1,0 +1,72 @@
+"""Serve a language model with continuous batching
+(net-new over the reference — Ray 0.9 predates LLM serving; this is the
+flagship serving path: router batches -> GenerationEngine slots).
+
+Concurrent callers' requests decode in lockstep on shared batch slots
+(`ray_tpu/models/engine.py`); greedy requests reproduce single-request
+`generate()` exactly, sampled requests are seed-reproducible.
+
+Run:  python examples/lm_serving.py [--smoke]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import BackendConfig, LMBackend
+from ray_tpu.models import TransformerConfig, init_params
+from ray_tpu.models.generate import generate
+
+
+def main(smoke: bool = False):
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=64 if smoke else 256,
+        n_layers=2 if smoke else 4, n_heads=4, n_kv_heads=2,
+        d_ff=128 if smoke else 512, max_seq_len=128,
+        dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    serve.init()
+    serve.create_backend(
+        "lm:v1", LMBackend, params, cfg,
+        config=BackendConfig(max_batch_size=4, batch_wait_timeout_s=0.05,
+                             max_concurrent_queries=8))
+    serve.create_endpoint("generate", backend="lm:v1")
+    h = serve.get_handle("generate")
+
+    # Fire concurrent requests: the router batches them, the engine
+    # decodes them together.
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    refs = [h.remote(p, max_new_tokens=8) for p in prompts]
+    outs = ray_tpu.get(refs, timeout=600)
+    for p, out in zip(prompts, outs):
+        exp = np.asarray(generate(
+            params, jnp.asarray(p, jnp.int32)[None], cfg,
+            max_new_tokens=8))[0].tolist()
+        assert out == exp, (p, out, exp)
+    print(f"{len(prompts)} concurrent greedy requests, all exact; "
+          f"e.g. {prompts[0]} -> {outs[0]}")
+
+    # Sampled request: reproducible under an explicit seed.
+    a = ray_tpu.get(h.remote([5, 6], max_new_tokens=8,
+                             temperature=0.8, seed=42), timeout=600)
+    b = ray_tpu.get(h.remote([5, 6], max_new_tokens=8,
+                             temperature=0.8, seed=42), timeout=600)
+    assert a == b
+    print(f"sampled (T=0.8, seed=42): {a}")
+    stats = serve.stat()
+    print("endpoint metrics:", stats["metrics"]["endpoints"]["generate"])
+    serve.shutdown()
+    return outs
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    main(p.parse_args().smoke)

@@ -208,8 +208,10 @@ class GenerationEngine:
         t = float(temperature)
         if not (math.isfinite(t) and t >= 0):
             raise ValueError(f"temperature must be finite and >= 0, got {t}")
-        if seed is not None and not isinstance(seed, (int, np.integer)):
-            raise ValueError(f"seed must be an int, got {type(seed).__name__}")
+        if seed is not None and (
+                not isinstance(seed, (int, np.integer)) or seed < 0):
+            raise ValueError(
+                f"seed must be a non-negative int, got {seed!r}")
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: Optional[int] = None) -> int:

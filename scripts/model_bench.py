@@ -99,6 +99,52 @@ def bench_config(use_pallas: bool, *, batch: int, seq: int, steps: int,
             tr.flash_attention = orig
 
 
+def bench_decode(*, batch: int, seq: int, new_tokens: int, cfg=None):
+    """Generation throughput: single-request generate() and the
+    continuous-batching engine at `batch` concurrent requests (decode is
+    HBM-bound on chip, so engine/sequential is the batching win)."""
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.engine import GenerationEngine
+    from ray_tpu.models.generate import generate
+
+    cfg = cfg or TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=16, d_ff=4096, max_seq_len=seq, dtype=jnp.bfloat16)
+    if new_tokens >= seq:
+        raise ValueError(
+            f"--new-tokens ({new_tokens}) must be < --seq ({seq}): the "
+            f"cache holds prompt + generation")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    T0 = max(1, min(64, seq - new_tokens))
+    prompts = [np.random.RandomState(i).randint(
+        0, cfg.vocab_size, T0).tolist() for i in range(batch)]
+
+    p0 = jnp.asarray(prompts[0], jnp.int32)[None]
+    generate(params, p0, cfg, max_new_tokens=new_tokens).block_until_ready()
+    t0 = time.time()
+    for p in prompts:
+        generate(params, jnp.asarray(p, jnp.int32)[None], cfg,
+                 max_new_tokens=new_tokens).block_until_ready()
+    seq_wall = time.time() - t0
+
+    eng = GenerationEngine(params, cfg, max_slots=batch, max_seq=seq)
+    for p in prompts:
+        eng.submit(p, new_tokens)
+    eng.run_until_done()                       # warm compiles
+    for p in prompts:
+        eng.submit(p, new_tokens)
+    t0 = time.time()
+    eng.run_until_done()
+    eng_wall = time.time() - t0
+    total = batch * new_tokens
+    return {
+        "prompt_len": T0, "new_tokens": new_tokens, "requests": batch,
+        "sequential_tokens_per_sec": round(total / seq_wall, 1),
+        "engine_tokens_per_sec": round(total / eng_wall, 1),
+        "engine_speedup": round(seq_wall / eng_wall, 2),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
@@ -106,6 +152,9 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--peak-tflops", type=float, default=275.0,
                     help="chip peak bf16 TFLOPs for the MFU denominator")
+    ap.add_argument("--new-tokens", type=int, default=128,
+                    help="decode benchmark generation length")
+    ap.add_argument("--skip-decode", action="store_true")
     args = ap.parse_args()
 
     backend = jax.default_backend()
@@ -123,6 +172,14 @@ def main():
     fast = max(("xla_attention", "pallas_attention"),
                key=lambda n: out[n]["tokens_per_sec"])
     out["winner"] = fast
+    if not args.skip_decode:
+        try:
+            out["decode"] = bench_decode(batch=args.batch, seq=args.seq,
+                                         new_tokens=args.new_tokens)
+            print(f"# decode: {out['decode']}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - keep the attention results
+            out["decode"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# decode failed: {e}", file=sys.stderr)
     with open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "MODEL_BENCH.json"), "w") as f:
         json.dump(out, f, indent=2)

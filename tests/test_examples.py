@@ -51,3 +51,10 @@ def test_pipelined_transformer():
 
     loss = main(smoke=True)
     assert loss > 0
+
+
+def test_lm_serving(local_ray):
+    from examples.lm_serving import main
+
+    outs = main(smoke=True)
+    assert len(outs) == 6
