@@ -67,6 +67,25 @@ class Cluster:
         self.head_resources = head_resources or {"CPU": 4}
         self.num_workers = num_workers
         self._start_head()
+        # A driver that dies without calling shutdown() (crashed script,
+        # timed-out tool) must not orphan the process tree: a leaked head +
+        # controllers + workers was measured costing ~2x on every co-hosted
+        # benchmark. A STRONG reference on purpose — a dropped Cluster must
+        # still be reaped at exit (shutdown() unregisters). atexit runs on
+        # normal exit and on SIGTERM only because we route SIGTERM through
+        # sys.exit below when no handler is installed; SIGKILL still leaks
+        # (nothing can run), so `cli stop` remains the manual cleanup.
+        import atexit
+        import signal
+        import sys
+
+        self._atexit_cb = self.shutdown
+        atexit.register(self._atexit_cb)
+        try:
+            if signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
+        except (ValueError, OSError):  # non-main thread / unsupported
+            pass
 
     @property
     def address(self) -> str:
@@ -148,6 +167,12 @@ class Cluster:
             client.close()
 
     def shutdown(self):
+        cb = getattr(self, "_atexit_cb", None)
+        if cb is not None:
+            import atexit
+
+            atexit.unregister(cb)
+            self._atexit_cb = None
         for node in self.nodes:
             if node.proc.poll() is None:
                 node.proc.terminate()

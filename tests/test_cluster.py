@@ -298,3 +298,64 @@ def test_gcs_debug_stats(driver):
     # the busiest handlers are sorted first
     totals = [v["total_s"] for v in handlers.values()]
     assert totals == sorted(totals, reverse=True)
+
+
+def test_cluster_atexit_cleanup():
+    """A driver that exits without shutdown() must not orphan the cluster
+    process tree (a leaked head was measured costing ~2x on co-hosted
+    benchmarks)."""
+    import subprocess
+    import sys
+
+    from ray_tpu.cluster.testing import _subprocess_env
+
+    script = (
+        "from ray_tpu.cluster.testing import Cluster\n"
+        "c = Cluster(head_resources={'CPU': 1}, num_workers=1)\n"
+        "print(c.address, flush=True)\n"
+        # exits WITHOUT calling c.shutdown()
+    )
+    proc = subprocess.run([sys.executable, "-c", script],
+                          env=_subprocess_env(), capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    addr = proc.stdout.strip()
+    # The head must be gone: nothing should accept on its port. (No global
+    # pgrep here — other tests' module-scoped clusters may legitimately be
+    # alive in a full-suite run.)
+    import socket
+    host, port = addr.split(":")
+    with pytest.raises(OSError):
+        socket.create_connection((host, int(port)), timeout=2).close()
+
+
+def test_cluster_cleanup_on_dropped_reference_and_sigterm():
+    """Cleanup holds even when the driver drops its Cluster reference, and
+    a SIGTERM'd driver reaps the tree via the routed sys.exit."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from ray_tpu.cluster.testing import _subprocess_env
+
+    script = (
+        "import sys, time\n"
+        "from ray_tpu.cluster.testing import Cluster\n"
+        "def run():\n"
+        "    c = Cluster(head_resources={'CPU': 1}, num_workers=1)\n"
+        "    print(c.address, flush=True)\n"
+        "run()  # reference dropped here\n"
+        "time.sleep(60)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            env=_subprocess_env(), stdout=subprocess.PIPE,
+                            text=True)
+    addr = proc.stdout.readline().strip()
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=30)
+    _time.sleep(1)
+    import socket
+    host, port = addr.split(":")
+    with pytest.raises(OSError):
+        socket.create_connection((host, int(port)), timeout=2).close()
