@@ -236,6 +236,14 @@ def cmd_status(args) -> None:
             print(f"  {n['NodeID'][:12]} {state:<6} {n['Resources']}")
         print(f"total resources:     {res['total']}")
         print(f"available resources: {res['available']}")
+        if getattr(args, "verbose", False):
+            # Per-RPC handler timings (bg:<type> = detached completion
+            # time): the cProfile-free view of where GCS cycles go.
+            stats = gcs.call({"type": "debug_stats"})["handlers"]
+            print("GCS handlers (busiest first):")
+            for mtype, h in stats.items():
+                print(f"  {mtype:<24} {h['count']:>8} calls "
+                      f"{h['total_s']:>10.4f} s")
     finally:
         gcs.close()
 
@@ -462,6 +470,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             sp.add_argument("--limit", type=int, default=1000)
             sp.add_argument("--refs", action="store_true",
                             help="reference-accounting view (holders/pins)")
+        if name == "status":
+            sp.add_argument("-v", "--verbose", action="store_true",
+                            help="include per-RPC GCS handler timings")
         sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("submit", help="run a driver script on the cluster")

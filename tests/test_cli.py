@@ -128,3 +128,19 @@ def test_cli_memory_refs_view(tmp_path):
         assert "HELD" in sub.stdout and "HOLDERS" in sub.stdout
     finally:
         _cli(env, "stop", timeout=30)
+
+
+@pytest.mark.slow
+def test_cli_status_verbose_handler_timings(tmp_path):
+    """`status -v` prints per-RPC GCS handler timings (debug_stats)."""
+    from ray_tpu.cluster.testing import Cluster
+
+    c = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        out = _cli(_cli_env(tmp_path), "status", "-v",
+                   "--address", c.address)
+        assert out.returncode == 0, out.stderr[-1000:]
+        assert "GCS handlers (busiest first):" in out.stdout
+        assert "list_nodes" in out.stdout  # status itself called it
+    finally:
+        c.shutdown()
