@@ -59,8 +59,18 @@ class ClusterNode:
 
 
 class Cluster:
+    """In-process test cluster (reference python/ray/cluster_utils.py:11).
+
+    Note: by default the constructor installs a process-wide SIGTERM handler
+    (routing to ``sys.exit(143)`` so atexit cleanup reaps the process tree)
+    — but only when no handler is already installed (SIG_DFL check). An
+    embedding application that relies on default SIGTERM termination can opt
+    out with ``Cluster(reap_on_sigterm=False)``; it then owns cleanup on
+    SIGTERM itself (atexit still covers normal exit).
+    """
+
     def __init__(self, head_resources: Optional[Dict[str, float]] = None,
-                 num_workers: int = 2):
+                 num_workers: int = 2, reap_on_sigterm: bool = True):
         self.nodes: List[ClusterNode] = []
         self._head = None
         self.gcs_port: Optional[int] = None
@@ -81,11 +91,12 @@ class Cluster:
 
         self._atexit_cb = self.shutdown
         atexit.register(self._atexit_cb)
-        try:
-            if signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
-                signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
-        except (ValueError, OSError):  # non-main thread / unsupported
-            pass
+        if reap_on_sigterm:
+            try:
+                if signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+                    signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
+            except (ValueError, OSError):  # non-main thread / unsupported
+                pass
 
     @property
     def address(self) -> str:

@@ -237,17 +237,20 @@ class GenerationEngine:
         logits, self.cache_k, self.cache_v = _batched_decode(
             self.params, jnp.asarray(self.tokens),
             jnp.asarray(self.lengths), self.cache_k, self.cache_v, self.cfg)
-        # Hot path stays device-side for the (default) all-greedy case:
-        # transfer [B] int32 argmaxes, not the [B, V] logits matrix.
-        sampling = any(r is not None and r.temperature > 0
-                       for r in self.active)
-        logits_np = np.asarray(logits) if sampling else None
-        nxt = (None if sampling
-               else np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32)))
+        # Hot path stays device-side: greedy slots get the [B] int32 argmax
+        # transfer; only the sampling slots' logits ROWS come to the host
+        # ([k, V], not [B, V]), so one temperature>0 request doesn't impose
+        # the full-matrix bandwidth cliff on its greedy batch-mates.
+        sampling_slots = [s for s, r in enumerate(self.active)
+                          if r is not None and r.temperature > 0]
+        nxt = np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        rows = (np.asarray(logits[jnp.asarray(sampling_slots)])
+                if sampling_slots else None)
+        row_of = {s: i for i, s in enumerate(sampling_slots)}
         for slot, req in enumerate(self.active):
             if req is None:
                 continue
-            token = (req.pick(logits_np[slot]) if sampling
+            token = (req.pick(rows[row_of[slot]]) if slot in row_of
                      else int(nxt[slot]))
             req.out.append(token)
             self.lengths[slot] += 1

@@ -111,6 +111,13 @@ def remote(*args, **kwargs):
 
     Dispatches to RemoteFunction for functions and ActorClass for classes
     (reference: python/ray/worker.py:1799 make_decorator).
+
+    Export semantics (cluster mode): a function object is pickled and
+    exported ONCE, on its first submission — the same as the reference's
+    export-at-decoration (python/ray/function_manager.py). Mutating a
+    captured global/closure cell after the first ``.remote()`` call does NOT
+    re-export; cluster workers keep executing the first-export snapshot.
+    Re-decorate (or define a new function) to ship new captured state.
     """
     from .actor import ActorClass
 

@@ -148,9 +148,12 @@ class DynaTrainer(Trainer):
         if self._steps_sampled < cfg["learning_starts"]:
             return stats
 
+        model_losses = []
         for _ in range(cfg["model_train_batches_per_step"]):
             batch = self.replay.sample(cfg["train_batch_size"])
-            stats["model_loss"] = self.model.train_on_batch(batch)
+            model_losses.append(self.model.train_on_batch(batch))
+        if model_losses:
+            stats["model_loss"] = float(np.mean(model_losses))
 
         policy: DQNPolicy = self.workers.local_worker().policy
         for _ in range(cfg["num_train_batches_per_step"]):
@@ -161,14 +164,17 @@ class DynaTrainer(Trainer):
         # Imagination: replayed states, random candidate actions, model
         # transitions — trained with the same jitted TD update.
         num_actions = self.model.num_actions
+        imagined_losses = []
         for _ in range(cfg["imagined_batches_per_step"]):
             seed_batch = self.replay.sample(cfg["train_batch_size"])
             obs = np.asarray(seed_batch[OBS], dtype=np.float32)
             actions = self._model_rng.randint(num_actions, size=len(obs))
             imagined = self.model.imagine_batch(obs, actions)
             im_stats = policy.learn_on_batch(imagined)
-            stats["imagined_loss"] = im_stats["loss"]
+            imagined_losses.append(im_stats["loss"])
             self._steps_trained += imagined.count
+        if imagined_losses:
+            stats["imagined_loss"] = float(np.mean(imagined_losses))
 
         if self._iteration % cfg["target_network_update_freq"] == 0:
             policy.update_target()

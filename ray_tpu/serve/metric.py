@@ -20,12 +20,14 @@ class LatencyWindow:
 
     def __init__(self, maxlen: int = 2048):
         self.samples: deque = deque(maxlen=maxlen)
+        self.stamps: deque = deque(maxlen=maxlen)
         self.count = 0
         self.errors = 0
         self.started = time.time()
 
     def record(self, latency_s: float, error: bool = False) -> None:
         self.samples.append(latency_s)
+        self.stamps.append(time.time())
         self.count += 1
         if error:
             self.errors += 1
@@ -43,11 +45,18 @@ class LatencyWindow:
                 return 0.0
             return xs[max(0, min(n - 1, math.ceil(p * n) - 1))]
 
-        elapsed = max(time.time() - self.started, 1e-9)
+        # qps over the retained sample window (first kept stamp -> now), not
+        # a lifetime average: after an idle period a lifetime rate would
+        # under-report the current load. Floored at 1s so a snapshot taken
+        # moments after the first sample can't report a phantom spike
+        # (1 sample / 1ms = 1000 qps).
+        window = max(time.time() - self.stamps[0], 1.0) if self.stamps \
+            else 1.0
         return {
             "count": self.count,
             "errors": self.errors,
-            "qps": round(self.count / elapsed, 2),
+            "qps": round(len(self.stamps) / window, 2) if self.stamps
+            else 0.0,
             "latency_ms_mean": round(1e3 * sum(xs) / n, 3) if n else 0.0,
             "latency_ms_p50": round(1e3 * pct(0.50), 3),
             "latency_ms_p90": round(1e3 * pct(0.90), 3),
