@@ -433,6 +433,8 @@ class ClusterCoreWorker:
             "return_ids": [spec.return_ids()[0].binary()],
             "resources": resources,
             "max_restarts": spec.max_restarts,
+            "max_concurrency": spec.max_concurrency,
+            "is_asyncio": spec.is_asyncio,
         })
         return actor_id
 

@@ -171,11 +171,114 @@ def main():
             for oid, val in zip(oids, result):
                 store_result(oid, val)
 
+    # ---- actor method concurrency -----------------------------------------
+    # Cluster/local parity (reference: BoundedExecutor for max_concurrency,
+    # direct_actor_transport.h:264, and fibers for asyncio actors,
+    # core_worker/fiber.h — mirrored locally by _private/runtime.LocalActor):
+    #  * plain actors run inline in this thread — per-caller order is the
+    #    controller's FIFO dispatch order;
+    #  * max_concurrency > 1 runs methods on a bounded thread pool;
+    #  * async actors schedule coroutines on ONE persistent event loop
+    #    thread, so concurrent awaits genuinely interleave instead of each
+    #    call paying a fresh asyncio.run().
+    import asyncio
+
+    actor_pool = None   # ThreadPoolExecutor when max_concurrency > 1
+    actor_loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def finish(msg) -> bool:
+        """Report task completion; returns False when the controller is gone.
+
+        Sends on the SAME connection the result notifications used (core's
+        controller client): TCP FIFO guarantees the controller registers the
+        objects before it sees task_done, so the GCS can never mark the task
+        FINISHED while its outputs are still unindexed (a lost-object false
+        positive that would trigger spurious lineage re-execution). Each
+        concurrent executor thread stores then finishes on that one locked
+        client, so the invariant holds per task regardless of interleaving.
+        """
+        try:
+            core._controller((chost, int(cport))).send_oneway({
+                "type": "task_done",
+                "pid": os.getpid(),
+                "return_ids": msg.get("return_ids", []),
+            })
+            return True
+        except (ConnectionError, OSError):
+            inbox.put({"type": "shutdown"})  # main loop exits
+            return False
+
+    def complete_actor_method(msg, result=None, error=None) -> None:
+        """Store returns (or the error), checkpoint, report task_done.
+
+        The store->finish pair runs in ONE thread so the TCP FIFO invariant
+        documented on finish() holds per task. Shared by the inline, pooled,
+        and async execution paths — a fix to error storage or the ordering
+        applies to all three at once."""
+        try:
+            if error is None:
+                run_returns(msg, result)
+                maybe_save_checkpoint()
+            else:
+                store_error(msg, error)
+        except BaseException as e:  # noqa: BLE001 - completion errors are data
+            try:
+                store_error(msg, e)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        finally:
+            finish(msg)
+
+    def run_actor_method(msg) -> None:
+        """One actor method: resolve, run, complete. Used inline (plain
+        actors) and from pool threads (max_concurrency)."""
+        try:
+            method = getattr(actor_instance, msg["method"])
+            pos, kwargs = resolve_args(msg)
+            result = method(*pos, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = asyncio.run(result)
+        except BaseException as e:  # noqa: BLE001 - task errors are data
+            complete_actor_method(msg, error=e)
+            return
+        complete_actor_method(msg, result)
+
+    async def run_actor_method_async(msg) -> None:
+        """Coroutine twin for the persistent loop: the method's coroutine is
+        awaited IN PLACE so batch-mates interleave, while the potentially
+        BLOCKING pieces (ref-arg resolution, result store / checkpoint /
+        task_done RPCs) run via asyncio.to_thread so they never stall the
+        loop and re-serialize the in-flight coroutines."""
+        try:
+            pos, kwargs = await asyncio.to_thread(resolve_args, msg)
+            method = getattr(actor_instance, msg["method"])
+            result = method(*pos, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+        except BaseException as e:  # noqa: BLE001 - task errors are data
+            await asyncio.to_thread(complete_actor_method, msg, None, e)
+            return
+        await asyncio.to_thread(complete_actor_method, msg, result)
+
     while True:
         msg = inbox.get()
         mtype = msg.get("type")
         if mtype == "shutdown":
             break
+        if mtype == "execute_actor_task" and actor_instance is not None:
+            # Dispatch order == controller FIFO order for all three modes;
+            # completion may interleave for async/pooled actors (that is
+            # their contract). The concurrent paths own their error
+            # handling + task_done, so they bypass the serial finally.
+            if actor_loop is not None:
+                asyncio.run_coroutine_threadsafe(
+                    run_actor_method_async(msg), actor_loop)
+                continue
+            if actor_pool is not None:
+                actor_pool.submit(run_actor_method, msg)
+                continue
+            run_actor_method(msg)
+            continue
         try:
             if mtype == "execute_task":
                 fn = load_function(msg["fn_id"])
@@ -188,18 +291,20 @@ def main():
                 actor_instance = cls(*pos, **kwargs)
                 actor_id = msg["actor_id"]
                 maybe_restore_checkpoint(msg)
+                if msg.get("is_asyncio"):
+                    actor_loop = asyncio.new_event_loop()
+                    threading.Thread(
+                        target=actor_loop.run_forever, daemon=True,
+                        name="actor-asyncio-loop").start()
+                elif int(msg.get("max_concurrency", 1) or 1) > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    actor_pool = ThreadPoolExecutor(
+                        max_workers=int(msg["max_concurrency"]),
+                        thread_name_prefix="actor-exec")
                 store_result(msg["return_ids"][0], True)
             elif mtype == "execute_actor_task":
-                if actor_instance is None:
-                    raise RuntimeError("actor not initialized")
-                method = getattr(actor_instance, msg["method"])
-                pos, kwargs = resolve_args(msg)
-                result = method(*pos, **kwargs)
-                import asyncio
-                if asyncio.iscoroutine(result):
-                    result = asyncio.run(result)
-                run_returns(msg, result)
-                maybe_save_checkpoint()
+                raise RuntimeError("actor not initialized")
             else:
                 continue
         except BaseException as e:  # noqa: BLE001 - task errors are data
@@ -208,20 +313,13 @@ def main():
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
         finally:
-            try:
-                # Send on the SAME connection the result notifications used
-                # (core's controller client): TCP FIFO guarantees the
-                # controller registers the objects before it sees task_done,
-                # so the GCS can never mark the task FINISHED while its
-                # outputs are still unindexed (a lost-object false positive
-                # that would trigger spurious lineage re-execution).
-                core._controller((chost, int(cport))).send_oneway({
-                    "type": "task_done",
-                    "pid": os.getpid(),
-                    "return_ids": msg.get("return_ids", []),
-                })
-            except (ConnectionError, OSError):
+            if not finish(msg):
                 break
+
+    if actor_loop is not None:
+        actor_loop.call_soon_threadsafe(actor_loop.stop)
+    if actor_pool is not None:
+        actor_pool.shutdown(wait=False)
 
 
 if __name__ == "__main__":

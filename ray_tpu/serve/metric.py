@@ -47,11 +47,18 @@ class LatencyWindow:
 
         # qps over the retained sample window (first kept stamp -> now), not
         # a lifetime average: after an idle period a lifetime rate would
-        # under-report the current load. Floored at 1s so a snapshot taken
-        # moments after the first sample can't report a phantom spike
-        # (1 sample / 1ms = 1000 qps).
-        window = max(time.time() - self.stamps[0], 1.0) if self.stamps \
-            else 1.0
+        # under-report the current load. The 1s floor only applies while the
+        # deque is NOT full: it stops a snapshot taken moments after the
+        # first sample from reporting a phantom spike, while a full deque
+        # uses its true span so sustained rates above maxlen/1s aren't
+        # clamped to maxlen.
+        if self.stamps:
+            window = time.time() - self.stamps[0]
+            if len(self.stamps) < self.stamps.maxlen:
+                window = max(window, 1.0)
+            window = max(window, 1e-3)
+        else:
+            window = 1.0
         return {
             "count": self.count,
             "errors": self.errors,

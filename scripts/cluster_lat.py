@@ -1,0 +1,50 @@
+"""Cluster task round-trip latency probe (VERDICT r3 item 3).
+
+Starts an in-process Cluster, runs N serial no-op round trips, prints
+p50/p90/p99 and a per-phase breakdown of one instrumented trip.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+from ray_tpu.cluster.testing import Cluster
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    c = Cluster(num_workers=2)
+    ray_tpu.init(address=c.address)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    # warm: fn export + worker spawn + code paths
+    ray_tpu.get([noop.remote() for _ in range(20)])
+
+    lats = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        ray_tpu.get(noop.remote())
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    p = lambda q: lats[min(n - 1, int(q * n))] * 1e3  # noqa: E731
+    print(f"serial round trip n={n}: p50={p(.5):.2f}ms p90={p(.9):.2f}ms "
+          f"p99={p(.99):.2f}ms min={lats[0]*1e3:.2f}ms")
+
+    t0 = time.perf_counter()
+    k = 5000
+    ray_tpu.get([noop.remote() for _ in range(k)])
+    dt = time.perf_counter() - t0
+    print(f"async batch {k}: {k/dt:,.0f} tasks/s")
+
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -145,22 +145,64 @@ def bench_decode(*, batch: int, seq: int, new_tokens: int, cfg=None):
     }
 
 
+_PEAK_BF16_TFLOPS = [
+    # (device_kind substring, peak bf16 TFLOPs/chip) — public spec sheets.
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0), ("v5 lite", 197.0), ("v5lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def _detect_peak_tflops(default: float = 275.0) -> float:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        return default
+    for sub, peak in _PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return default
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--peak-tflops", type=float, default=275.0,
-                    help="chip peak bf16 TFLOPs for the MFU denominator")
+    ap.add_argument("--peak-tflops", type=float, default=0.0,
+                    help="chip peak bf16 TFLOPs for the MFU denominator "
+                         "(0 = auto-detect from device_kind)")
     ap.add_argument("--new-tokens", type=int, default=128,
                     help="decode benchmark generation length")
     ap.add_argument("--skip-decode", action="store_true")
+    ap.add_argument("--require-backend", default="",
+                    help="abort (rc=3) unless jax.default_backend() matches "
+                         "— the capture daemon uses this so a mid-run tunnel "
+                         "drop can't overwrite an on-chip MODEL_BENCH.json "
+                         "with a CPU run")
+    ap.add_argument("--out", default="",
+                    help="output path (default: <repo>/MODEL_BENCH.json)")
     args = ap.parse_args()
 
     backend = jax.default_backend()
-    print(f"# backend: {backend}", file=sys.stderr)
-    out = {"backend": backend, "batch": args.batch, "seq": args.seq,
-           "peak_tflops": args.peak_tflops}
+    if args.require_backend and backend != args.require_backend:
+        print(f"# backend {backend} != required {args.require_backend}; "
+              "aborting", file=sys.stderr)
+        sys.exit(3)
+    if not args.peak_tflops:
+        args.peak_tflops = _detect_peak_tflops()
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        kind = "unknown"
+    print(f"# backend: {backend} ({kind}, peak {args.peak_tflops} TFLOPs)",
+          file=sys.stderr)
+    out = {"backend": backend, "device_kind": kind, "batch": args.batch,
+           "seq": args.seq, "peak_tflops": args.peak_tflops,
+           "captured_unix": int(time.time())}
     for name, use_pallas in (("xla_attention", False),
                              ("pallas_attention", True)):
         r = bench_config(use_pallas, batch=args.batch, seq=args.seq,
@@ -180,8 +222,9 @@ def main():
         except Exception as e:  # noqa: BLE001 - keep the attention results
             out["decode"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"# decode failed: {e}", file=sys.stderr)
-    with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "MODEL_BENCH.json"), "w") as f:
+    path = args.out or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MODEL_BENCH.json")
+    with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
 

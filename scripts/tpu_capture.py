@@ -1,0 +1,199 @@
+"""TPU capture daemon: retry on-chip evidence across the whole round.
+
+Round-3 verdict: two consecutive rounds shipped zero TPU-captured numbers
+because the bench probed the (flaky) tunnel exactly once, at bench time.
+This daemon inverts that: it runs for the whole round, probes the TPU
+periodically, and whenever the tunnel is healthy captures — in order —
+
+  1. on-chip pallas smoke gate:   pytest tests/test_fused_ops.py with
+     RAY_TPU_TESTS_ON_CHIP=1 (kernels compiled for the chip, not interpret)
+  2. kernel bench:                python bench.py; kept only if the output
+     line reports backend == "tpu"  -> BENCH_TPU_LASTGOOD.json
+                                       (+ BENCH_DETAIL.json -> _TPU copy)
+  3. model bench:                 python scripts/model_bench.py
+     --require-backend tpu        -> MODEL_BENCH.json (tokens/s + MFU
+                                      + decode tokens/s)
+
+Results are only ever overwritten by NEWER SUCCESSFUL captures; failures
+leave the last good artifacts in place. Status/journal:
+TPU_CAPTURE_STATUS.json + scripts/tpu_capture.log.
+
+Run it under tmux for the round:  python scripts/tpu_capture.py
+One-shot attempt (no loop):       python scripts/tpu_capture.py --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATUS = os.path.join(REPO, "TPU_CAPTURE_STATUS.json")
+LOG = os.path.join(REPO, "scripts", "tpu_capture.log")
+
+PROBE_TIMEOUT = 240
+STAGE_TIMEOUT = 3600
+RETRY_SLEEP = 420        # between failed probes
+REFRESH_SLEEP = 5400     # after a fully successful capture
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _status_update(**kw) -> dict:
+    try:
+        with open(STATUS) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        st = {}
+    st.update(kw)
+    st["updated_unix"] = int(time.time())
+    st["updated"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=2)
+    os.replace(tmp, STATUS)
+    return st
+
+
+def probe() -> str | None:
+    """Return the device_kind if a device_put round-trips on TPU, else None.
+
+    Runs in a subprocess: the axon backend has been observed to HANG init
+    for >9 minutes, and a hung thread inside this process would wedge the
+    daemon. A subprocess can always be killed.
+    """
+    code = (
+        "import jax, numpy as np\n"
+        "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        "np.asarray(jax.device_put(np.arange(8, dtype=np.float32))) \n"
+        "print(jax.devices()[0].device_kind)\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    return r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "tpu"
+
+
+def run_stage(name: str, argv: list[str], timeout: int = STAGE_TIMEOUT,
+              env_extra: dict | None = None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, cwd=REPO, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        log(f"{name}: TIMEOUT after {timeout}s")
+        return None
+    dt = round(time.time() - t0, 1)
+    tail = (r.stdout + "\n" + r.stderr)[-800:]
+    if r.returncode != 0:
+        log(f"{name}: rc={r.returncode} in {dt}s; tail:\n{tail}")
+        return None
+    log(f"{name}: OK in {dt}s")
+    return r
+
+
+def capture_once() -> dict:
+    """One full attempt; returns {stage: bool} for the three stages."""
+    done = {"smoke": False, "kernel_bench": False, "model_bench": False}
+
+    kind = probe()
+    if kind is None:
+        log("probe: TPU unreachable")
+        _status_update(last_probe="unreachable")
+        return done
+    log(f"probe: TPU healthy ({kind})")
+    _status_update(last_probe=f"healthy ({kind})", device_kind=kind)
+
+    # 1. on-chip pallas smoke gate (flash fwd/bwd + flash-decode compiled
+    #    for the chip). -p no:cacheprovider: keep the repo clean.
+    r = run_stage(
+        "smoke(test_fused_ops on-chip)",
+        [sys.executable, "-m", "pytest", "tests/test_fused_ops.py", "-q",
+         "-p", "no:cacheprovider"],
+        timeout=1800, env_extra={"RAY_TPU_TESTS_ON_CHIP": "1"})
+    done["smoke"] = r is not None
+    _status_update(smoke_on_chip={"ok": done["smoke"],
+                                  "unix": int(time.time())})
+
+    # 2. kernel bench; keep only a tpu-backend result.
+    r = run_stage("kernel bench", [sys.executable, "bench.py"])
+    if r is not None:
+        try:
+            line = [ln for ln in r.stdout.splitlines()
+                    if ln.startswith("{")][-1]
+            rec = json.loads(line)
+        except (IndexError, ValueError):
+            rec = {}
+        if rec.get("backend") == "tpu":
+            rec["captured_unix"] = int(time.time())
+            rec["device_kind"] = kind
+            with open(os.path.join(REPO, "BENCH_TPU_LASTGOOD.json"),
+                      "w") as f:
+                json.dump(rec, f, indent=2)
+            detail = os.path.join(REPO, "BENCH_DETAIL.json")
+            if os.path.exists(detail):
+                with open(detail) as f:
+                    d = f.read()
+                with open(os.path.join(REPO, "BENCH_DETAIL_TPU.json"),
+                          "w") as f:
+                    f.write(d)
+            done["kernel_bench"] = True
+            log(f"kernel bench captured on-chip: {rec.get('value')} "
+                f"{rec.get('unit')} ({rec.get('vs_baseline')}x baseline)")
+        else:
+            log(f"kernel bench fell back to backend="
+                f"{rec.get('backend')!r}; not persisting")
+    _status_update(kernel_bench={"ok": done["kernel_bench"],
+                                 "unix": int(time.time())})
+
+    # 3. model bench (writes MODEL_BENCH.json itself; --require-backend
+    #    makes a mid-run fallback abort instead of clobbering).
+    r = run_stage(
+        "model bench",
+        [sys.executable, "scripts/model_bench.py", "--require-backend",
+         "tpu", "--steps", "20"])
+    done["model_bench"] = r is not None
+    _status_update(model_bench={"ok": done["model_bench"],
+                                "unix": int(time.time())})
+    return done
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="single attempt, exit 0 iff all stages captured")
+    args = ap.parse_args()
+
+    log(f"daemon start (pid {os.getpid()})")
+    while True:
+        done = capture_once()
+        ok = all(done.values())
+        _status_update(last_attempt=done, all_captured=ok)
+        if args.once:
+            sys.exit(0 if ok else 1)
+        sleep = REFRESH_SLEEP if ok else RETRY_SLEEP
+        log(f"attempt done {done}; sleeping {sleep}s")
+        time.sleep(sleep)
+
+
+if __name__ == "__main__":
+    main()

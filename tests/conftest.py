@@ -8,20 +8,27 @@ initializes its backends, hence the top-of-conftest placement.
 
 import os
 
-# Force (not setdefault): the axon sitecustomize hook sets jax_platforms via
-# jax.config at interpreter startup, which would route tests to the remote TPU
-# tunnel. Override both the env var and the config before any backend
-# initializes (XLA_FLAGS is read at CPU client creation).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# RAY_TPU_TESTS_ON_CHIP=1 leaves the default (real TPU) backend in place so
+# selected suites (e.g. test_fused_ops) compile the pallas kernels on the
+# actual chip — used by scripts/tpu_capture.py as the on-chip smoke gate.
+_ON_CHIP = bool(os.environ.get("RAY_TPU_TESTS_ON_CHIP"))
+
+if not _ON_CHIP:
+    # Force (not setdefault): the axon sitecustomize hook sets jax_platforms
+    # via jax.config at interpreter startup, which would route tests to the
+    # remote TPU tunnel. Override both the env var and the config before any
+    # backend initializes (XLA_FLAGS is read at CPU client creation).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_CHIP:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

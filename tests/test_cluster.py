@@ -145,6 +145,62 @@ class TestClusterActors:
         with pytest.raises((ray_tpu.ActorError, ray_tpu.RayTpuError)):
             ray_tpu.get(a.ping.remote(), timeout=15)
 
+    def test_max_concurrency(self, driver):
+        # Same assertion as the local-mode test (test_basic.py
+        # test_max_concurrency): 4 blocking calls overlap on the worker's
+        # bounded pool instead of serializing in its inbox loop.
+        @ray_tpu.remote(max_concurrency=4)
+        class Slow:
+            def work(self):
+                time.sleep(0.5)
+                return 1
+
+        s = Slow.remote()
+        ray_tpu.get(s.work.remote(), timeout=30)  # creation + warm path
+        t0 = time.monotonic()
+        assert ray_tpu.get([s.work.remote() for _ in range(4)],
+                           timeout=30) == [1] * 4
+        assert time.monotonic() - t0 < 1.6  # concurrent, not 2s serial
+
+    def test_asyncio_actor_concurrent_awaits(self, driver):
+        # Same assertion as local-mode test_asyncio_actor: coroutines from
+        # separate calls interleave on the worker's persistent event loop
+        # (previously each call paid its own asyncio.run => serial).
+        @ray_tpu.remote
+        class AsyncWorker:
+            async def work(self, i):
+                import asyncio
+                await asyncio.sleep(0.5)
+                return i
+
+        w = AsyncWorker.remote()
+        ray_tpu.get(w.work.remote(-1), timeout=30)  # creation + warm path
+        t0 = time.monotonic()
+        out = ray_tpu.get([w.work.remote(i) for i in range(5)], timeout=30)
+        elapsed = time.monotonic() - t0
+        assert sorted(out) == list(range(5))
+        assert elapsed < 2.0  # overlapped, not 2.5s serial
+
+    def test_asyncio_actor_state_consistency(self, driver):
+        # Interleaved coroutines still see one shared instance.
+        @ray_tpu.remote
+        class Accum:
+            def __init__(self):
+                self.total = 0
+
+            async def add(self, x):
+                import asyncio
+                await asyncio.sleep(0.01)
+                self.total += x
+                return self.total
+
+            async def value(self):
+                return self.total
+
+        a = Accum.remote()
+        ray_tpu.get([a.add.remote(i) for i in range(10)], timeout=30)
+        assert ray_tpu.get(a.value.remote(), timeout=30) == sum(range(10))
+
 
 class TestMultiNode:
     def test_add_node_and_spread(self, cluster, driver):
