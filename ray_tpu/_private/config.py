@@ -44,6 +44,12 @@ class Config:
     actor_max_restarts_default: int = 0
     max_pending_lease_requests: int = 10
     worker_lease_timeout_ms: int = 500
+    # Owner worker leases + direct push (reference: direct task transport,
+    # direct_task_transport.h:49): dependency-free tasks skip the GCS queue
+    # and go straight to a leased worker while few results are outstanding.
+    direct_call_enabled: bool = True
+    direct_call_max_outstanding: int = 32
+    direct_lease_idle_s: float = 5.0
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 => num_cpus
     worker_register_timeout_s: int = 30

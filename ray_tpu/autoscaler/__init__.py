@@ -1,6 +1,7 @@
 """Autoscaler (reference: python/ray/autoscaler/)."""
 
 from .autoscaler import StandardAutoscaler  # noqa: F401
+from .gce import GCETPUNodeProvider, make_provider  # noqa: F401
 from .load_metrics import LoadMetrics  # noqa: F401
 from .node_provider import MockProvider, NodeProvider, SubprocessProvider  # noqa: F401
 from .resource_demand_scheduler import get_nodes_to_launch  # noqa: F401

@@ -42,12 +42,14 @@ class WorkerHandle:
         self.conn: Optional[Connection] = None
         self.idle = True
         self.actor_id: Optional[bytes] = None
+        self.lease_id: Optional[bytes] = None  # owner-leased (direct push)
         self.current_task: Optional[Dict] = None
         self.ready = asyncio.Event()
         self.killed_deliberately = False  # ray.kill: suppress restart
-        # Actor method calls in flight on this worker, keyed by first return
-        # id: on worker death every one of them must be failed (plain tasks
-        # use current_task — at most one at a time).
+        # Actor method calls AND leased direct tasks in flight on this
+        # worker, keyed by first return id: on worker death every one of
+        # them must be failed (plain queued tasks use current_task — at
+        # most one at a time).
         self.inflight: Dict[bytes, Dict] = {}
 
 
@@ -106,6 +108,10 @@ class NodeController:
         self._gcs: Optional[RpcClient] = None
         self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._actor_queues: Dict[bytes, "asyncio.Queue"] = {}
+        # Owner worker leases (reference: raylet worker leases granted to
+        # the direct task transport, node_manager.cc HandleRequestWorkerLease):
+        # lease_id -> {"worker": WorkerHandle, "task": admission record}.
+        self._leases: Dict[bytes, Dict] = {}
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
@@ -299,8 +305,42 @@ class NodeController:
                             f"{w.proc.returncode})", crashed=True,
                         )
                     for call in list(w.inflight.values()):
-                        await self._fail_actor_call(call)
+                        if call.get("direct"):
+                            # resources={}: the share belongs to the lease;
+                            # the GCS record re-drives on the normal path
+                            # (max_retries) or serves the terminal error.
+                            await self._fail_task(
+                                dict(call, resources={}),
+                                f"leased worker died (exit "
+                                f"{w.proc.returncode})", crashed=True)
+                        else:
+                            await self._fail_actor_call(call)
                     w.inflight.clear()
+                    if w.lease_id is not None:
+                        # The lease dies with its worker: give back the
+                        # local + cluster shares and tell the owner (the
+                        # controller stays reachable, so only this push
+                        # stops it from feeding a dead lease).
+                        lease = self._leases.pop(w.lease_id, None)
+                        if lease is not None:
+                            self._release_local(lease["task"])
+                            try:
+                                self._gcs.send_oneway({
+                                    "type": "release_resources",
+                                    "node_id": self.node_id,
+                                    "resources":
+                                        lease["task"].get("resources", {}),
+                                })
+                            except ConnectionError:
+                                pass
+                            if lease.get("conn") is not None:
+                                try:
+                                    await lease["conn"].send(
+                                        {"type": "lease_lost",
+                                         "lease_id": w.lease_id})
+                                except Exception:  # noqa: BLE001
+                                    pass
+                        w.lease_id = None
                     if w.actor_id is not None:
                         # A crash report: the GCS transitions to RESTARTING
                         # when max_restarts allows, DEAD otherwise.
@@ -451,7 +491,8 @@ class NodeController:
         deadline = time.monotonic() + timeout
         while True:
             for w in self.workers.values():
-                if w.idle and w.conn is not None and w.actor_id is None:
+                if w.idle and w.conn is not None and w.actor_id is None \
+                        and w.lease_id is None:
                     w.idle = False
                     return w
             if all(w.conn is not None for w in self.workers.values()) and \
@@ -504,6 +545,23 @@ class NodeController:
             error_blob = ERR_PREFIX + pickle.dumps(err)
         for oid in task["return_ids"]:
             await self._store_put(oid, error_blob)
+
+    async def _requeue_direct(self, task: Dict) -> None:
+        """Re-drive a never-executed direct task through its GCS lineage
+        record without burning a retry. The record travels owner->GCS while
+        the push travels owner->controller: it can lag us, so retry briefly
+        before treating the task as failed."""
+        for _ in range(5):
+            try:
+                resp = await asyncio.to_thread(self._gcs.call, {
+                    "type": "requeue_task", "task_id": task.get("task_id")})
+                if resp.get("requeued"):
+                    return
+            except Exception:  # noqa: BLE001 - GCS unreachable: fall through
+                break
+            await asyncio.sleep(0.05)
+        await self._fail_task(dict(task, resources={}),
+                              "lease lost before dispatch", crashed=True)
 
     async def _release(self, task: Dict):
         if task.get("released"):
@@ -613,6 +671,12 @@ class NodeController:
             if task is not None and task.get("task_id") == task_id \
                     and w.proc.poll() is None:
                 w.proc.kill()
+            elif w.proc.poll() is None and any(
+                    t.get("task_id") == task_id
+                    for t in w.inflight.values() if t.get("direct")):
+                # Direct-pushed task on a leased worker: same process-level
+                # interrupt; the reaper fails/retries its inflight set.
+                w.proc.kill()
 
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
@@ -643,16 +707,109 @@ class NodeController:
                 self._unborrow_call_refs(rid)
             if w is not None:
                 for rid in msg.get("return_ids", []):
-                    w.inflight.pop(rid, None)
+                    done = w.inflight.pop(rid, None)
+                    if done is not None and done.get("direct"):
+                        # Finish the direct task's lineage record; resources
+                        # are empty — the lease keeps holding the share.
+                        try:
+                            self._gcs.send_oneway({
+                                "type": "task_done",
+                                "node_id": self.node_id,
+                                "task_id": done.get("task_id"),
+                                "resources": {},
+                            })
+                        except ConnectionError:
+                            pass
                 task = w.current_task
                 w.current_task = None
-                if w.actor_id is None:
+                if w.actor_id is None and w.lease_id is None:
                     w.idle = True
                     self._idle_event.set()
                 if task is not None:
                     self._release_local(task)
                     await self._release(task)
             return None
+
+        @s.handler("lease_worker")
+        async def lease_worker(msg, conn):
+            """Pin an idle worker to an owner's lease (reference: raylet
+            HandleRequestWorkerLease, node_manager.cc:1777). The owner then
+            pushes tasks straight at it via push_task — no GCS queue hop.
+            The cluster-side share was reserved by the owner's
+            request_placement; this acquires the matching LOCAL share."""
+            admit = {"resources": msg.get("resources", {})}
+            # Non-blocking: a lease is an optimization — when the node is
+            # saturated the owner just keeps using the queued path rather
+            # than holding an RPC open against the admission queue.
+            if not self._fits_local(admit["resources"]):
+                return {"ok": False, "error": "node busy"}
+            self._acquire_now(admit)
+            try:
+                worker = await self._pop_idle_worker(timeout=5.0)
+            except Exception as e:  # noqa: BLE001 - no worker: lease denied
+                self._release_local(admit)
+                return {"ok": False, "error": f"no idle worker: {e}"}
+            worker.lease_id = msg["lease_id"]
+            # conn kept so worker death can notify the owner (lease_lost):
+            # the controller stays reachable, so no connection error would.
+            self._leases[msg["lease_id"]] = {
+                "worker": worker, "task": admit, "conn": conn}
+            return {"ok": True, "node_id": self.node_id}
+
+        @s.handler("push_task")
+        async def push_task(msg, conn):
+            """Owner-pushed task for a leased worker (reference: the owner's
+            PushTask straight to the leased worker,
+            direct_task_transport.cc OnWorkerIdle). One-way: the result
+            surfaces through the object store/directory as usual; failures
+            route through the GCS record the owner wrote first."""
+            lease = self._leases.get(msg["lease_id"])
+            w = None if lease is None else lease["worker"]
+            task = _payload(msg)
+            task["direct"] = True
+            if w is None or w.conn is None:
+                # Lease vanished (worker death raced the push). The task
+                # never ran, so requeue it through its GCS record WITHOUT
+                # burning a retry; tell the owner so it stops pushing here.
+                try:
+                    await conn.send({"type": "lease_lost",
+                                     "lease_id": msg["lease_id"]})
+                except Exception:  # noqa: BLE001
+                    pass
+                await self._requeue_direct(task)
+                return None
+            if msg.get("return_ids"):
+                w.inflight[msg["return_ids"][0]] = task
+            await w.conn.send(dict(task, type="execute_task"))
+            return None
+
+        @s.handler("release_lease")
+        async def release_lease(msg, conn):
+            """Owner returns its leased worker (idle timeout or shutdown)."""
+            lease = self._leases.pop(msg["lease_id"], None)
+            if lease is None:
+                return {"ok": True}
+            w = lease["worker"]
+            if w.lease_id == msg["lease_id"]:
+                w.lease_id = None
+                # Only idle the worker when nothing it was pushed is still
+                # running; otherwise a queued task would be dispatched onto
+                # it and the direct task's task_done would prematurely
+                # finish the queued one. task_done idles it on completion
+                # (lease_id is None by then).
+                if w.conn is not None and w.actor_id is None \
+                        and not w.inflight:
+                    w.idle = True
+                    self._idle_event.set()
+            self._release_local(lease["task"])
+            try:
+                self._gcs.send_oneway({
+                    "type": "release_resources", "node_id": self.node_id,
+                    "resources": lease["task"].get("resources", {}),
+                })
+            except ConnectionError:
+                pass
+            return {"ok": True}
 
         @s.handler("store_object")
         async def store_object(msg, conn):

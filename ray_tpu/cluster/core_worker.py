@@ -93,6 +93,13 @@ class ClusterCoreWorker:
         import uuid as _uuid
 
         self.worker_uid = _uuid.uuid4().hex
+        # Owner worker leases for direct push (reference: the per-
+        # SchedulingKey lease map in direct_task_transport.h:46): one lease
+        # per resource class; idle leases are returned by a janitor thread.
+        self._direct_lock = threading.Lock()
+        self._direct_leases: Dict[Tuple, Dict] = {}
+        self._direct_outstanding: Dict[bytes, float] = {}  # rid -> push time
+        self._direct_janitor: Any = None
         self._ref_lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
         self._ref_inc: List[bytes] = []
@@ -224,9 +231,21 @@ class ClusterCoreWorker:
         with self._controller_lock:
             client = self._controllers.get(addr)
             if client is None or client._closed:
-                client = RpcClient(*addr)
+                client = RpcClient(*addr,
+                                   push_handler=self._on_controller_push)
                 self._controllers[addr] = client
             return client
+
+    def _on_controller_push(self, msg: Dict) -> None:
+        """Unsolicited controller messages; currently lease-loss
+        notifications (the leased worker died while the controller stayed
+        reachable, so no connection error tells us)."""
+        if msg.get("type") == "lease_lost":
+            lease_id = msg.get("lease_id")
+            with self._direct_lock:
+                for key, lease in list(self._direct_leases.items()):
+                    if lease.get("lease_id") == lease_id:
+                        del self._direct_leases[key]
 
     def _home_controller(self) -> RpcClient:
         if self._home_addr is not None:
@@ -385,22 +404,184 @@ class ClusterCoreWorker:
             f"could not deliver task after {attempts} placements: {last_err}")
 
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
-        """Submit to the GCS task table; the GCS owns placement, dispatch,
-        and retry from here (reference: owner TaskManager + raylet lease,
-        collapsed into the central service that already runs the placement
-        kernel)."""
+        """Submit a task. Two paths (reference: direct task transport vs
+        the queued raylet path):
+
+        * **direct push** — dependency-free tasks, while few results are
+          outstanding, go straight to a worker this owner leased from a
+          node controller (one RPC hop, no GCS queue on the critical
+          path); a lineage record is sent to the GCS first so
+          worker-death retries / reconstruction still work;
+        * **queued** — everything else goes to the GCS task table, which
+          owns placement (batch kernel), dispatch, and retry.
+        """
         fn_id = self._export_fn(fn)
         args, kwargs, deps, pins = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
         resources = spec.resources.to_dict()
-        self._queue_submit({
+        payload = {
             "task_id": spec.task_id.binary(),
             "name": spec.function.repr_name,
             "fn_id": fn_id, "args": args, "kwargs": kwargs,
             "deps": deps, "pin_refs": pins, "return_ids": return_ids,
             "resources": resources, "max_retries": spec.max_retries,
-        })
+        }
+        if not deps and self.config.direct_call_enabled \
+                and self._direct_submit(payload):
+            return [ObjectRef(oid) for oid in spec.return_ids()]
+        self._queue_submit(payload)
         return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    # ------------------------------------------------------ direct push path
+    def _direct_submit(self, payload: Dict) -> bool:
+        """Push a dependency-free task to a leased worker; False => caller
+        uses the queued path. Never blocks on lease acquisition: a missing
+        lease is requested in the background so the NEXT submit hits it."""
+        key = tuple(sorted(payload["resources"].items()))
+        now = time.monotonic()
+        with self._direct_lock:
+            # Backlog guard: a leased worker executes serially, so a large
+            # fan-out belongs to the queued path where the kernel spreads it
+            # over the cluster. Stale entries (refs never get()ed) expire.
+            if len(self._direct_outstanding) >= \
+                    self.config.direct_call_max_outstanding:
+                for rid, t in list(self._direct_outstanding.items()):
+                    if now - t > 60.0:
+                        del self._direct_outstanding[rid]
+                if len(self._direct_outstanding) >= \
+                        self.config.direct_call_max_outstanding:
+                    return False
+            lease = self._direct_leases.get(key)
+            if lease is None or lease.get("acquiring"):
+                if lease is None:
+                    self._direct_leases[key] = {"acquiring": True}
+                    threading.Thread(
+                        target=self._acquire_lease, args=(key,),
+                        daemon=True).start()
+                return False
+            lease["last_used"] = now
+            for rid in payload["return_ids"]:
+                self._direct_outstanding[rid] = now
+        try:
+            # Record BEFORE push: when the leased worker dies mid-task the
+            # controller reports task_failed against this record and the
+            # GCS re-drives it on the queued path (max_retries preserved).
+            self.gcs.send_oneway(dict(
+                payload, type="record_direct_task",
+                node_id=lease["node_id"]))
+            lease["client"].send_oneway(dict(
+                payload, type="push_task", lease_id=lease["lease_id"]))
+            return True
+        except (ConnectionError, OSError):
+            with self._direct_lock:
+                self._direct_leases.pop(key, None)
+                for rid in payload["return_ids"]:
+                    self._direct_outstanding.pop(rid, None)
+            # The record may already be at the GCS: convert it into a
+            # queued task. If the record never arrived either (requeued
+            # False), fall back to a normal submission — returning True
+            # with no record anywhere would strand the ObjectRefs forever.
+            try:
+                resp = self.gcs.call({"type": "requeue_task",
+                                      "task_id": payload["task_id"]})
+                return bool(resp.get("requeued"))
+            except (ConnectionError, OSError):
+                return False
+
+    def _acquire_lease(self, key: Tuple) -> None:
+        """Background lease acquisition (one thread per resource class)."""
+        import uuid as _uuid
+
+        resources = dict(key)
+        placement = None
+        leased = False
+        try:
+            placement = self.gcs.call({
+                "type": "request_placement", "resources": resources,
+                "locality": None, "timeout": 10.0,
+            }, timeout=15.0)
+            addr = tuple(placement["address"])
+            lease_id = _uuid.uuid4().bytes
+            client = self._controller(addr)
+            resp = client.call({"type": "lease_worker",
+                                "lease_id": lease_id,
+                                "resources": resources}, timeout=15.0)
+            if not resp.get("ok", True):
+                raise RuntimeError(resp.get("error", "lease denied"))
+            leased = True
+            with self._direct_lock:
+                self._direct_leases[key] = {
+                    "lease_id": lease_id, "client": client,
+                    "addr": addr, "node_id": placement["node_id"],
+                    "last_used": time.monotonic(),
+                }
+            self._start_direct_janitor()
+        except Exception:  # noqa: BLE001 - lease denied: queued path serves
+            with self._direct_lock:
+                self._direct_leases.pop(key, None)
+        finally:
+            if placement is not None and not leased:
+                # Placement reserved a cluster-side share the lease never
+                # claimed: give it back.
+                try:
+                    self.gcs.send_oneway({
+                        "type": "release_resources",
+                        "node_id": placement["node_id"],
+                        "resources": resources})
+                except (ConnectionError, OSError):
+                    pass
+
+    def _start_direct_janitor(self) -> None:
+        with self._direct_lock:
+            if self._direct_janitor is not None:
+                return
+            self._direct_janitor = threading.Thread(
+                target=self._direct_janitor_loop, daemon=True)
+            self._direct_janitor.start()
+
+    def _direct_janitor_loop(self) -> None:
+        """Return idle leases (reference: lease returns on idle in
+        direct_task_transport.cc ReturnWorker)."""
+        while not self._ref_shutdown.wait(1.0):
+            idle_s = self.config.direct_lease_idle_s
+            now = time.monotonic()
+            to_release = []
+            with self._direct_lock:
+                if self._direct_outstanding:
+                    # Pushed work may still be running on a leased worker;
+                    # releasing now would idle that worker into the queued
+                    # dispatch pool mid-task.
+                    continue
+                for key, lease in list(self._direct_leases.items()):
+                    if lease.get("acquiring"):
+                        continue
+                    if now - lease["last_used"] > idle_s:
+                        to_release.append(lease)
+                        del self._direct_leases[key]
+            for lease in to_release:
+                self._release_lease(lease)
+
+    def _release_lease(self, lease: Dict) -> None:
+        try:
+            lease["client"].call({"type": "release_lease",
+                                  "lease_id": lease["lease_id"]},
+                                 timeout=10.0)
+        except Exception:  # noqa: BLE001 - node died: GCS reaps its shares
+            pass
+
+    def _direct_observed(self, oid: bytes) -> None:
+        """A result arrived: shrink the outstanding window."""
+        if self._direct_outstanding:
+            with self._direct_lock:
+                self._direct_outstanding.pop(oid, None)
+
+    def _release_all_leases(self) -> None:
+        with self._direct_lock:
+            leases, self._direct_leases = \
+                list(self._direct_leases.values()), {}
+        for lease in leases:
+            if not lease.get("acquiring"):
+                self._release_lease(lease)
 
     # ----------------------------------------------------------------- actors
     def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
@@ -714,6 +895,7 @@ class ClusterCoreWorker:
                 if blob is not None:
                     blobs[oid] = blob
                     pending.discard(oid)
+                    self._direct_observed(oid)
             if not pending:
                 break
             resp = self.gcs.call({"type": "locations_batch",
@@ -729,6 +911,7 @@ class ClusterCoreWorker:
                 if blob is not None:
                     blobs[oid] = blob
                     pending.discard(oid)
+                    self._direct_observed(oid)
             if not pending:
                 break
             if deadline is not None and time.monotonic() >= deadline:
@@ -759,6 +942,7 @@ class ClusterCoreWorker:
                     continue
                 if self._local_blob(oid) is not None:
                     ready.add(oid)
+                    self._direct_observed(oid)
                     continue
                 unknown.append(oid)
             if unknown:
@@ -857,6 +1041,7 @@ class ClusterCoreWorker:
 
     def shutdown(self):
         self._flush_submits()
+        self._release_all_leases()
         self._ref_shutdown.set()
         self._ref_dirty.set()  # unblock the flusher so it can exit
         self._flush_refs()

@@ -917,6 +917,63 @@ class GcsServer:
                     s.handler_stats.items(),
                     key=lambda kv: -kv[1][1])}}
 
+        @s.handler("record_direct_task")
+        async def record_direct_task(msg, conn):
+            """Lineage/FT record for a task the owner pushed straight to a
+            leased worker (reference: the direct task transport bypasses
+            the raylet/GCS dispatch path,
+            direct_task_transport.cc SubmitTask, while lineage still flows
+            through owner bookkeeping). No resources are reserved here (the
+            lease holds the node share) and no dispatch is driven; the
+            record exists so worker-death retries and lost-object
+            re-execution take the NORMAL queue path."""
+            payload = {k: v for k, v in msg.items()
+                       if k not in ("type", "rpc_id", "node_id")}
+            task_id = payload["task_id"]
+            if task_id in self.task_table:
+                return None
+            rec = {
+                "task_id": task_id, "payload": payload, "kind": "task",
+                "resources": payload.get("resources", {}),
+                "retries_left": payload.get("max_retries", 0),
+                "state": "DISPATCHED", "node_id": msg["node_id"],
+                "cancelled": False,
+                "return_ids": list(payload.get("return_ids", [])),
+            }
+            self.task_table[task_id] = rec
+            self._pin_deps(rec)
+            for oid in rec["return_ids"]:
+                self.lineage[oid] = task_id
+                self.error_objects.pop(oid, None)
+            # The record can lose the race against a fast task's own
+            # completion report (task_done found no record and dropped the
+            # finish). Completion evidence = every return object already
+            # registered; finish immediately so the record doesn't stay
+            # DISPATCHED forever (which would both block lost-object
+            # recovery and dodge the lineage eviction cap).
+            if rec["return_ids"] and all(oid in self.objects
+                                         for oid in rec["return_ids"]):
+                self._finish_record(task_id)
+            return None  # one-way
+
+        @s.handler("requeue_task")
+        async def requeue_task(msg, conn):
+            """An owner's direct push failed after its record landed (lease
+            connection died mid-send): re-drive the recorded task through
+            the normal queue. Reports whether anything was (or will be)
+            driven — a missing record means the caller must submit the task
+            itself, or its ObjectRefs would never resolve."""
+            rec = self.task_table.get(msg.get("task_id"))
+            if rec is None:
+                return {"ok": True, "requeued": False}
+            if rec["state"] == "DISPATCHED" and rec["kind"] == "task":
+                rec["state"] = "PENDING"
+                rec["node_id"] = None
+                self._spawn(self._drive_task(rec))
+            # FINISHED/PENDING/FAILED records need no action; the task ran,
+            # is running, or served its error.
+            return {"ok": True, "requeued": True}
+
         @s.handler("submit_batch")
         async def submit_batch(msg, conn):
             """Pipelined submissions: one RPC carries many task specs.

@@ -36,9 +36,11 @@ function bar(pct) {
          `height:10px"></div></div> ${p}%`;
 }
 async function refresh() {
-  const [nodes, actors, objects, resources, tasks, nstats] = await Promise.all(
-    ["nodes","actors","objects","resources","tasks","node_stats"].map(
-      p => fetch("/api/" + p).then(r => r.json())));
+  const [nodes, actors, objects, resources, tasks, nstats, memory, serve] =
+    await Promise.all(
+      ["nodes","actors","objects","resources","tasks","node_stats",
+       "memory","serve"].map(
+        p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th><th>workers (pid: cpu%, MB)</th></tr>";
   for (const [nid, s] of Object.entries(nstats)) {
@@ -72,6 +74,34 @@ async function refresh() {
     h += `<tr><td>${id.slice(0,16)}</td><td class=num>${o.size_bytes ?? o.size}</td>` +
          `<td>${o.has_error ?? ""}</td></tr>`;
   h += "</table>";
+  // memory / reference-accounting view (`ray memory` analogue)
+  const mem = Object.entries(memory);
+  const totalBytes = mem.reduce((a, [,m]) => a + (m.size||0), 0);
+  h += `<h2>memory (${mem.length} tracked objects, ` +
+       `${(totalBytes/1048576).toFixed(1)} MB)</h2>` +
+       "<table><tr><th>object</th><th>size</th><th>holders</th>" +
+       "<th>task pins</th><th>children</th><th>in directory</th></tr>";
+  for (const [id, m] of mem.slice(0, 50))
+    h += `<tr><td>${id.slice(0,16)}</td><td class=num>${m.size}</td>` +
+         `<td>${(m.holders||[]).map(x => x.slice(0,10)).join(" ")}</td>` +
+         `<td class=num>${m.task_pins}</td>` +
+         `<td class=num>${m.contained_children}</td>` +
+         `<td>${m.in_directory}</td></tr>`;
+  h += "</table>";
+  // serve stats when a serve control plane is running
+  if (serve && Object.keys(serve).length) {
+    h += "<h2>serve</h2><table><tr><th>endpoint</th><th>routed</th>" +
+         "<th>errors</th><th>qps</th><th>p50 ms</th><th>p99 ms</th></tr>";
+    const eps = (serve.metrics || {}).endpoints || {};
+    for (const [ep, info] of Object.entries(serve.endpoints||{})) {
+      const m = eps[ep] || {};
+      h += `<tr><td>${ep}</td><td class=num>${info.routed}</td>` +
+           `<td class=num>${info.errors}</td><td class=num>${m.qps ?? "-"}</td>` +
+           `<td class=num>${m.latency_ms_p50 ?? "-"}</td>` +
+           `<td class=num>${m.latency_ms_p99 ?? "-"}</td></tr>`;
+    }
+    h += "</table>";
+  }
   document.getElementById("content").innerHTML = h;
 }
 refresh(); setInterval(refresh, 2000);
@@ -97,6 +127,25 @@ def _collect(endpoint: str):
     if endpoint == "tasks":
         core = global_worker().core
         return dict(getattr(core, "stats", {}) or {})
+    if endpoint == "memory":
+        # Reference-accounting view (reference: dashboard memory.py +
+        # `ray memory`): who holds each object, task pins, sizes. Cluster
+        # mode reads the GCS ref table; local mode derives an equivalent
+        # view from the in-process store.
+        core = global_worker().core
+        gcs = getattr(core, "gcs", None)
+        if gcs is not None:
+            try:
+                return gcs.call({"type": "ref_table", "limit": 500})["refs"]
+            except Exception:  # noqa: BLE001 - GCS restart window
+                return {}
+        out = {}
+        for oid, info in list(state.objects().items())[:500]:
+            out[oid] = {"holders": ["driver"], "task_pins": 0,
+                        "contained_children": 0,
+                        "size": info.get("size_bytes", info.get("size", 0)),
+                        "in_directory": True}
+        return out
     if endpoint == "metrics":
         from ..metrics import collect_all
 

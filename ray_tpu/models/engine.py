@@ -264,6 +264,21 @@ class GenerationEngine:
                 self.lengths[slot] = 0
         return events
 
+    def cancel(self, req_id: int) -> bool:
+        """Abandon a request: queued ones never run, active ones free their
+        slot this tick (the next _admit can reuse it), finished ones drop
+        their buffered output. Returns True if anything was cancelled."""
+        for i, r in enumerate(self.queue):
+            if r.req_id == req_id:
+                del self.queue[i]
+                return True
+        for slot, r in enumerate(self.active):
+            if r is not None and r.req_id == req_id:
+                self.active[slot] = None
+                self.lengths[slot] = 0
+                return True
+        return self.done.pop(req_id, None) is not None
+
     def run_until_done(self) -> Dict[int, List[int]]:
         while self.queue or any(r is not None for r in self.active):
             self.step()

@@ -178,16 +178,42 @@ def cmd_up(args) -> None:
     address, head_pid, log_path = _launch_head(
         head.get("resources", {"CPU": 4}), head.get("num_workers", 2))
     worker_pids = []
+    provider_nodes = []
     n_nodes = 0
-    for group_idx, group in enumerate(cfg.get("worker_nodes", [])):
-        for i in range(group.get("count", 1)):
-            worker_pids.append(_launch_worker_node(
-                address, group.get("resources", {"CPU": 4}),
-                group.get("num_workers", 2),
-                label=f"group{group_idx}-{i}"))
-            n_nodes += 1
+    provider_cfg = cfg.get("provider")
+    if provider_cfg:
+        # Cloud path (reference: ray up provisioning via NodeProvider —
+        # autoscaler/commands.py): e.g. {"type": "gce_tpu", "project": ...,
+        # "zone": ..., "accelerator_type": ..., "runtime_version": ...}.
+        # TPU VMs join the head via their startup script.
+        from ray_tpu.autoscaler.gce import make_provider
+        from ray_tpu.autoscaler.node_provider import (
+            STATUS_UP_TO_DATE, TAG_NODE_KIND, TAG_NODE_STATUS,
+        )
+
+        provider_cfg = dict(provider_cfg, gcs_address=address)
+        provider = make_provider(provider_cfg)
+        tags = {TAG_NODE_KIND: "worker", TAG_NODE_STATUS: STATUS_UP_TO_DATE}
+        for group in cfg.get("worker_nodes", [{}]):
+            provider.create_node(group, tags, group.get("count", 1))
+        provider_nodes = provider.non_terminated_nodes({})
+        n_nodes = len(provider_nodes)
+        # Subprocess nodes are owned by THIS process; record pids so
+        # `cli down` (a different process) can stop them. Cloud nodes are
+        # API-addressable and torn down through the provider instead.
+        if hasattr(provider, "_procs"):
+            worker_pids = [p.pid for p in provider._procs.values()]
+    else:
+        for group_idx, group in enumerate(cfg.get("worker_nodes", [])):
+            for i in range(group.get("count", 1)):
+                worker_pids.append(_launch_worker_node(
+                    address, group.get("resources", {"CPU": 4}),
+                    group.get("num_workers", 2),
+                    label=f"group{group_idx}-{i}"))
+                n_nodes += 1
     _save_session({"address": address, "head_pid": head_pid,
                    "worker_pids": worker_pids, "head_log": log_path,
+                   "provider": provider_cfg, "provider_nodes": provider_nodes,
                    "config": os.path.abspath(args.config)})
     print(f"cluster up: address={address} head_pid={head_pid} "
           f"worker_nodes={n_nodes}")
@@ -203,6 +229,26 @@ def cmd_down(args) -> None:
 def cmd_stop(args) -> None:
     state = _load_session()
     stopped = 0
+    # Cloud provider nodes (TPU VMs) are released through the provider API;
+    # local subprocess-provider nodes were recorded by pid at `up` time.
+    if (state.get("provider") or {}).get("type") == "gce_tpu":
+        try:
+            from ray_tpu.autoscaler.gce import make_provider
+
+            provider = make_provider(state["provider"])
+            # Union of the nodes recorded at `up` time and a live API query:
+            # the autoscaler may have launched more since (a TPU VM missed
+            # here keeps running AND billing).
+            nodes = set(state.get("provider_nodes") or [])
+            try:
+                nodes |= set(provider.non_terminated_nodes({}))
+            except Exception:  # noqa: BLE001 - API hiccup: use saved list
+                pass
+            for nid in nodes:
+                provider.terminate_node(nid)
+                stopped += 1
+        except Exception as e:  # noqa: BLE001 - still stop local processes
+            print(f"provider teardown failed: {e}")
     for pid in state.get("worker_pids", []) + (
             [state["head_pid"]] if "head_pid" in state else []):
         try:
@@ -396,6 +442,32 @@ def cmd_timeline(args) -> None:
           "then open the JSON in chrome://tracing or perfetto.")
 
 
+def cmd_dashboard(args) -> None:
+    """Serve the browsable HTML dashboard against the session's cluster
+    (reference: ray dashboard / the aiohttp dashboard started by ray
+    start). Blocks until Ctrl-C."""
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    address = args.address or _load_session().get("address")
+    if address:
+        ray_tpu.init(address=address)
+        print(f"connected to cluster at {address}")
+    else:
+        ray_tpu.init(num_cpus=os.cpu_count() or 4)
+        print("no running cluster; serving a local-mode dashboard")
+    dash = start_dashboard(port=args.port)
+    print(f"dashboard at {dash.url} (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        dash.stop()
+        ray_tpu.shutdown()
+
+
 def cmd_microbenchmark(args) -> None:
     """In-process perf microbenchmarks (reference: ray microbenchmark /
     ray_perf.py). Prints ops/s per pattern."""
@@ -496,6 +568,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp = sub.add_parser("timeline")
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("dashboard",
+                        help="serve the browsable HTML dashboard")
+    sp.add_argument("--address")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)

@@ -26,6 +26,36 @@ class ServeHandle:
         return self._router.route.remote(
             self._endpoint, self._method, args, kwargs)
 
+    def stream(self, *args, **kwargs):
+        """Generator of incremental results from a streaming backend.
+
+        Requires the backend to expose ``stream_start``/``stream_poll``
+        (e.g. serve.lm.LMBackend): yields each token as the replica's
+        engine produces it. Closing the generator early cancels the
+        server-side stream.
+        """
+        import ray_tpu
+
+        token = ray_tpu.get(self._router.route.remote(
+            self._endpoint, "stream_start", args, kwargs))
+        finished = False
+        try:
+            while True:
+                out = ray_tpu.get(self._router.route.remote(
+                    self._endpoint, "stream_poll", (token,), {}))
+                for t in out["tokens"]:
+                    yield t
+                if out["done"]:
+                    finished = True
+                    return
+        finally:
+            if not finished:
+                try:
+                    ray_tpu.get(self._router.route.remote(
+                        self._endpoint, "stream_cancel", (token,), {}))
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+
     def __repr__(self):
         return f"ServeHandle(endpoint={self._endpoint!r})"
 

@@ -26,6 +26,11 @@ class HTTPProxyActor:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Chunked transfer-coding is an HTTP/1.1 feature; the stdlib
+            # default of 1.0 would make strict clients (curl, Go) pass the
+            # raw chunk framing through to the body.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -55,12 +60,60 @@ class HTTPProxyActor:
                         kwargs = dict(body.get("kwargs", {}))
                     else:
                         args = (body,)
+                stream = bool(kwargs.pop("stream", False)) or \
+                    "stream=1" in (self.path.split("?", 1) + [""])[1]
                 try:
+                    if stream:
+                        self._stream(endpoint, args, kwargs)
+                        return
                     ref = proxy.router.route.remote(endpoint, "", args, kwargs)
                     result = ray_tpu.get(ref)
                     self._reply(200, {"result": result})
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": str(e)})
+
+            def _stream(self, endpoint: str, args, kwargs):
+                """Chunked transfer: one JSON line per engine tick, written
+                as tokens arrive (the shape an LM client needs). Requires a
+                backend with stream_start/stream_poll (serve.lm.LMBackend)."""
+                token = ray_tpu.get(proxy.router.route.remote(
+                    endpoint, "stream_start", args, kwargs))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(payload: bytes):
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))
+
+                try:
+                    while True:
+                        out = ray_tpu.get(proxy.router.route.remote(
+                            endpoint, "stream_poll", (token,), {}))
+                        if out["tokens"] or out["done"]:
+                            chunk(json.dumps(
+                                {"tokens": out["tokens"],
+                                 "done": out["done"]}).encode() + b"\n")
+                        if out["done"]:
+                            break
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client hung up mid-stream: free the engine slot.
+                    self._cancel_stream(endpoint, token)
+                except Exception as e:  # noqa: BLE001 - headers already sent
+                    self._cancel_stream(endpoint, token)
+                    try:
+                        chunk(json.dumps({"error": str(e)}).encode() + b"\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
+
+            def _cancel_stream(self, endpoint: str, token: str):
+                try:
+                    ray_tpu.get(proxy.router.route.remote(
+                        endpoint, "stream_cancel", (token,), {}))
+                except Exception:  # noqa: BLE001
+                    pass
 
             def _reply(self, code: int, payload):
                 try:

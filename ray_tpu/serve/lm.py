@@ -28,18 +28,32 @@ from .config import ServeRequest
 
 class LMBackend:
     """Class backend for `serve.create_backend`: generation with
-    cross-request continuous batching."""
+    cross-request continuous batching.
+
+    Streaming: ``stream_start`` submits a request and returns an opaque
+    stream token; ``stream_poll`` advances the shared engine one tick and
+    returns the tokens produced since the last poll. Streams and whole-
+    response batches share the same engine slots, so a streaming caller and
+    a batch caller decode in lockstep on the MXU (the router pins polls to
+    the replica that started the stream).
+    """
 
     def __init__(self, params: Any, cfg: Any, *, max_slots: int = 8,
                  eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
-                 max_seq: Optional[int] = None):
+                 max_seq: Optional[int] = None,
+                 stream_idle_timeout_s: float = 120.0):
         from ..models.engine import GenerationEngine
 
         self.engine = GenerationEngine(
             params, cfg, max_slots=max_slots, eos_id=eos_id,
             max_seq=max_seq)
         self.default_max_new_tokens = default_max_new_tokens
+        self.stream_idle_timeout_s = stream_idle_timeout_s
+        self._streams: dict = {}        # token -> engine req_id
+        self._stream_bufs: dict = {}    # req_id -> [undelivered tokens]
+        self._stream_done: set = set()  # req_ids whose last token is buffered
+        self._stream_seen: dict = {}    # token -> last poll/start time
 
     def _parse(self, r: ServeRequest):
         if len(r.args) > 2:
@@ -58,6 +72,19 @@ class LMBackend:
         seed = r.kwargs.get("seed")
         return prompt, n, temperature, seed
 
+    def _pump(self) -> None:
+        """One engine tick; capture every event that belongs to a stream so
+        interleaved whole-response batches can't swallow stream tokens."""
+        for rid, tok, done in self.engine.step():
+            buf = self._stream_bufs.get(rid)
+            if buf is not None:
+                buf.append(tok)
+                if done:
+                    self._stream_done.add(rid)
+                    # A stream's tokens live in its buffer; drop the
+                    # engine-side duplicate accumulated in done.
+                    self.engine.done.pop(rid, None)
+
     @accept_batch
     def __call__(self, requests: List[ServeRequest]) -> List[List[int]]:
         parsed = [self._parse(r) for r in requests]
@@ -70,6 +97,70 @@ class LMBackend:
                for p, n, t, s in parsed]
         pending = set(ids)
         while pending:
-            self.engine.step()
+            self._pump()
             pending -= self.engine.done.keys()
         return [self.engine.done.pop(rid) for rid in ids]
+
+    # ------------------------------------------------------------- streaming
+    def _expire_idle_streams(self) -> None:
+        """A poller that vanished without cancel (crashed client, SIGKILLed
+        proxy) must not occupy one of max_slots forever."""
+        import time
+
+        cutoff = time.monotonic() - self.stream_idle_timeout_s
+        for token, seen in list(self._stream_seen.items()):
+            if seen < cutoff:
+                self.stream_cancel(token)
+
+    def stream_start(self, prompt, max_new_tokens: Optional[int] = None,
+                     temperature: float = 0.0, seed=None) -> str:
+        import time
+        import uuid
+
+        self._expire_idle_streams()
+        prompt = list(prompt)
+        n = int(max_new_tokens if max_new_tokens is not None
+                else self.default_max_new_tokens)
+        self.engine.validate(prompt, n, float(temperature), seed)
+        rid = self.engine.submit(prompt, n, temperature=float(temperature),
+                                 seed=seed)
+        token = uuid.uuid4().hex
+        self._streams[token] = rid
+        self._stream_bufs[rid] = []
+        self._stream_seen[token] = time.monotonic()
+        return token
+
+    def stream_poll(self, token: str) -> dict:
+        """Return {"tokens": [...], "done": bool}: everything produced for
+        this stream since the last poll. Advances the engine at most one
+        tick per poll (and only when this stream has nothing buffered), so
+        a fast poller can't starve batch-mates of host cycles."""
+        import time
+
+        rid = self._streams.get(token)
+        if rid is None:
+            raise KeyError(f"unknown or finished stream {token!r}")
+        self._stream_seen[token] = time.monotonic()
+        self._expire_idle_streams()
+        if not self._stream_bufs.get(rid) and rid not in self._stream_done:
+            self._pump()
+        out = self._stream_bufs.get(rid, [])
+        self._stream_bufs[rid] = []
+        done = rid in self._stream_done
+        if done:
+            self._drop_stream(token, rid)
+        return {"tokens": out, "done": done}
+
+    def stream_cancel(self, token: str) -> bool:
+        rid = self._streams.get(token)
+        if rid is None:
+            return False
+        self.engine.cancel(rid)
+        self._drop_stream(token, rid)
+        return True
+
+    def _drop_stream(self, token: str, rid: int) -> None:
+        self._streams.pop(token, None)
+        self._stream_bufs.pop(rid, None)
+        self._stream_done.discard(rid)
+        self._stream_seen.pop(token, None)

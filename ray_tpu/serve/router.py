@@ -43,6 +43,11 @@ class Router:
         self.num_routed: Dict[str, int] = {}
         self.num_errors: Dict[str, int] = {}
         self.metrics = MetricRecorder()
+        # Stream affinity: a stream's state lives inside ONE replica, so
+        # every poll must hit the replica that started it.
+        # stream token -> (backend_tag, _Replica, last_used)
+        self._streams: Dict[str, list] = {}
+        self.stream_idle_timeout_s = 300.0
 
     # ---- control plane (called by ServeMaster) ----
 
@@ -123,7 +128,10 @@ class Router:
         self.num_routed[endpoint] = self.num_routed.get(endpoint, 0) + 1
         t0 = time.monotonic()
         try:
-            if b.queue is not None:
+            if method in ("stream_start", "stream_poll", "stream_cancel"):
+                result = await self._route_stream(
+                    endpoint, backend_tag, b, method, args, kwargs)
+            elif b.queue is not None:
                 fut = asyncio.get_event_loop().create_future()
                 await b.queue.put((method, args, kwargs, fut))
                 result = await fut
@@ -136,6 +144,46 @@ class Router:
             raise
         self.metrics.record(endpoint, backend_tag, time.monotonic() - t0)
         return result
+
+    async def _route_stream(self, endpoint: str, backend_tag: str,
+                            b: _Backend, method: str, args: tuple,
+                            kwargs: dict) -> Any:
+        """Streaming calls skip the batch queue (the engine batches streams
+        internally) and polls are pinned to the replica holding the
+        stream's state."""
+        # Abandoned streams (no poll-to-done, no cancel — e.g. a SIGKILLed
+        # caller) must not pin replica entries forever; replicas expire the
+        # engine slot themselves on the same kind of timeout.
+        now = time.monotonic()
+        for tok, ent in list(self._streams.items()):
+            if now - ent[2] > self.stream_idle_timeout_s:
+                del self._streams[tok]
+        if method == "stream_start":
+            r = self._next_replica(b)
+            token = await self._call_replica(r, method, args, kwargs)
+            self._streams[str(token)] = [backend_tag, r, time.monotonic()]
+            return token
+        token = str(args[0]) if args else str(kwargs.get("token"))
+        entry = self._streams.get(token)
+        if entry is None:
+            raise KeyError(f"unknown or finished stream {token!r}")
+        entry[2] = time.monotonic()
+        r = entry[1]
+        out = await self._call_replica(r, method, args, kwargs)
+        if method == "stream_cancel" or (
+                isinstance(out, dict) and out.get("done")):
+            self._streams.pop(token, None)
+        return out
+
+    async def _call_replica(self, r: _Replica, method: str, args: tuple,
+                            kwargs: dict) -> Any:
+        async with r.sem:
+            r.inflight += 1
+            try:
+                return await r.handle.handle_request.remote(
+                    method, args, kwargs)
+            finally:
+                r.inflight -= 1
 
     def _pick_backend(self, traffic: Dict[str, float]) -> str:
         tags = list(traffic.keys())
@@ -159,13 +207,8 @@ class Router:
 
     async def _call_one(self, b: _Backend, method: str, args: tuple,
                         kwargs: dict) -> Any:
-        r = self._next_replica(b)
-        async with r.sem:
-            r.inflight += 1
-            try:
-                return await r.handle.handle_request.remote(method, args, kwargs)
-            finally:
-                r.inflight -= 1
+        return await self._call_replica(
+            self._next_replica(b), method, args, kwargs)
 
     async def _batch_loop(self, backend_tag: str, b: _Backend) -> None:
         max_bs = int(b.config.get("max_batch_size", 1))

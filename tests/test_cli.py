@@ -101,6 +101,40 @@ def test_cli_up_down(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_up_down_provider_config(tmp_path):
+    """`up` with a provider block provisions worker nodes through the
+    NodeProvider surface (here: subprocess provider; gce_tpu shares the
+    exact code path with the API transport swapped in)."""
+    import json
+    import time as _time
+
+    env = _cli_env(tmp_path)
+    cfg = tmp_path / "cluster.json"
+    cfg.write_text(json.dumps({
+        "head": {"resources": {"CPU": 2}, "num_workers": 1},
+        "provider": {"type": "subprocess",
+                     "worker_resources": {"CPU": 2},
+                     "workers_per_node": 1},
+        "worker_nodes": [{"count": 1}],
+    }))
+    up = _cli(env, "up", str(cfg))
+    assert up.returncode == 0, (up.stdout, up.stderr)
+    assert "worker_nodes=1" in up.stdout
+    try:
+        deadline = _time.time() + 60
+        alive = 0
+        while _time.time() < deadline and alive < 2:
+            st = _cli(env, "status")
+            if st.returncode == 0 and "nodes:" in st.stdout:
+                alive = int(st.stdout.split("nodes:")[1].split()[0])
+            _time.sleep(1.0)
+        assert alive >= 2, st.stdout
+    finally:
+        down = _cli(env, "down", timeout=60)
+        assert down.returncode == 0
+
+
+@pytest.mark.slow
 def test_cli_memory_refs_view(tmp_path):
     """`memory --refs` surfaces the GCS reference table (holders + pins)."""
     env = _cli_env(tmp_path)

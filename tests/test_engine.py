@@ -128,3 +128,91 @@ def test_per_request_temperature_sampling():
     assert samp_a != samp_c
     for t in samp_a + samp_c:
         assert 0 <= t < cfg.vocab_size
+
+
+def test_lm_backend_token_streaming(local_ray):
+    """serve handle.stream() yields tokens incrementally and matches the
+    whole-response greedy continuation; early close cancels server-side."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    serve.init()
+    try:
+        serve.create_backend(
+            "lm:stream", LMBackend, params, cfg,
+            config=BackendConfig(max_concurrent_queries=8))
+        serve.create_endpoint("gen_s", backend="lm:stream")
+        h = serve.get_handle("gen_s")
+
+        # streamed tokens == whole-response greedy continuation
+        streamed = list(h.stream([1, 2, 3], max_new_tokens=5))
+        assert streamed == _ref(params, cfg, [1, 2, 3], 5)
+
+        # two concurrent streams interleave on shared engine slots and each
+        # still gets its exact continuation
+        g1 = h.stream([2, 3, 4], max_new_tokens=4)
+        g2 = h.stream([5, 6], max_new_tokens=4)
+        out1, out2 = [], []
+        for a, b in zip(g1, g2):
+            out1.append(a)
+            out2.append(b)
+        assert out1 == _ref(params, cfg, [2, 3, 4], 4)
+        assert out2 == _ref(params, cfg, [5, 6], 4)
+
+        # early close cancels: the engine slot frees for the next request
+        g = h.stream([1, 2], max_new_tokens=30)
+        first = next(g)
+        assert first == _ref(params, cfg, [1, 2], 1)[0]
+        g.close()
+        # follow-up request completes promptly => slot was reclaimed
+        assert list(h.stream([3, 4], max_new_tokens=3)) == \
+            _ref(params, cfg, [3, 4], 3)
+    finally:
+        serve.shutdown()
+
+
+def test_http_streaming_chunked(local_ray):
+    """HTTP ingress streams tokens as NDJSON chunks."""
+    import json as _json
+    import urllib.request
+
+    import jax as _jax
+    from ray_tpu import serve
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = _cfg()
+    params = init_params(_jax.random.PRNGKey(0), cfg)
+    serve.init(http_port=0)
+    try:
+        serve.create_backend(
+            "lm:http", LMBackend, params, cfg,
+            config=BackendConfig(max_concurrent_queries=8))
+        serve.create_endpoint("gen_h", backend="lm:http", route="/generate",
+                              methods=["POST"])
+        addr = serve.http_address()
+        body = _json.dumps({"args": [[1, 2, 3]],
+                            "kwargs": {"max_new_tokens": 4,
+                                       "stream": True}}).encode()
+        req = urllib.request.Request(
+            f"{addr}/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        toks, saw_incremental = [], 0
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers.get("Content-Type") == "application/x-ndjson"
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                chunk = _json.loads(line)
+                assert "error" not in chunk, chunk
+                toks.extend(chunk["tokens"])
+                saw_incremental += 1
+                if chunk["done"]:
+                    break
+        assert toks == _ref(params, cfg, [1, 2, 3], 4)
+        assert saw_incremental >= 2  # arrived over multiple chunks
+    finally:
+        serve.shutdown()

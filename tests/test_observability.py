@@ -38,10 +38,53 @@ def test_dashboard_serves_state(local_ray):
         assert res["total"]["CPU"] > 0
         tasks = get("/api/tasks")
         assert tasks["tasks_finished"] >= 1
+        # memory/ref view (`ray memory` analogue): the put object shows up
+        # with its holder + size
+        mem = get("/api/memory")
+        entry = mem.get(ref.hex())
+        assert entry is not None and entry["size"] > 0, mem
+        assert entry["holders"], entry
         html = urllib.request.urlopen(dash.url, timeout=10).read().decode()
         assert "ray_tpu dashboard" in html
+        assert "memory" in html  # ref view section is part of the page
     finally:
         dash.stop()
+
+
+def test_dashboard_memory_view_cluster():
+    """Cluster mode: /api/memory surfaces the GCS ref table (holders/pins),
+    the same data as `cli memory --refs`."""
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.dashboard import start_dashboard
+
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        ray_tpu.init(address=cluster.address)
+        import numpy as np
+
+        ref = ray_tpu.put(np.zeros(100_000))
+        dash = start_dashboard()
+        try:
+            # Holder registration is a batched one-way (20 ms flush):
+            # retry briefly rather than assert on the first snapshot.
+            deadline = time.time() + 10
+            entry = None
+            while time.time() < deadline:
+                with urllib.request.urlopen(f"{dash.url}/api/memory",
+                                            timeout=10) as r:
+                    mem = json.loads(r.read())
+                entry = mem.get(ref.hex())
+                if entry and entry["holders"]:
+                    break
+                time.sleep(0.2)
+            assert entry is not None, list(mem)[:5]
+            assert entry["size"] >= 100_000 * 8
+            assert entry["holders"], entry
+        finally:
+            dash.stop()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
 
 
 def test_serve_master_crash_recovery(local_ray):
