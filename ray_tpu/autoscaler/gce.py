@@ -93,6 +93,12 @@ class GCETPUNodeProvider(NodeProvider):
         self.project = provider_config["project"]
         self.zone = provider_config["zone"]
         self.prefix = provider_config.get("name_prefix", "ray-tpu-worker")
+        # Cluster-scoping label (reference: the autoscaler's cluster-name
+        # tag): every node this provider creates carries it and every
+        # list/terminate filters by it, so two clusters sharing a
+        # project+zone — or unrelated TPU VMs — are never touched.
+        self.cluster_name = _sanitize_label(
+            provider_config.get("cluster_name", "ray-tpu"))
         self.transport: Callable = provider_config.get(
             "transport", default_transport)
         self._lock = threading.Lock()
@@ -138,6 +144,7 @@ class GCETPUNodeProvider(NodeProvider):
     def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
         wanted = {_sanitize_label(k): _sanitize_label(v)
                   for k, v in tag_filters.items()}
+        wanted["ray-tpu-cluster"] = self.cluster_name
         out = []
         for node in self._list():
             if node.get("state") not in _RUNNING_STATES:
@@ -171,6 +178,7 @@ class GCETPUNodeProvider(NodeProvider):
         cfg = self.provider_config
         labels = {_sanitize_label(k): _sanitize_label(v)
                   for k, v in tags.items()}
+        labels["ray-tpu-cluster"] = self.cluster_name
         for _ in range(count):
             with self._lock:
                 node_id = f"{self.prefix}-{self._next}-{int(time.time())}"
@@ -197,22 +205,21 @@ class GCETPUNodeProvider(NodeProvider):
             pass  # already gone
 
 
-PROVIDER_TYPES = {
-    "gce_tpu": GCETPUNodeProvider,
-}
+def _provider_types() -> Dict[str, type]:
+    from .node_provider import MockProvider, SubprocessProvider
+
+    return {"gce_tpu": GCETPUNodeProvider,
+            "subprocess": SubprocessProvider,
+            "mock": MockProvider}
 
 
 def make_provider(provider_config: Dict[str, Any]) -> NodeProvider:
     """Provider factory for config files (``cli up`` / monitor):
     {"type": "gce_tpu" | "subprocess" | "mock", ...}."""
-    from .node_provider import MockProvider, SubprocessProvider
-
+    types = _provider_types()
     ptype = provider_config.get("type", "subprocess")
-    if ptype == "gce_tpu":
-        return GCETPUNodeProvider(provider_config)
-    if ptype == "subprocess":
-        return SubprocessProvider(provider_config)
-    if ptype == "mock":
-        return MockProvider(provider_config)
-    raise ValueError(f"unknown provider type {ptype!r} "
-                     f"(expected gce_tpu | subprocess | mock)")
+    cls = types.get(ptype)
+    if cls is None:
+        raise ValueError(f"unknown provider type {ptype!r} "
+                         f"(expected {' | '.join(sorted(types))})")
+    return cls(provider_config)

@@ -546,6 +546,41 @@ class NodeController:
         for oid in task["return_ids"]:
             await self._store_put(oid, error_blob)
 
+    def _drop_lease(self, lease_id: bytes) -> None:
+        """Return a lease's worker + local/cluster shares. Shared by the
+        release_lease RPC and owner-disconnect reaping."""
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        w = lease["worker"]
+        if w.lease_id == lease_id:
+            w.lease_id = None
+            # Only idle the worker when nothing it was pushed is still
+            # running; otherwise a queued task would be dispatched onto it
+            # and the direct task's task_done would prematurely finish the
+            # queued one. task_done idles it on completion (lease_id is
+            # None by then).
+            if w.conn is not None and w.actor_id is None \
+                    and not w.inflight:
+                w.idle = True
+                self._idle_event.set()
+        self._release_local(lease["task"])
+        try:
+            self._gcs.send_oneway({
+                "type": "release_resources", "node_id": self.node_id,
+                "resources": lease["task"].get("resources", {}),
+            })
+        except ConnectionError:
+            pass
+
+    def _on_conn_lost(self, conn) -> None:
+        """A client connection dropped: reap any worker leases it owned —
+        a crashed driver must not pin workers and resource shares forever
+        (reference: lease reclamation on owner death)."""
+        for lease_id, lease in list(self._leases.items()):
+            if lease.get("conn") is conn:
+                self._drop_lease(lease_id)
+
     async def _requeue_direct(self, task: Dict) -> None:
         """Re-drive a never-executed direct task through its GCS lineage
         record without burning a retry. The record travels owner->GCS while
@@ -681,6 +716,7 @@ class NodeController:
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
         s = self.server
+        s.on_disconnect(self._on_conn_lost)
 
         @s.handler("register_worker")
         async def register_worker(msg, conn):
@@ -722,7 +758,12 @@ class NodeController:
                             pass
                 task = w.current_task
                 w.current_task = None
-                if w.actor_id is None and w.lease_id is None:
+                # not w.inflight: a lease released mid-run leaves later
+                # direct pushes still executing — idling then would let a
+                # queued task be dispatched behind them and prematurely
+                # "finished" by their task_done.
+                if w.actor_id is None and w.lease_id is None \
+                        and not w.inflight:
                     w.idle = True
                     self._idle_event.set()
                 if task is not None:
@@ -786,29 +827,7 @@ class NodeController:
         @s.handler("release_lease")
         async def release_lease(msg, conn):
             """Owner returns its leased worker (idle timeout or shutdown)."""
-            lease = self._leases.pop(msg["lease_id"], None)
-            if lease is None:
-                return {"ok": True}
-            w = lease["worker"]
-            if w.lease_id == msg["lease_id"]:
-                w.lease_id = None
-                # Only idle the worker when nothing it was pushed is still
-                # running; otherwise a queued task would be dispatched onto
-                # it and the direct task's task_done would prematurely
-                # finish the queued one. task_done idles it on completion
-                # (lease_id is None by then).
-                if w.conn is not None and w.actor_id is None \
-                        and not w.inflight:
-                    w.idle = True
-                    self._idle_event.set()
-            self._release_local(lease["task"])
-            try:
-                self._gcs.send_oneway({
-                    "type": "release_resources", "node_id": self.node_id,
-                    "resources": lease["task"].get("resources", {}),
-                })
-            except ConnectionError:
-                pass
+            self._drop_lease(msg["lease_id"])
             return {"ok": True}
 
         @s.handler("store_object")

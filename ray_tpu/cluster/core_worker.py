@@ -474,9 +474,16 @@ class ClusterCoreWorker:
             return True
         except (ConnectionError, OSError):
             with self._direct_lock:
-                self._direct_leases.pop(key, None)
+                dead = self._direct_leases.pop(key, None)
                 for rid in payload["return_ids"]:
                     self._direct_outstanding.pop(rid, None)
+            if dead is not None and not dead.get("acquiring"):
+                # Best-effort controller-side release: if only the GCS leg
+                # failed, the controller is still holding a worker + shares
+                # for this lease (the controller also reaps leases when the
+                # owner's connection drops).
+                threading.Thread(target=self._release_lease, args=(dead,),
+                                 daemon=True).start()
             # The record may already be at the GCS: convert it into a
             # queued task. If the record never arrived either (requeued
             # False), fall back to a normal submission — returning True

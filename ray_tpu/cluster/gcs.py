@@ -105,6 +105,14 @@ class GcsServer:
         self._contained: Dict[bytes, List[bytes]] = {}
         self._error_order: Any = _deque()
         self._finished_order: Any = _deque()
+        # task_done reports that arrived before their task had any record
+        # (a direct push's one-way record can lose the race against a
+        # sub-millisecond task): remembered so record_direct_task can
+        # finish the record on arrival instead of leaving it DISPATCHED
+        # forever (which would both dodge lineage eviction and let node-
+        # death reconciliation re-drive a completed task).
+        self._early_task_done: Set[bytes] = set()
+        self._early_task_done_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
         self.node_stats: Dict[str, Dict[str, Any]] = {}  # reporter data
         self._place_event = asyncio.Event()
@@ -947,12 +955,16 @@ class GcsServer:
                 self.error_objects.pop(oid, None)
             # The record can lose the race against a fast task's own
             # completion report (task_done found no record and dropped the
-            # finish). Completion evidence = every return object already
-            # registered; finish immediately so the record doesn't stay
-            # DISPATCHED forever (which would both block lost-object
-            # recovery and dodge the lineage eviction cap).
-            if rec["return_ids"] and all(oid in self.objects
-                                         for oid in rec["return_ids"]):
+            # finish — it left a marker in _early_task_done). Registered
+            # return objects are secondary evidence (their one-way
+            # registrations can themselves lag on a batch timer). Finish
+            # immediately so the record doesn't stay DISPATCHED forever
+            # (which would both block lost-object recovery and let node-
+            # death reconciliation re-drive a completed task).
+            if task_id in self._early_task_done or (
+                    rec["return_ids"] and all(oid in self.objects
+                                              for oid in rec["return_ids"])):
+                self._early_task_done.discard(task_id)
                 self._finish_record(task_id)
             return None  # one-way
 
@@ -1060,6 +1072,16 @@ class GcsServer:
             # task was re-driven elsewhere) must not flip the state.
             if rec is not None and rec["node_id"] == msg["node_id"]:
                 self._finish_record(msg["task_id"])
+            elif rec is None and msg.get("task_id"):
+                # Completion beat the owner's direct-task record here:
+                # remember it so the record finishes on arrival.
+                tid = msg["task_id"]
+                if tid not in self._early_task_done:
+                    self._early_task_done.add(tid)
+                    self._early_task_done_order.append(tid)
+                    while len(self._early_task_done_order) > 10_000:
+                        self._early_task_done.discard(
+                            self._early_task_done_order.popleft())
             return None  # one-way
 
         @s.handler("task_failed")

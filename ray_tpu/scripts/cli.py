@@ -192,6 +192,11 @@ def cmd_up(args) -> None:
         )
 
         provider_cfg = dict(provider_cfg, gcs_address=address)
+        # Scope cloud nodes to THIS cluster (cluster-name label) so stop/
+        # down can never touch another cluster's VMs in the same zone.
+        provider_cfg.setdefault("cluster_name", cfg.get(
+            "cluster_name",
+            os.path.splitext(os.path.basename(args.config))[0]))
         provider = make_provider(provider_cfg)
         tags = {TAG_NODE_KIND: "worker", TAG_NODE_STATUS: STATUS_UP_TO_DATE}
         for group in cfg.get("worker_nodes", [{}]):

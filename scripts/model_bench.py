@@ -167,6 +167,36 @@ def _detect_peak_tflops(default: float = 275.0) -> float:
     return default
 
 
+def bench_decode_truncation(*, pool: int = 4096, short_len: int = 128,
+                            batch: int = 8, heads: int = 16,
+                            d_head: int = 128, iters: int = 50):
+    """A/B the flash-decode DMA truncation: short sequences in a large KV
+    pool, full-pool sweep vs length-clamped sweep (r3 verdict item 5).
+    Decode is HBM-bound, so the win should approach pool/short_len."""
+    from ray_tpu.ops.attention import decode_attention
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, heads, d_head), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, pool, 1, d_head), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, pool, 1, d_head), jnp.bfloat16)
+    lens = jnp.full((batch,), short_len, jnp.int32)
+
+    out = {"pool": pool, "short_len": short_len, "batch": batch}
+    for name, trunc in (("full_sweep", False), ("truncated", True)):
+        fn = jax.jit(lambda q, k, v, ln, t=trunc: decode_attention(
+            q, k, v, ln, truncate_dma=t))
+        fn(q, k, v, lens).block_until_ready()
+        t0 = time.time()
+        for _ in range(iters):
+            r = fn(q, k, v, lens)
+        r.block_until_ready()
+        us = (time.time() - t0) / iters * 1e6
+        out[name + "_us"] = round(us, 1)
+    if out.get("truncated_us"):
+        out["speedup"] = round(out["full_sweep_us"] / out["truncated_us"], 2)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
@@ -222,6 +252,14 @@ def main():
         except Exception as e:  # noqa: BLE001 - keep the attention results
             out["decode"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"# decode failed: {e}", file=sys.stderr)
+        try:
+            out["decode_dma_truncation"] = bench_decode_truncation()
+            print(f"# decode_dma_truncation: {out['decode_dma_truncation']}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            out["decode_dma_truncation"] = {
+                "error": f"{type(e).__name__}: {e}"}
+            print(f"# decode truncation A/B failed: {e}", file=sys.stderr)
     path = args.out or os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MODEL_BENCH.json")
     with open(path, "w") as f:

@@ -178,3 +178,28 @@ def test_flash_decode_mqa():
     """MQA (KH=1): all heads in one kernel row-block."""
     _decode_vs_reference(B=2, H=8, KH=1, D=128, S=16, block_k=8,
                          lengths=[3, 15])
+
+
+def test_flash_decode_truncated_vs_full_sweep():
+    """The DMA-truncating index map (scalar-prefetch clamp) is numerically
+    identical to the full-pool sweep — only the HBM traffic differs."""
+    import numpy as np
+
+    from ray_tpu.ops import attention as att
+
+    key = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(key, 3)
+    B, H, KH, D, S, bk = 4, 8, 1, 128, 32, 8
+    q = jax.random.normal(kq, (B, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, KH, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, KH, D), jnp.float32)
+    lens = jnp.asarray([0, 5, 17, 31], jnp.int32)
+
+    att._INTERPRET = jax.default_backend() != "tpu"
+    try:
+        full = att._flash_decode(q, k, v, lens, bk, truncate_dma=False)
+        trunc = att._flash_decode(q, k, v, lens, bk, truncate_dma=True)
+    finally:
+        att._INTERPRET = False
+    np.testing.assert_allclose(np.asarray(trunc), np.asarray(full),
+                               atol=1e-6, rtol=1e-6)
