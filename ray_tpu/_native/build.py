@@ -31,6 +31,39 @@ def _needs_build(src: str, out: str) -> bool:
     return newest > os.path.getmtime(out)
 
 
+def build_c_api() -> Optional[str]:
+    """Build the embeddable C frontend (src/capi.cc + CPython) into
+    _build/libray_tpu_c.so; returns the path, or None on failure.
+
+    Not dlopen'd here — the consumer is a C/C++ program linking
+    -lray_tpu_c against include/ray_tpu_c.h (see tests/native/test_capi.c).
+    """
+    import sysconfig
+
+    src = os.path.join(_SRC_DIR, "capi.cc")
+    out = os.path.join(_BUILD_DIR, "libray_tpu_c.so")
+    try:
+        if _needs_build(src, out):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            inc = sysconfig.get_paths()["include"]
+            own_inc = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "include")
+            libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+            pylib = "python" + (sysconfig.get_config_var("VERSION") or "3")
+            tmp = out + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-Wall",
+                 f"-I{inc}", f"-I{own_inc}", "-o", tmp, src,
+                 f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-l{pylib}",
+                 "-lpthread"],
+                check=True, capture_output=True, timeout=180,
+            )
+            os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def load_native_library(name: str) -> Optional[ctypes.CDLL]:
     """Builds (if stale) and dlopens src/<name>.cc -> _build/lib<name>.so."""
     with _lock:

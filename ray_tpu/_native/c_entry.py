@@ -1,0 +1,92 @@
+"""JSON bridge behind the C frontend (ray_tpu/_native/src/capi.cc).
+
+Reference counterpart: the runtime glue under cpp/src/ray/runtime/ that
+backs cpp/include/ray/api.h. The C library embeds CPython and calls these
+helpers with plain strings; every value crossing the C boundary is JSON, so
+non-Python callers never see pickles.
+
+Refs handed to C are tracked here (hex -> ObjectRef) both to keep the
+distributed refcount alive while C holds the handle and so get/wait can
+resolve hexes without re-deriving ownership.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Dict
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.object_ref import ObjectRef
+
+_refs: Dict[str, ObjectRef] = {}
+
+
+def _track(ref: ObjectRef) -> str:
+    h = ref.hex()
+    _refs[h] = ref
+    return h
+
+
+def _resolve(ref_hex: str) -> ObjectRef:
+    ref = _refs.get(ref_hex)
+    if ref is None:
+        ref = ObjectRef(ObjectID(bytes.fromhex(ref_hex)))
+        _refs[ref_hex] = ref
+    return ref
+
+
+def init(address: str) -> bool:
+    if address:
+        ray_tpu.init(address=address)
+    else:
+        ray_tpu.init()
+    return True
+
+
+def shutdown() -> bool:
+    _refs.clear()
+    ray_tpu.shutdown()
+    return True
+
+
+def put_json(payload: str) -> str:
+    return _track(ray_tpu.put(json.loads(payload)))
+
+
+def get_json(ref_hex: str, timeout: float) -> str:
+    value = ray_tpu.get(_resolve(ref_hex),
+                        timeout=None if timeout <= 0 else timeout)
+    return json.dumps(value)
+
+
+def submit(entrypoint: str, args_json: str, num_cpus: float) -> str:
+    """entrypoint = "module:function", importable on the workers (functions
+    pickle by reference, so any installed module works — e.g.
+    "operator:add")."""
+    mod_name, sep, fn_name = entrypoint.partition(":")
+    if not sep or not mod_name or not fn_name:
+        raise ValueError(
+            f"entrypoint must be 'module:function', got {entrypoint!r}")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    if num_cpus and num_cpus > 0:
+        remote_fn = ray_tpu.remote(num_cpus=num_cpus)(fn)
+    else:
+        remote_fn = ray_tpu.remote(fn)
+    return _track(remote_fn.remote(*json.loads(args_json)))
+
+
+def release(ref_hex: str) -> bool:
+    """Drop the C side's handle; the ObjectRef's __del__ decrements the
+    distributed refcount. Long-running C clients call this per finished
+    ref or the results stay pinned cluster-wide until shutdown."""
+    return _refs.pop(ref_hex, None) is not None
+
+
+def wait(refs_json: str, num_returns: int, timeout: float) -> int:
+    refs = [_resolve(h) for h in json.loads(refs_json)]
+    ready, _ = ray_tpu.wait(
+        refs, num_returns=num_returns,
+        timeout=None if timeout <= 0 else timeout)
+    return len(ready)

@@ -1,0 +1,60 @@
+/* C frontend for the ray_tpu cluster (layer-7 native-language API).
+ *
+ * Reference counterpart: cpp/include/ray/api.h (Ray::Init / Ray::Put /
+ * Ray::Get / Ray::Task(...).Remote()). The execution substrate here is the
+ * Python+jax worker, so remote calls name an importable Python entrypoint
+ * ("module:function") and values cross the boundary as JSON — a C program
+ * can orchestrate cluster compute without any Python in its own source.
+ *
+ * Thread-safe: every call acquires the embedded interpreter's GIL.
+ * Error handling: functions return NULL / -1 on failure;
+ * ray_tpu_last_error() returns a description (thread-shared, read soon).
+ *
+ * Strings returned by ray_tpu_* are malloc'd; free with ray_tpu_free().
+ */
+
+#ifndef RAY_TPU_C_H
+#define RAY_TPU_C_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Connect to a cluster ("host:port") or start a local runtime (NULL/"").
+ * Returns 0 on success. */
+int ray_tpu_init(const char *address);
+
+/* Disconnect and tear down the runtime. Returns 0 on success. */
+int ray_tpu_shutdown(void);
+
+/* Store a JSON-encoded value; returns the object ref as a hex string. */
+char *ray_tpu_put_json(const char *json);
+
+/* Fetch an object as JSON. timeout_s <= 0 waits forever. */
+char *ray_tpu_get_json(const char *ref_hex, double timeout_s);
+
+/* Submit entrypoint("module:function") with JSON-array args; returns the
+ * result's object ref. num_cpus <= 0 uses the default (1). */
+char *ray_tpu_submit_json(const char *entrypoint, const char *args_json,
+                          double num_cpus);
+
+/* Wait until >= num_returns of the given refs are ready (or timeout).
+ * Returns the number ready, or -1 on error. */
+int ray_tpu_wait(const char **ref_hexes, int n, int num_returns,
+                 double timeout_s);
+
+/* Drop this process's handle on an object ref. Long-running clients MUST
+ * release refs they are done with, or the distributed refcount pins every
+ * result until shutdown. (ray_tpu_free only frees the string.)
+ * Returns 0 on success. */
+int ray_tpu_release(const char *ref_hex);
+
+const char *ray_tpu_last_error(void);
+
+void ray_tpu_free(char *s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* RAY_TPU_C_H */

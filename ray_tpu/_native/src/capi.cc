@@ -1,0 +1,223 @@
+// C frontend implementation: embeds CPython and delegates to the JSON
+// bridge (ray_tpu/_native/c_entry.py). Public surface:
+// ray_tpu/_native/include/ray_tpu_c.h.
+//
+// Reference counterpart: cpp/src/ray/runtime/ (the native runtime behind
+// cpp/include/ray/api.h). The compute/runtime substrate here is the
+// Python+jax worker stack, so the native API binds INTO it (CPython
+// embedding) rather than re-implementing the client protocol; the C caller
+// never sees Python objects — strings in, strings out.
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+
+#include "ray_tpu_c.h"  // keep impl signatures pinned to the public ABI
+
+namespace {
+
+char g_err[4096] = "";
+std::mutex g_init_mutex;
+
+void set_error(const char *msg) {
+  std::snprintf(g_err, sizeof(g_err), "%s", msg ? msg : "unknown error");
+}
+
+// Capture the pending Python exception into g_err (GIL held).
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  const char *txt = "python error (unprintable)";
+  PyObject *str = value ? PyObject_Str(value) : nullptr;
+  if (str != nullptr) {
+    const char *u = PyUnicode_AsUTF8(str);
+    if (u != nullptr) txt = u;
+  }
+  std::snprintf(g_err, sizeof(g_err), "%s", txt);
+  Py_XDECREF(str);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Call c_entry.<fn>(args...); returns a NEW reference or nullptr (error
+// recorded). GIL must be held.
+PyObject *call_bridge(const char *fn, PyObject *args) {
+  if (args == nullptr && PyErr_Occurred()) {
+    // A failed Py_BuildValue at the call site (e.g. non-UTF-8 input):
+    // surface ITS error instead of calling the bridge with a pending
+    // exception and zero args.
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *mod = PyImport_ImportModule("ray_tpu._native.c_entry");
+  if (mod == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *callable = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (callable == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(callable, args);
+  Py_DECREF(callable);
+  Py_XDECREF(args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+// Copy a Python str result into a malloc'd C string.
+char *steal_string(PyObject *obj) {
+  if (obj == nullptr) return nullptr;
+  const char *u = PyUnicode_AsUTF8(obj);
+  char *out = nullptr;
+  if (u != nullptr) {
+    out = static_cast<char *>(std::malloc(std::strlen(u) + 1));
+    if (out != nullptr) std::strcpy(out, u);
+  } else {
+    set_error_from_python();
+  }
+  Py_DECREF(obj);
+  return out;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *ray_tpu_last_error(void) { return g_err; }
+
+int ray_tpu_release(const char *ref_hex) {
+  if (ref_hex == nullptr) {
+    set_error("ref_hex must not be NULL");
+    return -1;
+  }
+  Gil gil;
+  PyObject *out = call_bridge("release", Py_BuildValue("(s)", ref_hex));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+void ray_tpu_free(char *s) { std::free(s); }
+
+int ray_tpu_init(const char *address) {
+  {
+    std::lock_guard<std::mutex> lock(g_init_mutex);
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);  // no signal handlers: the host app owns them
+      // Release the GIL acquired by initialization so any thread
+      // (including this one, via Gil below) can take it symmetrically.
+      // The interpreter is deliberately never finalized: shutdown()
+      // disconnects the runtime, but finalizing CPython under a loaded
+      // jax/XLA runtime is not supported.
+      PyEval_SaveThread();
+    }
+  }
+  Gil gil;
+  PyObject *out = call_bridge(
+      "init", Py_BuildValue("(s)", address ? address : ""));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int ray_tpu_shutdown(void) {
+  if (!Py_IsInitialized()) return 0;
+  Gil gil;
+  PyObject *out = call_bridge("shutdown", nullptr);
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+char *ray_tpu_put_json(const char *json) {
+  if (json == nullptr) {
+    set_error("json must not be NULL");
+    return nullptr;
+  }
+  Gil gil;
+  return steal_string(call_bridge("put_json", Py_BuildValue("(s)", json)));
+}
+
+char *ray_tpu_get_json(const char *ref_hex, double timeout_s) {
+  if (ref_hex == nullptr) {
+    set_error("ref_hex must not be NULL");
+    return nullptr;
+  }
+  Gil gil;
+  return steal_string(
+      call_bridge("get_json", Py_BuildValue("(sd)", ref_hex, timeout_s)));
+}
+
+char *ray_tpu_submit_json(const char *entrypoint, const char *args_json,
+                          double num_cpus) {
+  if (entrypoint == nullptr || args_json == nullptr) {
+    set_error("entrypoint/args_json must not be NULL");
+    return nullptr;
+  }
+  Gil gil;
+  return steal_string(call_bridge(
+      "submit", Py_BuildValue("(ssd)", entrypoint, args_json, num_cpus)));
+}
+
+int ray_tpu_wait(const char **ref_hexes, int n, int num_returns,
+                 double timeout_s) {
+  if (ref_hexes == nullptr || n < 0) {
+    set_error("bad ref list");
+    return -1;
+  }
+  Gil gil;
+  PyObject *list = PyList_New(n);
+  if (list == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < n; i++) {
+    PyList_SetItem(list, i, PyUnicode_FromString(ref_hexes[i]));
+  }
+  PyObject *jmod = PyImport_ImportModule("json");
+  if (jmod == nullptr) {
+    Py_DECREF(list);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *refs_json = PyObject_CallMethod(jmod, "dumps", "(O)", list);
+  Py_DECREF(jmod);
+  Py_DECREF(list);
+  if (refs_json == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *out = call_bridge(
+      "wait",
+      Py_BuildValue("(Oid)", refs_json, num_returns, timeout_s));
+  Py_DECREF(refs_json);
+  if (out == nullptr) return -1;
+  long ready = PyLong_AsLong(out);
+  Py_DECREF(out);
+  if (ready < 0 && PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return static_cast<int>(ready);
+}
+
+}  // extern "C"

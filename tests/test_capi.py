@@ -1,0 +1,69 @@
+"""C frontend (layer 7): build libray_tpu_c.so + the C test driver, run it
+against a real cluster and in local mode (reference: cpp/ worker API,
+cpp/src/ray/test/api_test.cc)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build():
+    from ray_tpu._native.build import build_c_api
+
+    lib = build_c_api()
+    if lib is None:
+        pytest.skip("C API build failed (no g++/libpython?)")
+    exe = os.path.join(os.path.dirname(lib), "test_capi")
+    src = os.path.join(REPO, "tests", "native", "test_capi.c")
+    if (not os.path.exists(exe)
+            or os.path.getmtime(src) > os.path.getmtime(exe)):
+        subprocess.run(
+            ["gcc", "-O2", "-Wall", "-o", exe, src,
+             f"-I{os.path.join(REPO, 'ray_tpu', '_native', 'include')}",
+             f"-L{os.path.dirname(lib)}",
+             f"-Wl,-rpath,{os.path.dirname(lib)}",
+             "-lray_tpu_c"],
+            check=True, capture_output=True, timeout=120)
+    return exe
+
+
+def _env():
+    """The embedded interpreter must import ray_tpu and must not claim the
+    TPU tunnel at startup (same scrubbing as the cluster launcher)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p and not os.path.exists(
+                      os.path.join(p, "sitecustomize.py"))])
+    return env
+
+
+def test_c_frontend_against_cluster():
+    from ray_tpu.cluster.testing import Cluster
+
+    exe = _build()
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        out = subprocess.run(
+            [exe, cluster.address], capture_output=True, text=True,
+            timeout=180, env=_env())
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "CAPI_OK" in out.stdout
+        assert "add=42 mul=42" in out.stdout
+    finally:
+        cluster.shutdown()
+
+
+def test_c_frontend_local_mode():
+    exe = _build()
+    out = subprocess.run(
+        [exe], capture_output=True, text=True, timeout=180, env=_env())
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "CAPI_OK" in out.stdout
