@@ -60,6 +60,17 @@ def main(smoke: bool = False):
                              temperature=0.8, seed=42), timeout=600)
     assert a == b
     print(f"sampled (T=0.8, seed=42): {a}")
+
+    # Token streaming: tokens arrive as the engine produces them (the
+    # HTTP ingress exposes the same stream as chunked NDJSON with
+    # {"stream": true} in the request kwargs).
+    streamed = []
+    for tok in h.stream([7, 8, 9], max_new_tokens=8):
+        streamed.append(tok)
+    exp = np.asarray(generate(params, jnp.asarray([[7, 8, 9]], jnp.int32),
+                              cfg, max_new_tokens=8))[0].tolist()
+    assert streamed == exp, (streamed, exp)
+    print(f"streamed token-by-token: {streamed}")
     stats = serve.stat()
     print("endpoint metrics:", stats["metrics"]["endpoints"]["generate"])
     serve.shutdown()

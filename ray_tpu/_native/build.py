@@ -49,7 +49,11 @@ def build_c_api() -> Optional[str]:
             own_inc = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "include")
             libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
-            pylib = "python" + (sysconfig.get_config_var("VERSION") or "3")
+            # LDVERSION carries ABI flags (e.g. "3.13t"); VERSION alone
+            # fails to link on abiflagged builds.
+            pylib = "python" + (sysconfig.get_config_var("LDVERSION")
+                                or sysconfig.get_config_var("VERSION")
+                                or "3")
             tmp = out + f".tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-Wall",

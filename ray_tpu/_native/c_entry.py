@@ -17,7 +17,6 @@ import json
 from typing import Dict
 
 import ray_tpu
-from ray_tpu._private.ids import ObjectID
 from ray_tpu.object_ref import ObjectRef
 
 _refs: Dict[str, ObjectRef] = {}
@@ -32,8 +31,11 @@ def _track(ref: ObjectRef) -> str:
 def _resolve(ref_hex: str) -> ObjectRef:
     ref = _refs.get(ref_hex)
     if ref is None:
-        ref = ObjectRef(ObjectID(bytes.fromhex(ref_hex)))
-        _refs[ref_hex] = ref
+        # The C client only holds hexes it got from put/submit here:
+        # unknown means released (use-after-release) or corrupted — fail
+        # fast instead of fabricating an owner-less ref that would silently
+        # re-pin the object and can hang a get until timeout.
+        raise KeyError(f"unknown or released ref {ref_hex!r}")
     return ref
 
 

@@ -191,7 +191,18 @@ int ray_tpu_wait(const char **ref_hexes, int n, int num_returns,
     return -1;
   }
   for (int i = 0; i < n; i++) {
-    PyList_SetItem(list, i, PyUnicode_FromString(ref_hexes[i]));
+    if (ref_hexes[i] == nullptr) {
+      Py_DECREF(list);
+      set_error("ref list contains NULL");
+      return -1;
+    }
+    PyObject *item = PyUnicode_FromString(ref_hexes[i]);
+    if (item == nullptr) {  // non-UTF-8 input
+      set_error_from_python();
+      Py_DECREF(list);
+      return -1;
+    }
+    PyList_SetItem(list, i, item);
   }
   PyObject *jmod = PyImport_ImportModule("json");
   if (jmod == nullptr) {

@@ -59,6 +59,11 @@ int main(int argc, char **argv) {
   CHECK(ray_tpu_release(r1) == 0, "release r1");
   CHECK(ray_tpu_release(r2) == 0, "release r2");
   CHECK(ray_tpu_release(ref) == 0, "release put ref");
+
+  /* use-after-release fails fast instead of hanging or re-pinning */
+  char *gone = ray_tpu_get_json(r1, 5.0);
+  CHECK(gone == NULL, "get after release should fail");
+
   ray_tpu_free(r1);
   ray_tpu_free(r2);
   ray_tpu_free(ref);
