@@ -618,6 +618,15 @@ class NodeController:
         mtype = msg.get("type")
         if mtype == "assign_task":
             coro = self._run_task(_payload(msg))
+        elif mtype == "assign_batch":
+            tasks = msg.get("tasks", [])
+
+            def fan_out(ts=tasks):
+                for t in ts:
+                    self._spawn_bg(self._run_task(dict(t)))
+
+            self._loop.call_soon_threadsafe(fan_out)
+            return
         elif mtype == "create_actor":
             coro = self._create_actor(_payload(msg))
         elif mtype == "cancel_task":
@@ -732,6 +741,12 @@ class NodeController:
         @s.handler("assign_task")
         async def assign_task(msg, conn):
             self._spawn_bg(self._run_task(_payload(msg)))
+            return {"ok": True}
+
+        @s.handler("assign_batch")
+        async def assign_batch(msg, conn):
+            for t in msg.get("tasks", []):
+                self._spawn_bg(self._run_task(dict(t)))
             return {"ok": True}
 
         @s.handler("task_done")

@@ -121,6 +121,11 @@ class GcsServer:
         # _choose_place_backend.
         self._place_perf: Dict[Tuple[str, int], list] = {}
         self._kernel_unavailable = False
+        # Per-node dispatch coalescing buffers (see _dispatch_to_node) and
+        # batches mid-send (awaiting a conn rebind): both are "granted but
+        # never transmitted" sets that node-death must re-drive for free.
+        self._assign_bufs: Dict[str, list] = {}
+        self._assign_inflight: Dict[str, List[list]] = {}
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()
         self._register_handlers()
@@ -392,8 +397,30 @@ class GcsServer:
             self._unplaceable.pop(token, None)
 
     async def _dispatch_to_node(self, node_id: str, rec: Dict[str, Any]) -> bool:
-        """Push the dispatch over the node's registered GCS connection."""
-        mtype = "assign_task" if rec["kind"] == "task" else "create_actor"
+        """Push the dispatch over the node's registered GCS connection.
+
+        Plain tasks coalesce into per-node assign_batch messages (one
+        pickle + one socket write for a whole tick's worth — at fan-out
+        rates the per-task send dominated GCS cycles); actor creations
+        keep the immediate path.
+        """
+        if rec["kind"] == "task":
+            buf = self._assign_bufs.setdefault(node_id, [])
+            buf.append(rec["payload"])
+            if len(buf) == 1:
+                self._spawn(self._flush_assign(node_id))
+            elif len(buf) >= 512:
+                # Don't let one giant burst build a single huge message.
+                self._assign_bufs[node_id] = []
+                self._spawn(self._send_assign_batch(node_id, buf))
+            return True
+        return await self._send_with_retry(
+            node_id, dict(rec["payload"], type="create_actor"))
+
+    async def _send_with_retry(self, node_id: str, msg: Dict) -> bool:
+        """One message over the node's registered GCS connection, waiting
+        out controller re-dials; False once the node is dead or never
+        rebinds. Shared by actor dispatch and task batches."""
         for _ in range(20):
             conn = self._node_conns.get(node_id)
             node = self.nodes.get(node_id)
@@ -401,13 +428,50 @@ class GcsServer:
                 return False
             if conn is not None:
                 try:
-                    await conn.send(dict(rec["payload"], type=mtype))
+                    await conn.send(msg)
                     return True
                 except Exception:  # noqa: BLE001 - conn died; maybe rebound
                     self._node_conns.pop(node_id, None)
             # The controller re-dials on its next heartbeat; wait briefly.
             await asyncio.sleep(0.05)
         return False
+
+    async def _flush_assign(self, node_id: str) -> None:
+        """Micro-batch window: let same-tick dispatches to this node pile
+        up, then ship them in one message."""
+        await asyncio.sleep(0)   # drain the current event-loop pass first
+        batch = self._assign_bufs.pop(node_id, [])
+        if batch:
+            await self._send_assign_batch(node_id, batch)
+
+    async def _send_assign_batch(self, node_id: str, batch: list) -> None:
+        # Registered while in flight so node-death reconciliation can tell
+        # "granted but never transmitted" (free re-drive) apart from
+        # "died executing" (burns a retry).
+        bucket = self._assign_inflight.setdefault(node_id, [])
+        bucket.append(batch)
+        try:
+            msg = (dict(batch[0], type="assign_task") if len(batch) == 1
+                   else {"type": "assign_batch", "tasks": batch})
+            if await self._send_with_retry(node_id, msg):
+                return
+        finally:
+            bucket.remove(batch)
+            if not bucket:
+                self._assign_inflight.pop(node_id, None)
+        self._redrive_unsent(node_id, batch)
+
+    def _redrive_unsent(self, node_id: str, batch: list) -> None:
+        """Re-place never-transmitted dispatches without burning retries.
+        Idempotent with _on_node_death's sweep via the state guard."""
+        for payload in batch:
+            rec = self.task_table.get(payload.get("task_id"))
+            if rec is not None and rec["state"] == "DISPATCHED" \
+                    and rec["node_id"] == node_id:
+                self._release(node_id, rec["resources"])
+                rec["state"] = "PENDING"
+                rec["node_id"] = None
+                self._spawn(self._drive_task(rec))
 
     def _cancel_error(self, rec: Dict[str, Any]):
         from ..exceptions import TaskCancelledError
@@ -658,6 +722,15 @@ class GcsServer:
             entry["locations"].discard(node.node_id)
             if not entry["locations"]:
                 del self.objects[oid]
+        # Tasks still sitting in this node's UNSENT dispatch buffer (or in
+        # a batch mid-send awaiting a conn rebind) were never transmitted:
+        # re-drive them for free BEFORE the table sweep below, which would
+        # otherwise misread their DISPATCHED state as "died executing" and
+        # burn a retry (or terminally fail them).
+        self._redrive_unsent(node.node_id,
+                             self._assign_bufs.pop(node.node_id, []))
+        for batch in self._assign_inflight.get(node.node_id, []):
+            self._redrive_unsent(node.node_id, batch)
         for rec in list(self.task_table.values()):
             if rec["state"] != "DISPATCHED" or rec["node_id"] != node.node_id:
                 continue

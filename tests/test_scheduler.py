@@ -312,3 +312,62 @@ class TestAdaptivePlacementCrossover:
         g._record_place_perf("kernel", 128, 0.001)
         assert g._place_perf[("kernel", 128)][1] == 1
         assert abs(g._place_perf[("kernel", 128)][0] - 0.001) < 1e-9
+
+
+class TestUnsentDispatchRecovery:
+    """Coalesced dispatch (assign_batch) must never let node death misread
+    a buffered-but-untransmitted task as 'died executing': such tasks are
+    re-driven for free, not failed / retry-burned."""
+
+    def _gcs_with_task(self):
+        import asyncio
+
+        from ray_tpu._private.config import Config
+        from ray_tpu.cluster.gcs import GcsServer, NodeEntry
+
+        g = GcsServer(Config())
+        node = NodeEntry("nodeA", ("127.0.0.1", 1), {"CPU": 2.0}, index=0)
+        g.nodes["nodeA"] = node
+        payload = {"task_id": b"t1", "return_ids": [b"o1"],
+                   "resources": {"CPU": 1.0}, "deps": []}
+        rec = {"task_id": b"t1", "payload": payload, "kind": "task",
+               "resources": {"CPU": 1.0}, "retries_left": 0,
+               "state": "DISPATCHED", "node_id": "nodeA",
+               "cancelled": False, "return_ids": [b"o1"]}
+        g.task_table[b"t1"] = rec
+        return g, node, payload, rec, asyncio
+
+    def test_send_fallback_redrives_without_burning_retry(self):
+        g, node, payload, rec, asyncio = self._gcs_with_task()
+        node.alive = False  # dead before any bytes go out
+
+        async def run():
+            # _redrive_unsent spawns _drive_task via asyncio; patch the
+            # spawn to record instead of actually driving.
+            driven = []
+            g._spawn = lambda coro: (driven.append(True), coro.close())
+            await g._send_assign_batch("nodeA", [payload])
+            return driven
+
+        driven = asyncio.run(run())
+        assert rec["state"] == "PENDING" and rec["node_id"] is None
+        assert rec["retries_left"] == 0  # untouched: no retry burned
+        assert driven  # re-drive scheduled
+        assert g._assign_inflight == {}  # no leak
+
+    def test_node_death_rescues_buffered_batch(self):
+        g, node, payload, rec, asyncio = self._gcs_with_task()
+        g._assign_bufs["nodeA"] = [payload]
+        driven = []
+        g._spawn = lambda coro: (driven.append(True), coro.close())
+
+        async def run():
+            node.alive = False
+            await g._on_node_death(node)
+
+        asyncio.run(run())
+        # Re-driven for free — NOT failed, despite retries_left == 0.
+        assert rec["state"] == "PENDING"
+        assert rec["retries_left"] == 0
+        assert not g.error_objects
+        assert driven
