@@ -121,11 +121,15 @@ class GcsServer:
         # _choose_place_backend.
         self._place_perf: Dict[Tuple[str, int], list] = {}
         self._kernel_unavailable = False
-        # Per-node dispatch coalescing buffers (see _dispatch_to_node) and
-        # batches mid-send (awaiting a conn rebind): both are "granted but
-        # never transmitted" sets that node-death must re-drive for free.
+        # Per-node dispatch coalescing buffers (see _dispatch_to_node):
+        # tasks here are "granted but never transmitted" and node-death
+        # re-drives them for free. Batches already handed to conn.send are
+        # NOT tracked — once bytes may have been delivered, death handling
+        # must treat the task as possibly-executed (at-most-once for
+        # max_retries=0), exactly like the pre-batching path.
         self._assign_bufs: Dict[str, list] = {}
-        self._assign_inflight: Dict[str, List[list]] = {}
+        # Small placement-kernel buckets being warmed off-thread.
+        self._place_warming: set = set()
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()
         self._register_handlers()
@@ -445,20 +449,13 @@ class GcsServer:
             await self._send_assign_batch(node_id, batch)
 
     async def _send_assign_batch(self, node_id: str, batch: list) -> None:
-        # Registered while in flight so node-death reconciliation can tell
-        # "granted but never transmitted" (free re-drive) apart from
-        # "died executing" (burns a retry).
-        bucket = self._assign_inflight.setdefault(node_id, [])
-        bucket.append(batch)
-        try:
-            msg = (dict(batch[0], type="assign_task") if len(batch) == 1
-                   else {"type": "assign_batch", "tasks": batch})
-            if await self._send_with_retry(node_id, msg):
-                return
-        finally:
-            bucket.remove(batch)
-            if not bucket:
-                self._assign_inflight.pop(node_id, None)
+        msg = (dict(batch[0], type="assign_task") if len(batch) == 1
+               else {"type": "assign_batch", "tasks": batch})
+        if await self._send_with_retry(node_id, msg):
+            return
+        # Nothing was delivered: re-drive for free. The state guard in
+        # _redrive_unsent makes this a no-op for any record node-death
+        # reconciliation already failed/retried in the meantime.
         self._redrive_unsent(node_id, batch)
 
     def _redrive_unsent(self, node_id: str, batch: list) -> None:
@@ -722,15 +719,16 @@ class GcsServer:
             entry["locations"].discard(node.node_id)
             if not entry["locations"]:
                 del self.objects[oid]
-        # Tasks still sitting in this node's UNSENT dispatch buffer (or in
-        # a batch mid-send awaiting a conn rebind) were never transmitted:
-        # re-drive them for free BEFORE the table sweep below, which would
-        # otherwise misread their DISPATCHED state as "died executing" and
-        # burn a retry (or terminally fail them).
+        # Tasks still sitting in this node's UNSENT dispatch buffer were
+        # never transmitted: re-drive them for free BEFORE the table sweep
+        # below, which would otherwise misread their DISPATCHED state as
+        # "died executing" and burn a retry (or terminally fail them).
+        # Batches already handed to conn.send are deliberately NOT rescued:
+        # their bytes may have been delivered, so the sweep's
+        # possibly-executed accounting (at-most-once for max_retries=0)
+        # applies.
         self._redrive_unsent(node.node_id,
                              self._assign_bufs.pop(node.node_id, []))
-        for batch in self._assign_inflight.get(node.node_id, []):
-            self._redrive_unsent(node.node_id, batch)
         for rec in list(self.task_table.values()):
             if rec["state"] != "DISPATCHED" or rec["node_id"] != node.node_id:
                 continue
@@ -802,7 +800,21 @@ class GcsServer:
                 [index_of.get(loc, -1) if loc else -1 for _, loc, _ in batch],
                 dtype=np.int32,
             )
-            placement = self._place(demand, avail, locality)
+            # Kernel ticks run off the event loop: a compile (new bucket
+            # shape / custom-resource column set) takes seconds —
+            # heartbeats, task_done, and object registration must keep
+            # flowing while only this tick's tasks wait. The common
+            # sub-millisecond numpy tick stays inline (an executor hop
+            # would tax every small placement). Only this loop places, so
+            # sequencing is preserved by the await.
+            self._seed += 1
+            choice = self._choose_place_backend(demand.shape[0])
+            if choice == "numpy":
+                placement = self._place_with(
+                    "numpy", demand, avail, locality)
+            else:
+                placement = await asyncio.to_thread(
+                    self._place_with, "kernel", demand, avail, locality)
             # Queue-at-node fallback (reference: tasks the per-tick policy
             # can't admit queue at a raylet, which admits locally when
             # resources free — node_manager DispatchTasks). A task the
@@ -867,21 +879,74 @@ class GcsServer:
             return "kernel" if self._seed % 1024 == 0 else "numpy"
         if T < 64:
             # Explore the kernel a few times per small bucket so a
-            # host-attached chip gets discovered; the cost is bounded at
-            # _PLACE_EXPLORE_SAMPLES ticks per bucket.
+            # host-attached chip gets discovered — but NEVER pay the
+            # bucket's first XLA compile on the serving path (observed
+            # 5-7s control-plane stalls in the soak): a cold bucket is
+            # warmed by a background thread (schedule_dag's jit cache is
+            # module-level, so the warm carries over) while this tick
+            # serves on numpy.
             if (k is None or k[1] < self._PLACE_EXPLORE_SAMPLES) \
                     and self._seed % 16 == 0:
-                return "kernel"
+                if k is not None and k[1] >= 1:
+                    return "kernel"  # warm: a real timed sample exists
+                self._spawn_place_warmup(bucket)
             return "numpy"
         return "kernel"
+
+    def _spawn_place_warmup(self, bucket: int) -> None:
+        """Compile + time the kernel for a small bucket off the event loop;
+        records post-compile samples so the EMA comparison can start
+        routing the bucket to the kernel. A failed/raced warmup removes
+        itself from _place_warming so the next exploration tick retries
+        (otherwise a transient error — e.g. self.nodes mutating mid-
+        iteration — would lock the bucket onto numpy forever)."""
+        import threading
+
+        if bucket in self._place_warming:
+            return
+        self._place_warming.add(bucket)
+
+        def warm():
+            ok = False
+            try:
+                from ..scheduler.kernel import BatchScheduler
+
+                avail, _, order = self._avail_matrix(())
+                if not order:
+                    return
+                sched = BatchScheduler(avail, seed=0, chunk=4096)
+                demand = np.zeros((bucket, avail.shape[1]), np.int32)
+                demand[:, 0] = 1000
+                locality = np.full(bucket, -1, np.int32)
+                sched.place(demand, locality)  # compile
+                # 3 timed runs: _record_place_perf discards the first
+                # visit per bucket as compile-pending, so 2 real samples
+                # land in the EMA. (Concurrent EMA updates from the
+                # placement thread can drop a sample — benign.)
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    sched.place(demand, locality)
+                    self._record_place_perf(
+                        "kernel", bucket, time.perf_counter() - t0)
+                ok = True
+            except Exception:  # noqa: BLE001 - best-effort; retried later
+                pass
+            finally:
+                if not ok:
+                    self._place_warming.discard(bucket)
+
+        threading.Thread(target=warm, daemon=True,
+                         name=f"place-warmup-{bucket}").start()
 
     def _reset_kernel_perf(self) -> None:
         """A BatchScheduler rebuild (cluster size change) forces fresh XLA
         compiles: mark every kernel cell compile-pending so the next sample
-        per bucket is dropped instead of poisoning the EMA."""
+        per bucket is dropped instead of poisoning the EMA, and let small
+        buckets warm again for the new shape."""
         for key, cell in self._place_perf.items():
             if key[0] == "kernel":
                 cell[0], cell[1] = 0.0, 0
+        self._place_warming.clear()
 
     def _record_place_perf(self, path: str, T: int, seconds: float) -> None:
         bucket = 1 << max(T - 1, 1).bit_length()
@@ -915,8 +980,12 @@ class GcsServer:
         _choose_place_backend.
         """
         self._seed += 1
+        choice = self._choose_place_backend(demand.shape[0])
+        return self._place_with(choice, demand, avail, locality)
+
+    def _place_with(self, choice: str, demand: np.ndarray, avail: np.ndarray,
+                    locality: np.ndarray) -> np.ndarray:
         T = demand.shape[0]
-        choice = self._choose_place_backend(T)
         t0 = time.perf_counter()
         if choice == "numpy":
             out = _place_numpy(demand, avail, locality, self._seed)

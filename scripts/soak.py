@@ -36,7 +36,12 @@ def _loop(name: str, duration_s: float, body, setup=None, teardown=None):
             iters += 1
             if len(times) >= 8:
                 med = statistics.median(times[-50:])
-                if dt > max(20 * med, 5.0):
+                # Absolute floor 15s: the shared 1-vCPU host exhibits
+                # multi-second co-tenant freezes (observed 5-7s with the
+                # SAME iteration fast on re-run); a genuine hang trips the
+                # body's own 60s get-timeouts or this cap, while scheduler
+                # noise doesn't fail the run.
+                if dt > max(20 * med, 15.0):
                     raise RuntimeError(
                         f"{name}: iteration {iters} took {dt:.1f}s "
                         f"(median {med:.2f}s) — stall")

@@ -271,21 +271,25 @@ class TestAdaptivePlacementCrossover:
 
     def test_small_batches_explore_kernel_boundedly(self):
         g = self._gcs()
-        g._choose_place_backend(8)  # init perf table
-        explored = 0
-        for seed in range(0, 64):
-            g._seed = seed
-            if g._choose_place_backend(8) == "kernel":
-                explored += 1
-                # pretend the exploration ran post-compile
-                g._record_place_perf("kernel", 8, 0.07)
-                g._record_place_perf("kernel", 8, 0.07)
-        assert explored >= 1
-        # once sampled, a slow kernel (70ms, tunneled chip) loses to a
-        # measured fast numpy path
+        # Cold bucket + exploration tick: serve numpy, warm in background
+        # (never compile on the serving path — that stalled the soak).
+        warmed = []
+        g._spawn_place_warmup = lambda bucket: warmed.append(bucket)
+        g._seed = 16
+        assert g._choose_place_backend(8) == "numpy"
+        assert warmed == [8]  # background warmup requested for bucket 8
+        # Warm bucket (samples recorded, e.g. a slow tunneled chip at
+        # 70ms): exploration ticks now route to the kernel for real
+        # serving samples...
+        g._place_perf[("kernel", 8)] = [0.07, 1]
+        g._seed = 16
+        assert g._choose_place_backend(8) == "kernel"
+        # ...until both paths have >= 2 samples, after which the EMA
+        # comparison decides (numpy wins against the 70ms kernel).
+        g._record_place_perf("kernel", 8, 0.07)
         g._record_place_perf("numpy", 8, 0.0005)
         g._record_place_perf("numpy", 8, 0.0005)
-        g._seed = 16  # exploration seed, but both paths are measured
+        g._seed = 16
         assert g._choose_place_backend(8) == "numpy"
         # ...except the periodic healing re-sample (1/1024 ticks), which
         # keeps a transiently-poisoned kernel EMA from locking out forever
@@ -353,7 +357,6 @@ class TestUnsentDispatchRecovery:
         assert rec["state"] == "PENDING" and rec["node_id"] is None
         assert rec["retries_left"] == 0  # untouched: no retry burned
         assert driven  # re-drive scheduled
-        assert g._assign_inflight == {}  # no leak
 
     def test_node_death_rescues_buffered_batch(self):
         g, node, payload, rec, asyncio = self._gcs_with_task()
