@@ -128,6 +128,11 @@ class GcsServer:
         # must treat the task as possibly-executed (at-most-once for
         # max_retries=0), exactly like the pre-batching path.
         self._assign_bufs: Dict[str, list] = {}
+        # Batches in _send_assign_batch, each with an "attempted" flag set
+        # the instant conn.send is first called: node death can then tell
+        # provably-unsent batches (free re-drive) from possibly-delivered
+        # ones (possibly-executed accounting).
+        self._assign_pending: Dict[str, List[dict]] = {}
         # Small placement-kernel buckets being warmed off-thread.
         self._place_warming: set = set()
         self._tasks: List[asyncio.Task] = []
@@ -421,10 +426,13 @@ class GcsServer:
         return await self._send_with_retry(
             node_id, dict(rec["payload"], type="create_actor"))
 
-    async def _send_with_retry(self, node_id: str, msg: Dict) -> bool:
+    async def _send_with_retry(self, node_id: str, msg: Dict,
+                               entry: Optional[Dict] = None) -> bool:
         """One message over the node's registered GCS connection, waiting
         out controller re-dials; False once the node is dead or never
-        rebinds. Shared by actor dispatch and task batches."""
+        rebinds. Shared by actor dispatch and task batches. ``entry`` (a
+        pending-batch record) has its "attempted" flag set the moment a
+        send is first tried."""
         for _ in range(20):
             conn = self._node_conns.get(node_id)
             node = self.nodes.get(node_id)
@@ -432,6 +440,8 @@ class GcsServer:
                 return False
             if conn is not None:
                 try:
+                    if entry is not None:
+                        entry["attempted"] = True
                     await conn.send(msg)
                     return True
                 except Exception:  # noqa: BLE001 - conn died; maybe rebound
@@ -451,12 +461,23 @@ class GcsServer:
     async def _send_assign_batch(self, node_id: str, batch: list) -> None:
         msg = (dict(batch[0], type="assign_task") if len(batch) == 1
                else {"type": "assign_batch", "tasks": batch})
-        if await self._send_with_retry(node_id, msg):
-            return
-        # Nothing was delivered: re-drive for free. The state guard in
-        # _redrive_unsent makes this a no-op for any record node-death
-        # reconciliation already failed/retried in the meantime.
-        self._redrive_unsent(node_id, batch)
+        entry = {"batch": batch, "attempted": False}
+        pend = self._assign_pending.setdefault(node_id, [])
+        pend.append(entry)
+        try:
+            delivered = await self._send_with_retry(node_id, msg, entry)
+        finally:
+            pend.remove(entry)
+            if not pend:
+                self._assign_pending.pop(node_id, None)
+        if not delivered:
+            # Re-place on send failure — the same semantics the queued
+            # single-send path always had. If an attempted send actually
+            # reached the controller before its connection died, a
+            # duplicate execution double-puts the same immutable object
+            # ids (a store no-op); the state guard also no-ops when
+            # node-death reconciliation already settled the records.
+            self._redrive_unsent(node_id, batch)
 
     def _redrive_unsent(self, node_id: str, batch: list) -> None:
         """Re-place never-transmitted dispatches without burning retries.
@@ -719,16 +740,19 @@ class GcsServer:
             entry["locations"].discard(node.node_id)
             if not entry["locations"]:
                 del self.objects[oid]
-        # Tasks still sitting in this node's UNSENT dispatch buffer were
-        # never transmitted: re-drive them for free BEFORE the table sweep
-        # below, which would otherwise misread their DISPATCHED state as
-        # "died executing" and burn a retry (or terminally fail them).
-        # Batches already handed to conn.send are deliberately NOT rescued:
-        # their bytes may have been delivered, so the sweep's
-        # possibly-executed accounting (at-most-once for max_retries=0)
-        # applies.
+        # Tasks still sitting in this node's UNSENT dispatch buffer — or in
+        # a pending batch whose send was never even attempted (conn-rebind
+        # wait) — were provably never transmitted: re-drive them for free
+        # BEFORE the table sweep below, which would otherwise misread
+        # their DISPATCHED state as "died executing" and burn a retry (or
+        # terminally fail them). Batches whose send WAS attempted may have
+        # been delivered, so the sweep's possibly-executed accounting
+        # applies to them.
         self._redrive_unsent(node.node_id,
                              self._assign_bufs.pop(node.node_id, []))
+        for entry in self._assign_pending.get(node.node_id, []):
+            if not entry["attempted"]:
+                self._redrive_unsent(node.node_id, entry["batch"])
         for rec in list(self.task_table.values()):
             if rec["state"] != "DISPATCHED" or rec["node_id"] != node.node_id:
                 continue
@@ -914,7 +938,18 @@ class GcsServer:
                 avail, _, order = self._avail_matrix(())
                 if not order:
                     return
-                sched = BatchScheduler(avail, seed=0, chunk=4096)
+                # Install as the serving scheduler when none exists (or
+                # the cluster resized): the first serving kernel tick then
+                # reuses it instead of rebuilding — a rebuild would call
+                # _reset_kernel_perf and wipe the samples recorded below.
+                # Note buckets are keyed by T only: a tick that carries
+                # custom-resource columns widens the demand matrix (a new
+                # jit cache key) and still pays its compile on the serving
+                # tick — rare, and to_thread keeps the event loop alive.
+                sched = getattr(self, "_sched", None)
+                if sched is None or sched.avail.shape[0] != avail.shape[0]:
+                    sched = BatchScheduler(avail, seed=0, chunk=4096)
+                    self._sched = sched
                 demand = np.zeros((bucket, avail.shape[1]), np.int32)
                 demand[:, 0] = 1000
                 locality = np.full(bucket, -1, np.int32)
@@ -971,20 +1006,12 @@ class GcsServer:
                                      "samples": c[1]}
                 for (path, bucket), c in sorted(self._place_perf.items())}
 
-    def _place(self, demand: np.ndarray, avail: np.ndarray,
-               locality: np.ndarray) -> np.ndarray:
-        """One tick of the placement spec on the head.
-
-        The backend (numpy spec vs jax kernel with power-of-two bucket
-        padding) is chosen by the measured crossover — see
-        _choose_place_backend.
-        """
-        self._seed += 1
-        choice = self._choose_place_backend(demand.shape[0])
-        return self._place_with(choice, demand, avail, locality)
-
     def _place_with(self, choice: str, demand: np.ndarray, avail: np.ndarray,
                     locality: np.ndarray) -> np.ndarray:
+        """One tick of the placement spec on the head with the given
+        backend ("numpy" spec or jax "kernel" with power-of-two bucket
+        padding); the caller (the placement loop) picks the backend via
+        _choose_place_backend and offloads kernel ticks to a thread."""
         T = demand.shape[0]
         t0 = time.perf_counter()
         if choice == "numpy":

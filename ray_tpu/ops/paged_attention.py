@@ -1,0 +1,200 @@
+"""Paged-KV decode attention + page pool (net-new vs the reference — Ray
+0.9 predates LLM serving; this is the vLLM-style building block the
+contiguous-slot engine can graduate to).
+
+Layout: one shared pool of fixed-size pages, ``k_pages/v_pages:
+[num_pages, page_size, KH, D]``; each sequence owns a list of page ids
+(``page_table: [B, max_pages]`` int32, -1 padded). Memory is allocated in
+page granules on demand, so N concurrent sequences cost
+sum(ceil(len_i/page_size)) pages instead of N * max_seq rows.
+
+The pallas path REUSES the flash-decode kernel (`ops/attention.py
+_decode_kernel`) unchanged: paging only changes WHERE a logical KV block
+lives, which is exactly the index map's job — the scalar-prefetched page
+table routes grid step (b, ki) to physical page ``page_table[b, ki]``, and
+the same clamp that truncates the DMA sweep at each sequence's length
+keeps dead pages (and -1 padding) from ever being fetched.
+
+XLA reference path (CPU / non-tiling shapes): gather pages into the
+contiguous layout and delegate to ``masked_gqa_attention`` — identical
+math, one copy of the softmax semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import _decode_kernel, masked_gqa_attention
+from . import attention as _att
+
+
+def paged_gather(k_pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """[num_pages, ps, KH, D] gathered to [B, max_pages*ps, KH, D] (XLA
+    reference layout). -1 page ids are clamped to page 0; callers mask by
+    length so the garbage rows are never attended."""
+    safe = jnp.maximum(page_table, 0)                  # [B, P]
+    gathered = k_pages[safe]                           # [B, P, ps, KH, D]
+    B, P, ps, KH, D = gathered.shape
+    return gathered.reshape(B, P * ps, KH, D)
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_table: jax.Array,
+                           lengths: jax.Array) -> jax.Array:
+    """Single-position cached attention over a paged KV pool.
+
+    q [B, H, D]; k_pages/v_pages [num_pages, page_size, KH, D];
+    page_table [B, max_pages] int32 (-1 padded); lengths [B] int32
+    (inclusive attend bound, like ``decode_attention``) -> [B, H, D].
+    """
+    B, H, D = q.shape
+    num_pages, ps, KH, _ = k_pages.shape
+    P = page_table.shape[1]
+    G = H // max(KH, 1)
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    tiles = (D % 128 == 0 and ps % 128 == 0 and H % KH == 0 and G % 8 == 0)
+    if on_tpu and tiles:
+        return _paged_flash_decode(q, k_pages, v_pages, page_table, lengths)
+    buf_k = paged_gather(k_pages, page_table)
+    buf_v = paged_gather(v_pages, page_table)
+    S = P * ps
+    mask = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, :]
+    return masked_gqa_attention(q[:, None], buf_k, buf_v, mask)[:, 0]
+
+
+def _paged_flash_decode(q, k_pages, v_pages, page_table, lengths):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    num_pages, ps, KH, _ = k_pages.shape
+    P = page_table.shape[1]
+    G = H // KH
+    scale = D ** -0.5
+    qf = q.reshape(B * KH, G, D)
+    lens = lengths.astype(jnp.int32)
+    pt = page_table.astype(jnp.int32)
+
+    def kv_index(r, ki, lens_ref, pt_ref, kh=KH):
+        b = r // kh
+        # Clamp at the sequence's last live page: dead/-1 pages are never
+        # fetched (revisited index => pallas skips the copy), mirroring
+        # decode_attention's DMA truncation.
+        last = lens_ref[b] // ps
+        page = pt_ref[b, jnp.minimum(ki, last)]
+        return (jnp.maximum(page, 0), 0, r % kh, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, block_k=ps, kv_heads=KH)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * KH, P),
+        in_specs=[
+            pl.BlockSpec((1, G, D), lambda r, ki, lr, pr: (r, 0, 0)),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda r, ki, lr, pr: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KH, G, D), q.dtype),
+        interpret=_att._INTERPRET,
+    )(lens, pt, qf, k_pages, v_pages)
+    return out.reshape(B, H, D)
+
+
+def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, block_k, kv_heads):
+    """The flash-decode kernel verbatim: logical position of grid step ki
+    is still ki*page_size, so the online-softmax/masking math is identical
+    — only the index maps (which consume pt_ref) differ."""
+    _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr,
+                   scale=scale, block_k=block_k, kv_heads=kv_heads)
+
+
+class PagePool:
+    """Host-side page allocator for a paged KV cache (the bookkeeping half
+    of vLLM's block manager; device arrays live with the caller).
+
+    Free pages are a LIFO; sequences append pages as they grow and return
+    them on free. Raises when the pool is exhausted — admission control
+    (e.g. an engine's slot queue) decides what to do about it.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._owned: dict = {}  # seq id -> [page ids]
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, seq: int) -> List[int]:
+        return list(self._owned.get(seq, ()))
+
+    def alloc(self, seq: int, tokens: int) -> List[int]:
+        """Ensure ``seq`` owns enough pages for ``tokens`` total tokens;
+        returns newly allocated page ids (may be empty)."""
+        owned = self._owned.setdefault(seq, [])
+        need = -(-tokens // self.page_size) - len(owned)
+        if need <= 0:
+            return []
+        if need > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: need {need}, free {len(self._free)}")
+        new = [self._free.pop() for _ in range(need)]
+        owned.extend(new)
+        return new
+
+    def free(self, seq: int) -> int:
+        """Return all of ``seq``'s pages; returns how many were freed."""
+        pages = self._owned.pop(seq, [])
+        self._free.extend(reversed(pages))
+        return len(pages)
+
+    def table(self, seqs: List[int], max_pages: Optional[int] = None
+              ) -> np.ndarray:
+        """Dense [len(seqs), max_pages] int32 page table (-1 padded) for
+        the given sequences, in order."""
+        width = max_pages or max(
+            (len(self._owned.get(s, ())) for s in seqs), default=1) or 1
+        out = np.full((len(seqs), width), -1, np.int32)
+        for i, s in enumerate(seqs):
+            pages = self._owned.get(s, ())
+            if len(pages) > width:
+                raise ValueError(
+                    f"seq {s} owns {len(pages)} pages but the table is "
+                    f"only {width} wide — it outgrew the configured "
+                    f"max_pages")
+            out[i, :len(pages)] = pages
+        return out
+
+
+def write_paged(pages: jax.Array, pool_positions: jax.Array,
+                values: jax.Array) -> jax.Array:
+    """Scatter new KV rows into the paged pool.
+
+    pages [num_pages, ps, KH, D]; pool_positions [N] int32 (global row =
+    page_id * ps + offset, computed by the caller from its page table);
+    values [N, KH, D]. Returns the updated pool. Donation-friendly: one
+    scatter, no host sync.
+    """
+    num_pages, ps, KH, D = pages.shape
+    flat = pages.reshape(num_pages * ps, KH, D)
+    flat = flat.at[pool_positions].set(values.astype(flat.dtype))
+    return flat.reshape(num_pages, ps, KH, D)
