@@ -234,9 +234,7 @@ class GenerationEngine:
         events = self._admit()
         if not any(r is not None for r in self.active):
             return events
-        logits, self.cache_k, self.cache_v = _batched_decode(
-            self.params, jnp.asarray(self.tokens),
-            jnp.asarray(self.lengths), self.cache_k, self.cache_v, self.cfg)
+        logits = self._decode_all()
         # Hot path stays device-side: greedy slots get the [B] int32 argmax
         # transfer; only the sampling slots' logits ROWS come to the host
         # ([k, V], not [B, V]), so one temperature>0 request doesn't impose
@@ -260,8 +258,7 @@ class GenerationEngine:
             events.append((req.req_id, token, finished))
             if finished:
                 self.done[req.req_id] = req.out
-                self.active[slot] = None
-                self.lengths[slot] = 0
+                self._release_slot(slot)
         return events
 
     def cancel(self, req_id: int) -> bool:
@@ -274,8 +271,7 @@ class GenerationEngine:
                 return True
         for slot, r in enumerate(self.active):
             if r is not None and r.req_id == req_id:
-                self.active[slot] = None
-                self.lengths[slot] = 0
+                self._release_slot(slot)
                 return True
         return self.done.pop(req_id, None) is not None
 
@@ -285,16 +281,36 @@ class GenerationEngine:
         out, self.done = self.done, {}
         return out
 
-    # ---- internals ----
+    # ---- internals (subclass hooks: _decode_all / _prefill_slot /
+    #      _release_slot / _can_admit — the paged engine overrides these) --
+
+    def _decode_all(self) -> jax.Array:
+        """One lockstep decode over every slot; returns logits [B, V]."""
+        logits, self.cache_k, self.cache_v = _batched_decode(
+            self.params, jnp.asarray(self.tokens),
+            jnp.asarray(self.lengths), self.cache_k, self.cache_v, self.cfg)
+        return logits
+
+    def _release_slot(self, slot: int) -> None:
+        self.active[slot] = None
+        self.lengths[slot] = 0
+
+    def _can_admit(self, req: _Request) -> bool:
+        """Capacity gate beyond free slots (paged engine: page budget)."""
+        return True
 
     def _admit(self) -> List[Tuple[int, int, bool]]:
         """Fill free slots from the queue; a request that finishes at
         prefill frees its slot immediately, so the same slot can admit
         several one-token requests within one tick. Returns the
-        prefill-produced (req_id, first_token, done) events."""
+        prefill-produced (req_id, first_token, done) events. FIFO: if the
+        queue head can't be admitted (capacity gate), nothing behind it
+        jumps ahead."""
         events: List[Tuple[int, int, bool]] = []
         for slot in range(self.slots):
             while self.queue and self.active[slot] is None:
+                if not self._can_admit(self.queue[0]):
+                    return events
                 req = self.queue.pop(0)
                 done = self._prefill_slot(slot, req)
                 events.append((req.req_id, req.out[0], done))

@@ -1,0 +1,218 @@
+"""Paged-KV continuous-batching engine (vLLM-style memory management on
+the engine of `models/engine.py`).
+
+The contiguous engine preallocates ``slots * max_seq`` cache rows per
+layer; most requests use a fraction of max_seq, so most of that HBM is
+dead. Here every layer's KV cache is a shared pool of fixed-size pages
+(``[L, num_pages, page_size, KH, Dh]``) and each active request owns just
+``ceil((prompt+max_new)/page_size)`` pages, handed out by
+`ops.paged_attention.PagePool` and returned the moment the request
+finishes. Admission is gated on page budget (FIFO), so a smaller pool
+degrades to queueing instead of OOM.
+
+Decode attends through `paged_decode_attention` (the flash-decode kernel
+with page-table index maps); prefill runs the normal causal forward over
+the prompt (which needs no pool) and scatters the resulting K/V rows
+through the page indirection. Page 0 is a reserved scratch page: pad
+positions and idle slots write there, so clamped indices can never
+corrupt a live sequence.
+
+Greedy outputs are bit-exact vs the contiguous engine and
+single-request `generate()` (same math, different storage).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import masked_gqa_attention
+from ..ops.paged_attention import PagePool, paged_decode_attention
+from .engine import GenerationEngine, _Request, _rope_at
+from .transformer import Params, TransformerConfig, _mlp, _rms_norm, _rope
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("k_pages", "v_pages"))
+def _paged_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
+                  tables: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                  cfg: TransformerConfig):
+    """tokens [B] at positions ``lengths`` [B] -> logits [B, V].
+
+    k_pages/v_pages: [L, num_pages, ps, KH, Dh]; tables [B, P] int32
+    (-1 padded — clamped writes land on the reserved scratch page 0).
+    """
+    B = tokens.shape[0]
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pages.shape[2]
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens][:, None, :]           # [B, 1, E]
+    # Global pool row for each slot's current position, through its table.
+    page = jnp.take_along_axis(
+        tables, (lengths // ps)[:, None], axis=1)[:, 0]          # [B]
+    rows = jnp.maximum(page, 0) * ps + lengths % ps              # [B]
+
+    def block(x, xs):
+        layer, kp, vp = xs                    # kp [num_pages, ps, KH, Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(B, 1, H, Dh),
+                     lengths, cfg.rope_theta)
+        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(B, 1, KH, Dh),
+                     lengths, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KH, Dh)
+        shape = kp.shape
+        kp = kp.reshape(-1, KH, Dh).at[rows].set(k[:, 0]).reshape(shape)
+        vp = vp.reshape(-1, KH, Dh).at[rows].set(v[:, 0]).reshape(shape)
+        attn = paged_decode_attention(
+            q[:, 0], kp, vp, tables, lengths).reshape(B, 1, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x[:, 0] @ params["embed"].astype(dt).T
+    return logits, new_k, new_v
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("k_pages", "v_pages"))
+def _paged_prefill(params: Params, tokens: jax.Array, real_len: jax.Array,
+                   rows: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                   cfg: TransformerConfig):
+    """Prompt [1, Tb] (bucket-padded) -> logits [V] at real_len-1; each
+    layer's prompt K/V rows scatter into the pool at global rows ``rows``
+    [Tb] (pad positions point at the scratch page). The forward itself is
+    the standard causal attention over the prompt — prefill never reads
+    the pool. Compiles once per bucket length."""
+    _, Tb = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]                       # [1, Tb, E]
+    positions = jnp.arange(Tb)
+    causal = positions[None, :] <= positions[:, None]
+
+    def block(x, xs):
+        layer, kp, vp = xs
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope((h @ layer["wq"].astype(dt)).reshape(1, Tb, H, Dh),
+                  positions, cfg.rope_theta)
+        k = _rope((h @ layer["wk"].astype(dt)).reshape(1, Tb, KH, Dh),
+                  positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(1, Tb, KH, Dh)
+        shape = kp.shape
+        kp = kp.reshape(-1, KH, Dh).at[rows].set(k[0]).reshape(shape)
+        vp = vp.reshape(-1, KH, Dh).at[rows].set(v[0]).reshape(shape)
+        attn = masked_gqa_attention(q, k, v, causal).reshape(1, Tb, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], real_len - 1, axis=0,
+                                        keepdims=False)
+    logits = last @ params["embed"].astype(dt).T
+    return logits, new_k, new_v
+
+
+class PagedGenerationEngine(GenerationEngine):
+    """GenerationEngine with paged KV memory.
+
+    ``num_pages`` bounds TOTAL cache memory independently of
+    slots * max_seq: requests reserve ceil((prompt+max_new)/page_size)
+    pages at admission (no mid-decode OOM) and queue FIFO when the pool
+    is exhausted. Page 0 is reserved as the scratch target for pad/idle
+    writes.
+    """
+
+    def __init__(self, params: Params, cfg: TransformerConfig, *,
+                 max_slots: int = 4, max_seq: Optional[int] = None,
+                 eos_id: Optional[int] = None, page_size: int = 128,
+                 num_pages: Optional[int] = None):
+        super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
+                         eos_id=eos_id)
+        L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self.page_size = ps = page_size
+        self.pages_per_slot = -(-self.max_seq // ps)
+        if num_pages is None:
+            num_pages = max_slots * self.pages_per_slot + 1  # +1 scratch
+        if num_pages < self.pages_per_slot + 1:
+            raise ValueError(
+                f"num_pages={num_pages} cannot fit one max_seq sequence "
+                f"({self.pages_per_slot} pages) plus the scratch page")
+        self.num_pages = num_pages
+        # Replace the contiguous pools from super().__init__ with pages.
+        del self.cache_k, self.cache_v
+        self.k_pages = jnp.zeros((L, num_pages, ps, KH, Dh), cfg.dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self.pool = PagePool(num_pages, ps)
+        self.pool.alloc(seq=-1, tokens=1)       # pin page 0 as scratch
+        assert self.pool.pages_for(-1) == [0]
+        # Device page tables, one row per slot (-1 padded). Rebuilt on
+        # admit/release; shape is fixed so nothing retraces.
+        self._tables = np.full((max_slots, self.pages_per_slot), -1,
+                               np.int32)
+
+    # ------------------------------------------------------------ hooks
+    def _pages_needed(self, req: _Request) -> int:
+        return -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
+
+    def _can_admit(self, req: _Request) -> bool:
+        return self.pool.free_pages >= self._pages_needed(req)
+
+    def _release_slot(self, slot: int) -> None:
+        super()._release_slot(slot)
+        self.pool.free(slot)
+        self._tables[slot] = -1
+
+    def _decode_all(self) -> jax.Array:
+        logits, self.k_pages, self.v_pages = _paged_decode(
+            self.params, jnp.asarray(self.tokens),
+            jnp.asarray(self.lengths), jnp.asarray(self._tables),
+            self.k_pages, self.v_pages, self.cfg)
+        return logits
+
+    def _prefill_slot(self, slot: int, req: _Request) -> bool:
+        T0 = len(req.prompt)
+        bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
+        padded = req.prompt + [0] * (bucket - T0)
+        # Reserve the request's full page budget up front (admission
+        # checked it fits): growth during decode can't OOM mid-flight.
+        self.pool.free(slot)  # defensive: slot ids are reused as seq ids
+        self.pool.alloc(slot, T0 + req.max_new_tokens)
+        pages = np.asarray(self.pool.pages_for(slot), np.int32)
+        self._tables[slot] = -1
+        self._tables[slot, :len(pages)] = pages
+        ps = self.page_size
+        # Global pool rows for every bucket position; pad positions beyond
+        # the owned range land on scratch page 0 (garbage, never attended).
+        logical = np.arange(bucket)
+        page_idx = logical // ps
+        owned = page_idx < len(pages)
+        rows = np.where(owned,
+                        pages[np.minimum(page_idx, len(pages) - 1)] * ps
+                        + logical % ps,
+                        logical % ps)  # scratch page 0
+        logits, self.k_pages, self.v_pages = _paged_prefill(
+            self.params, jnp.asarray(padded, jnp.int32)[None],
+            jnp.asarray(T0, jnp.int32), jnp.asarray(rows, jnp.int32),
+            self.k_pages, self.v_pages, self.cfg)
+        first = req.pick(np.asarray(logits))
+        req.out.append(first)
+        self.lengths[slot] = T0
+        self.tokens[slot] = first
+        if (len(req.out) >= req.max_new_tokens
+                or (self.eos_id is not None and first == self.eos_id)):
+            self.done[req.req_id] = req.out
+            self._release_slot(slot)
+            return True
+        return False

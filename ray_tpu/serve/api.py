@@ -64,10 +64,13 @@ def _require_master():
 
 
 def create_backend(backend_tag: str, func_or_class: Any, *init_args,
-                   config: Optional[BackendConfig] = None) -> None:
+                   config: Optional[BackendConfig] = None,
+                   **init_kwargs) -> None:
+    """Extra keyword arguments are passed to the backend class constructor
+    (e.g. ``LMBackend(..., paged=True, page_size=128)``)."""
     cfg = (config or BackendConfig()).to_dict()
     ray_tpu.get(_require_master().create_backend.remote(
-        backend_tag, func_or_class, init_args, cfg))
+        backend_tag, func_or_class, init_args, cfg, init_kwargs))
 
 
 def delete_backend(backend_tag: str) -> None:

@@ -23,13 +23,15 @@ class ReplicaActor:
     """One backend replica. Created by the ServeMaster as a plain actor."""
 
     def __init__(self, backend_tag: str, func_or_class: Any, init_args: tuple,
-                 user_config: dict):
+                 user_config: dict, init_kwargs: dict = None):
         self.backend_tag = backend_tag
+        init_kwargs = init_kwargs or {}
         if inspect.isclass(func_or_class):
-            self.callable = func_or_class(*init_args)
+            self.callable = func_or_class(*init_args, **init_kwargs)
         else:
-            if init_args:
-                raise ValueError("init args are only valid for class backends")
+            if init_args or init_kwargs:
+                raise ValueError(
+                    "init args/kwargs are only valid for class backends")
             self.callable = func_or_class
         self.user_config = user_config
         self.num_queries = 0

@@ -42,12 +42,24 @@ class LMBackend:
                  eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
                  max_seq: Optional[int] = None,
-                 stream_idle_timeout_s: float = 120.0):
-        from ..models.engine import GenerationEngine
+                 stream_idle_timeout_s: float = 120.0,
+                 paged: bool = False, page_size: int = 128,
+                 num_pages: Optional[int] = None):
+        if paged:
+            # Paged KV (models/paged_engine.py): cache memory bounded by
+            # num_pages instead of max_slots * max_seq; admission queues
+            # FIFO on page budget. Same outputs.
+            from ..models.paged_engine import PagedGenerationEngine
 
-        self.engine = GenerationEngine(
-            params, cfg, max_slots=max_slots, eos_id=eos_id,
-            max_seq=max_seq)
+            self.engine = PagedGenerationEngine(
+                params, cfg, max_slots=max_slots, eos_id=eos_id,
+                max_seq=max_seq, page_size=page_size, num_pages=num_pages)
+        else:
+            from ..models.engine import GenerationEngine
+
+            self.engine = GenerationEngine(
+                params, cfg, max_slots=max_slots, eos_id=eos_id,
+                max_seq=max_seq)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
         self._streams: dict = {}        # token -> engine req_id

@@ -62,7 +62,8 @@ class ServeMaster(ray_tpu.Checkpointable):
             "backends": {
                 tag: {"config": e["config"].to_dict(),
                       "func_or_class": e["func_or_class"],
-                      "init_args": e["init_args"]}
+                      "init_args": e["init_args"],
+                      "init_kwargs": e.get("init_kwargs", {})}
                 for tag, e in self.backends.items()
             },
             "replicas": {k: list(v) for k, v in self.replicas.items()},
@@ -74,7 +75,8 @@ class ServeMaster(ray_tpu.Checkpointable):
         self.backends = {
             tag: {"config": BackendConfig.from_dict(e["config"]),
                   "func_or_class": e["func_or_class"],
-                  "init_args": e["init_args"]}
+                  "init_args": e["init_args"],
+                  "init_kwargs": e.get("init_kwargs", {})}
             for tag, e in checkpoint["backends"].items()
         }
         self.replicas = checkpoint["replicas"]
@@ -94,13 +96,14 @@ class ServeMaster(ray_tpu.Checkpointable):
     # ---- backends ----
 
     def create_backend(self, backend_tag: str, func_or_class: Any,
-                       init_args: tuple, config_dict: dict) -> None:
+                       init_args: tuple, config_dict: dict,
+                       init_kwargs: Optional[dict] = None) -> None:
         if backend_tag in self.backends:
             raise ValueError(f"backend {backend_tag!r} already exists")
         config = BackendConfig.from_dict(config_dict)
         self.backends[backend_tag] = {
             "config": config, "func_or_class": func_or_class,
-            "init_args": init_args,
+            "init_args": init_args, "init_kwargs": dict(init_kwargs or {}),
         }
         self.replicas[backend_tag] = []
         self._scale(backend_tag, config.num_replicas)
@@ -141,7 +144,8 @@ class ServeMaster(ray_tpu.Checkpointable):
         while len(current) < target:
             h = ray_tpu.remote(num_cpus=0)(ReplicaActor).remote(
                 backend_tag, entry["func_or_class"], entry["init_args"],
-                dict(config.user_config))
+                dict(config.user_config),
+                entry.get("init_kwargs") or {})
             current.append(h)
         retired = []
         while len(current) > target:
