@@ -589,7 +589,8 @@ class NodeController:
         for _ in range(5):
             try:
                 resp = await asyncio.to_thread(self._gcs.call, {
-                    "type": "requeue_task", "task_id": task.get("task_id")})
+                    "type": "requeue_task", "task_id": task.get("task_id"),
+                    "node_id": self.node_id})
                 if resp.get("requeued"):
                     return
             except Exception:  # noqa: BLE001 - GCS unreachable: fall through
@@ -799,12 +800,18 @@ class NodeController:
             # than holding an RPC open against the admission queue.
             if not self._fits_local(admit["resources"]):
                 return {"ok": False, "error": "node busy"}
-            self._acquire_now(admit)
             try:
                 worker = await self._pop_idle_worker(timeout=5.0)
             except Exception as e:  # noqa: BLE001 - no worker: lease denied
-                self._release_local(admit)
                 return {"ok": False, "error": f"no idle worker: {e}"}
+            # Acquire only now that a worker is in hand, and re-check: the
+            # share must not be held across the idle-wait above, where it
+            # would starve queued tasks of that capacity for up to 5 s.
+            if not self._fits_local(admit["resources"]):
+                worker.idle = True
+                self._idle_event.set()
+                return {"ok": False, "error": "node busy"}
+            self._acquire_now(admit)
             worker.lease_id = msg["lease_id"]
             # conn kept so worker death can notify the owner (lease_lost):
             # the controller stays reachable, so no connection error would.
@@ -836,7 +843,20 @@ class NodeController:
                 return None
             if msg.get("return_ids"):
                 w.inflight[msg["return_ids"][0]] = task
-            await w.conn.send(dict(task, type="execute_task"))
+            try:
+                await w.conn.send(dict(task, type="execute_task"))
+            except Exception:  # noqa: BLE001 - worker died under the send
+                # Same recovery as the lease-vanished branch: the task
+                # never ran, so requeue without burning a retry and tell
+                # the owner — don't leave it to the death reaper alone.
+                if msg.get("return_ids"):
+                    w.inflight.pop(msg["return_ids"][0], None)
+                try:
+                    await conn.send({"type": "lease_lost",
+                                     "lease_id": msg["lease_id"]})
+                except Exception:  # noqa: BLE001
+                    pass
+                await self._requeue_direct(task)
             return None
 
         @s.handler("release_lease")

@@ -36,6 +36,12 @@ from .protocol import ResilientClient, RpcClient
 ERR_PREFIX = b"E"
 VAL_PREFIX = b"V"
 
+# Shared staleness window for owner-pushed direct refs the owner never
+# observed: both the submit-side backlog guard and the lease janitor use it,
+# and both confirm with the GCS that the result was actually produced before
+# dropping an entry (see _expire_direct_outstanding).
+DIRECT_STALE_S = 60.0
+
 
 class ClusterCoreWorker:
     def __init__(self, gcs_addr: Tuple[str, int],
@@ -99,6 +105,7 @@ class ClusterCoreWorker:
         self._direct_lock = threading.Lock()
         self._direct_leases: Dict[Tuple, Dict] = {}
         self._direct_outstanding: Dict[bytes, float] = {}  # rid -> push time
+        self._direct_expire_last = 0.0
         self._direct_janitor: Any = None
         self._ref_lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
@@ -439,18 +446,18 @@ class ClusterCoreWorker:
         lease is requested in the background so the NEXT submit hits it."""
         key = tuple(sorted(payload["resources"].items()))
         now = time.monotonic()
+        # Backlog guard: a leased worker executes serially, so a large
+        # fan-out belongs to the queued path where the kernel spreads it
+        # over the cluster. Stale entries (refs never get()ed) expire once
+        # the GCS confirms their results exist (outside the lock — the
+        # expiry makes an RPC).
+        if len(self._direct_outstanding) >= \
+                self.config.direct_call_max_outstanding:
+            self._expire_direct_outstanding(now)
         with self._direct_lock:
-            # Backlog guard: a leased worker executes serially, so a large
-            # fan-out belongs to the queued path where the kernel spreads it
-            # over the cluster. Stale entries (refs never get()ed) expire.
             if len(self._direct_outstanding) >= \
                     self.config.direct_call_max_outstanding:
-                for rid, t in list(self._direct_outstanding.items()):
-                    if now - t > 60.0:
-                        del self._direct_outstanding[rid]
-                if len(self._direct_outstanding) >= \
-                        self.config.direct_call_max_outstanding:
-                    return False
+                return False
             lease = self._direct_leases.get(key)
             if lease is None or lease.get("acquiring"):
                 if lease is None:
@@ -490,7 +497,8 @@ class ClusterCoreWorker:
             # with no record anywhere would strand the ObjectRefs forever.
             try:
                 resp = self.gcs.call({"type": "requeue_task",
-                                      "task_id": payload["task_id"]})
+                                      "task_id": payload["task_id"],
+                                      "node_id": lease["node_id"]})
                 return bool(resp.get("requeued"))
             except (ConnectionError, OSError):
                 return False
@@ -553,6 +561,10 @@ class ClusterCoreWorker:
             idle_s = self.config.direct_lease_idle_s
             now = time.monotonic()
             to_release = []
+            # Expire completed-but-never-observed entries first: an owner
+            # that pushes a few tasks and never get()/wait()s their refs
+            # must not pin the leased worker and its shares forever.
+            self._expire_direct_outstanding(now)
             with self._direct_lock:
                 if self._direct_outstanding:
                     # Pushed work may still be running on a leased worker;
@@ -567,6 +579,32 @@ class ClusterCoreWorker:
                         del self._direct_leases[key]
             for lease in to_release:
                 self._release_lease(lease)
+
+    def _expire_direct_outstanding(self, now: float) -> None:
+        """Drop outstanding direct refs older than DIRECT_STALE_S that the
+        owner never observed — but ONLY once the GCS confirms the result
+        (or its error blob) was actually produced. Age alone cannot
+        distinguish an unfetched completed task from a long-running one,
+        and treating a running task as stale would let the janitor release
+        its lease (and the node shares it occupies) mid-execution."""
+        with self._direct_lock:
+            stale = [rid for rid, t in self._direct_outstanding.items()
+                     if now - t > DIRECT_STALE_S]
+        if not stale or now - self._direct_expire_last < 5.0:
+            return
+        self._direct_expire_last = now
+        try:
+            resp = self.gcs.call({"type": "locations_batch",
+                                  "object_ids": stale}, timeout=5.0)
+        except Exception:  # noqa: BLE001 - GCS unreachable: keep entries
+            return
+        produced = resp.get("objects", {})
+        if not produced:
+            return
+        with self._direct_lock:
+            for rid in stale:
+                if rid in produced:
+                    self._direct_outstanding.pop(rid, None)
 
     def _release_lease(self, lease: Dict) -> None:
         try:

@@ -396,6 +396,7 @@ class GcsServer:
                     return
                 rec["node_id"] = nid
                 rec["state"] = "DISPATCHED"
+                rec["direct_dispatch"] = False  # this dispatch holds a share
                 if await self._dispatch_to_node(nid, rec):
                     return
                 # Node vanished between grant and send: put its share back
@@ -1198,6 +1199,11 @@ class GcsServer:
                 "resources": payload.get("resources", {}),
                 "retries_left": payload.get("max_retries", 0),
                 "state": "DISPATCHED", "node_id": msg["node_id"],
+                # Direct-push dispatches hold NO GCS resource share (the
+                # owner's lease does); _drive_task clears this when a
+                # requeue re-drives the record through the queue, whose
+                # dispatches DO acquire shares at placement.
+                "direct_dispatch": True,
                 "cancelled": False,
                 "return_ids": list(payload.get("return_ids", [])),
             }
@@ -1232,6 +1238,16 @@ class GcsServer:
             if rec is None:
                 return {"ok": True, "requeued": False}
             if rec["state"] == "DISPATCHED" and rec["kind"] == "task":
+                if not rec.get("direct_dispatch"):
+                    # Stale/duplicate requeue: the record was already
+                    # re-driven through the queue (that dispatch acquired a
+                    # node share at placement) — flipping it again would
+                    # both leak that share and run the task twice.
+                    return {"ok": True, "requeued": True}
+                if msg.get("node_id") is not None \
+                        and rec["node_id"] != msg["node_id"]:
+                    # Requeue for a dispatch the caller no longer owns.
+                    return {"ok": True, "requeued": True}
                 rec["state"] = "PENDING"
                 rec["node_id"] = None
                 self._spawn(self._drive_task(rec))
@@ -1352,6 +1368,16 @@ class GcsServer:
                 # (e.g. the reporter was declared dead after a heartbeat
                 # blip). Don't double-drive it.
                 return {"ok": True, "will_retry": True}
+            if rec["state"] == "PENDING":
+                # Already re-driven (requeue_task / _redrive_unsent /
+                # node-death sweep beat this report): a _drive_task is in
+                # flight for the record — spawning another would run the
+                # task twice and double-release its node share.
+                return {"ok": True, "will_retry": True}
+            if rec["state"] in ("FINISHED", "FAILED"):
+                # Terminal: the result (or error) is already served; a late
+                # failure report must not resurrect the record.
+                return {"ok": True, "will_retry": False}
             if rec["kind"] == "actor":
                 # Restart decision happens on the update_actor DEAD path.
                 return {"ok": True, "will_retry": False}

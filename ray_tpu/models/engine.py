@@ -177,16 +177,25 @@ class GenerationEngine:
         self.slots = max_slots
         self.max_seq = max_seq or cfg.max_seq_len
         self.eos_id = eos_id
-        L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        self.cache_k = jnp.zeros((L, max_slots, self.max_seq, KH, Dh),
-                                 cfg.dtype)
-        self.cache_v = jnp.zeros_like(self.cache_k)
+        self._alloc_cache()
         self.lengths = np.zeros(max_slots, np.int32)
         self.tokens = np.zeros(max_slots, np.int32)   # last token per slot
         self.active: List[Optional[_Request]] = [None] * max_slots
         self.queue: List[_Request] = []
         self.done: Dict[int, List[int]] = {}
         self._next_id = 0
+
+    def _alloc_cache(self) -> None:
+        """Materialise the KV store on device. A hook so subclasses with a
+        different storage scheme (paged) never allocate the contiguous
+        [L, slots, max_seq, KH, Dh] pool — even transiently, since at small
+        page budgets that spike alone can OOM the HBM the paged engine is
+        bounding."""
+        cfg = self.cfg
+        L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self.cache_k = jnp.zeros((L, self.slots, self.max_seq, KH, Dh),
+                                 cfg.dtype)
+        self.cache_v = jnp.zeros_like(self.cache_k)
 
     # ---- public API ----
 

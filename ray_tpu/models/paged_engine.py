@@ -150,8 +150,6 @@ class PagedGenerationEngine(GenerationEngine):
                 f"num_pages={num_pages} cannot fit one max_seq sequence "
                 f"({self.pages_per_slot} pages) plus the scratch page")
         self.num_pages = num_pages
-        # Replace the contiguous pools from super().__init__ with pages.
-        del self.cache_k, self.cache_v
         self.k_pages = jnp.zeros((L, num_pages, ps, KH, Dh), cfg.dtype)
         self.v_pages = jnp.zeros_like(self.k_pages)
         self.pool = PagePool(num_pages, ps)
@@ -163,6 +161,13 @@ class PagedGenerationEngine(GenerationEngine):
                                np.int32)
 
     # ------------------------------------------------------------ hooks
+    def _alloc_cache(self) -> None:
+        """Pages are allocated in __init__ (they need page_size/num_pages,
+        known only after super().__init__ returns); crucially the base
+        class's contiguous [L, slots, max_seq, KH, Dh] pool is NEVER
+        materialised — the transient spike would defeat the paged engine's
+        HBM bound at exactly the small num_pages configs it exists for."""
+
     def _pages_needed(self, req: _Request) -> int:
         return -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
 

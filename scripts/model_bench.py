@@ -127,21 +127,30 @@ def bench_decode(*, batch: int, seq: int, new_tokens: int, cfg=None):
                  max_new_tokens=new_tokens).block_until_ready()
     seq_wall = time.time() - t0
 
-    eng = GenerationEngine(params, cfg, max_slots=batch, max_seq=seq)
-    for p in prompts:
-        eng.submit(p, new_tokens)
-    eng.run_until_done()                       # warm compiles
-    for p in prompts:
-        eng.submit(p, new_tokens)
-    t0 = time.time()
-    eng.run_until_done()
-    eng_wall = time.time() - t0
+    def engine_wall(eng) -> float:
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        eng.run_until_done()                   # warm compiles
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        t0 = time.time()
+        eng.run_until_done()
+        return time.time() - t0
+
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    eng_wall = engine_wall(
+        GenerationEngine(params, cfg, max_slots=batch, max_seq=seq))
+    paged_wall = engine_wall(
+        PagedGenerationEngine(params, cfg, max_slots=batch, max_seq=seq))
     total = batch * new_tokens
     return {
         "prompt_len": T0, "new_tokens": new_tokens, "requests": batch,
         "sequential_tokens_per_sec": round(total / seq_wall, 1),
         "engine_tokens_per_sec": round(total / eng_wall, 1),
+        "paged_engine_tokens_per_sec": round(total / paged_wall, 1),
         "engine_speedup": round(seq_wall / eng_wall, 2),
+        "paged_vs_contiguous": round(eng_wall / paged_wall, 2),
     }
 
 
