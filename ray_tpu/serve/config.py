@@ -20,6 +20,13 @@ class BackendConfig:
     max_batch_size: int = 0  # 0 = no batching
     batch_wait_timeout_s: float = 0.01
     max_concurrent_queries: int = 8
+    # Actor-level max_concurrency for each replica: how many RPCs (batch
+    # calls, streaming long-polls) may PARK in the replica concurrently.
+    # Default 1 = serial execution, safe for any user backend; streaming
+    # backends (serve.lm.LMBackend) are internally locked and should run
+    # with replica_concurrency >= expected concurrent streams so a
+    # long-poll never blocks batch-mates.
+    replica_concurrency: int = 1
     user_config: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -29,6 +36,8 @@ class BackendConfig:
             raise ValueError("max_batch_size must be >= 0")
         if self.max_concurrent_queries < 1:
             raise ValueError("max_concurrent_queries must be >= 1")
+        if self.replica_concurrency < 1:
+            raise ValueError("replica_concurrency must be >= 1")
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -36,6 +45,7 @@ class BackendConfig:
             "max_batch_size": self.max_batch_size,
             "batch_wait_timeout_s": self.batch_wait_timeout_s,
             "max_concurrent_queries": self.max_concurrent_queries,
+            "replica_concurrency": self.replica_concurrency,
             "user_config": dict(self.user_config),
         }
 

@@ -31,18 +31,23 @@ class ServeHandle:
 
         Requires the backend to expose ``stream_start``/``stream_poll``
         (e.g. serve.lm.LMBackend): yields each token as the replica's
-        engine produces it. Closing the generator early cancels the
-        server-side stream.
+        engine produces it. Polls are LONG-POLLS — the replica replies as
+        soon as it has buffered tokens (its pump thread decodes
+        independently of this loop), so one round-trip carries a batch of
+        tokens. Closing the generator early cancels the server-side
+        stream.
         """
         import ray_tpu
 
+        wait_s = float(kwargs.pop("poll_wait_s", 2.0))
         token = ray_tpu.get(self._router.route.remote(
             self._endpoint, "stream_start", args, kwargs))
         finished = False
         try:
             while True:
                 out = ray_tpu.get(self._router.route.remote(
-                    self._endpoint, "stream_poll", (token,), {}))
+                    self._endpoint, "stream_poll", (token,),
+                    {"wait_s": wait_s}))
                 for t in out["tokens"]:
                     yield t
                 if out["done"]:

@@ -73,9 +73,12 @@ class HTTPProxyActor:
                     self._reply(500, {"error": str(e)})
 
             def _stream(self, endpoint: str, args, kwargs):
-                """Chunked transfer: one JSON line per engine tick, written
-                as tokens arrive (the shape an LM client needs). Requires a
-                backend with stream_start/stream_poll (serve.lm.LMBackend)."""
+                """Chunked transfer: one JSON line per long-poll reply,
+                written as tokens arrive (the shape an LM client needs).
+                The replica's pump thread decodes independently of this
+                loop, so each round-trip drains a batch of buffered tokens
+                rather than at most one. Requires a backend with
+                stream_start/stream_poll (serve.lm.LMBackend)."""
                 token = ray_tpu.get(proxy.router.route.remote(
                     endpoint, "stream_start", args, kwargs))
                 self.send_response(200)
@@ -89,7 +92,8 @@ class HTTPProxyActor:
                 try:
                     while True:
                         out = ray_tpu.get(proxy.router.route.remote(
-                            endpoint, "stream_poll", (token,), {}))
+                            endpoint, "stream_poll", (token,),
+                            {"wait_s": 2.0}))
                         if out["tokens"] or out["done"]:
                             chunk(json.dumps(
                                 {"tokens": out["tokens"],

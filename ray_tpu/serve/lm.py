@@ -10,16 +10,31 @@ every request in lockstep on shared batch slots — concurrent callers share
 MXU work instead of serializing. The engine (caches, compiled programs)
 persists across batches, so steady-state serving never recompiles.
 
+Streaming is push-shaped, not poll-driven: a dedicated PUMP THREAD owns
+the engine and decodes continuously whenever any request is active,
+buffering each stream's tokens as they are produced — the decode rate is
+decoupled from any RPC round-trip. ``stream_poll`` is a LONG-POLL: it
+blocks (up to ``wait_s``) until tokens exist, then drains the whole
+buffer in one reply, so one router round-trip carries a batch of tokens
+instead of at most one. Run replicas with
+``BackendConfig(replica_concurrency=N)`` so N concurrent long-polls (and
+whole-response batches) park in the replica without serializing.
+
     serve.create_backend(
         "lm:v1", LMBackend, params, cfg,
-        config=BackendConfig(max_batch_size=8, max_concurrent_queries=16))
+        config=BackendConfig(max_batch_size=8, max_concurrent_queries=16,
+                             replica_concurrency=8))
     serve.create_endpoint("generate", backend="lm:v1")
     h = serve.get_handle("generate")
     tokens = ray_tpu.get(h.remote([1, 2, 3], max_new_tokens=16))
+    for tok in h.stream([1, 2, 3], max_new_tokens=16):
+        ...
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, List, Optional
 
 from .api import accept_batch
@@ -28,14 +43,12 @@ from .config import ServeRequest
 
 class LMBackend:
     """Class backend for `serve.create_backend`: generation with
-    cross-request continuous batching.
+    cross-request continuous batching and push-style streaming.
 
-    Streaming: ``stream_start`` submits a request and returns an opaque
-    stream token; ``stream_poll`` advances the shared engine one tick and
-    returns the tokens produced since the last poll. Streams and whole-
-    response batches share the same engine slots, so a streaming caller and
-    a batch caller decode in lockstep on the MXU (the router pins polls to
-    the replica that started the stream).
+    All engine access is serialized under one condition variable; the pump
+    thread is the only caller of ``engine.step()``. Whole-response calls
+    submit and wait; streams submit and drain their token buffers as the
+    pump fills them.
     """
 
     def __init__(self, params: Any, cfg: Any, *, max_slots: int = 8,
@@ -62,10 +75,15 @@ class LMBackend:
                 max_seq=max_seq)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
+        # RLock: stream_poll -> _expire_idle_streams -> stream_cancel
+        # re-enters the lock.
+        self._cond = threading.Condition(threading.RLock())
+        self._pump_thread: Optional[threading.Thread] = None
         self._streams: dict = {}        # token -> engine req_id
         self._stream_bufs: dict = {}    # req_id -> [undelivered tokens]
         self._stream_done: set = set()  # req_ids whose last token is buffered
         self._stream_seen: dict = {}    # token -> last poll/start time
+        self._failed: dict = {}         # req_id -> exception from the pump
 
     def _parse(self, r: ServeRequest):
         if len(r.args) > 2:
@@ -84,41 +102,88 @@ class LMBackend:
         seed = r.kwargs.get("seed")
         return prompt, n, temperature, seed
 
-    def _pump(self) -> None:
-        """One engine tick; capture every event that belongs to a stream so
-        interleaved whole-response batches can't swallow stream tokens."""
-        for rid, tok, done in self.engine.step():
-            buf = self._stream_bufs.get(rid)
-            if buf is not None:
-                buf.append(tok)
-                if done:
-                    self._stream_done.add(rid)
-                    # A stream's tokens live in its buffer; drop the
-                    # engine-side duplicate accumulated in done.
-                    self.engine.done.pop(rid, None)
+    # -------------------------------------------------------------- pump
+    def _ensure_pump(self) -> None:
+        """Start the decode thread lazily (under self._cond)."""
+        if self._pump_thread is None or not self._pump_thread.is_alive():
+            self._pump_thread = threading.Thread(
+                target=self._pump_loop, name="lm-engine-pump", daemon=True)
+            self._pump_thread.start()
+
+    def _engine_has_work(self) -> bool:
+        return bool(self.engine.queue
+                    or any(r is not None for r in self.engine.active))
+
+    def _pump_loop(self) -> None:
+        """The ONLY caller of engine.step(): decodes continuously while any
+        request is live, sleeps on the condition otherwise. Each tick's
+        stream events land in their buffers and every waiter (long-polls,
+        whole-response calls) is woken."""
+        while True:
+            with self._cond:
+                while not self._engine_has_work():
+                    self._cond.wait()
+                try:
+                    events = self.engine.step()
+                except BaseException as e:  # noqa: BLE001
+                    # The pump dying silently would hang every waiter
+                    # forever (the old inline pump surfaced errors on the
+                    # polling RPC): fail every live request with the error
+                    # and drain the engine so a poisoned step can't rerun.
+                    self._poison(e)
+                    continue
+                for rid, tok, done in events:
+                    buf = self._stream_bufs.get(rid)
+                    if buf is not None:
+                        buf.append(tok)
+                        if done:
+                            self._stream_done.add(rid)
+                            # A stream's tokens live in its buffer; drop
+                            # the engine-side duplicate kept in done.
+                            self.engine.done.pop(rid, None)
+                self._cond.notify_all()
+
+    def _poison(self, err: BaseException) -> None:
+        """Fail every queued/active request with ``err`` (under _cond):
+        whole-response waiters raise it, stream pollers raise it, and the
+        engine's slots/queue are cleared so the next submission starts
+        from an idle engine rather than re-running the failing step."""
+        rids = [r.req_id for r in self.engine.queue]
+        rids += [r.req_id for r in self.engine.active if r is not None]
+        for rid in rids:
+            self._failed[rid] = err
+            self.engine.cancel(rid)
+        self._cond.notify_all()
 
     @accept_batch
     def __call__(self, requests: List[ServeRequest]) -> List[List[int]]:
         parsed = [self._parse(r) for r in requests]
-        # Validate every request BEFORE submitting any: a bad one must not
-        # leave its batch-mates orphaned inside the engine (they would keep
-        # decoding with no caller and leak into engine.done forever).
-        for prompt, n, t, sd in parsed:
-            self.engine.validate(prompt, n, t, sd)
-        ids = [self.engine.submit(p, n, temperature=t, seed=s)
-               for p, n, t, s in parsed]
-        pending = set(ids)
-        while pending:
-            self._pump()
-            pending -= self.engine.done.keys()
-        return [self.engine.done.pop(rid) for rid in ids]
+        with self._cond:
+            # Validate every request BEFORE submitting any: a bad one must
+            # not leave its batch-mates orphaned inside the engine (they
+            # would keep decoding with no caller and leak into engine.done
+            # forever).
+            for prompt, n, t, sd in parsed:
+                self.engine.validate(prompt, n, t, sd)
+            ids = [self.engine.submit(p, n, temperature=t, seed=s)
+                   for p, n, t, s in parsed]
+            self._ensure_pump()
+            self._cond.notify_all()
+            while not all(rid in self.engine.done or rid in self._failed
+                          for rid in ids):
+                self._cond.wait(0.5)
+            errs = [self._failed.pop(rid) for rid in ids
+                    if rid in self._failed]
+            if errs:
+                for rid in ids:
+                    self.engine.done.pop(rid, None)
+                raise errs[0]
+            return [self.engine.done.pop(rid) for rid in ids]
 
     # ------------------------------------------------------------- streaming
     def _expire_idle_streams(self) -> None:
         """A poller that vanished without cancel (crashed client, SIGKILLed
         proxy) must not occupy one of max_slots forever."""
-        import time
-
         cutoff = time.monotonic() - self.stream_idle_timeout_s
         for token, seen in list(self._stream_seen.items()):
             if seen < cutoff:
@@ -126,53 +191,72 @@ class LMBackend:
 
     def stream_start(self, prompt, max_new_tokens: Optional[int] = None,
                      temperature: float = 0.0, seed=None) -> str:
-        import time
         import uuid
 
-        self._expire_idle_streams()
         prompt = list(prompt)
         n = int(max_new_tokens if max_new_tokens is not None
                 else self.default_max_new_tokens)
-        self.engine.validate(prompt, n, float(temperature), seed)
-        rid = self.engine.submit(prompt, n, temperature=float(temperature),
-                                 seed=seed)
-        token = uuid.uuid4().hex
-        self._streams[token] = rid
-        self._stream_bufs[rid] = []
-        self._stream_seen[token] = time.monotonic()
+        with self._cond:
+            self._expire_idle_streams()
+            self.engine.validate(prompt, n, float(temperature), seed)
+            rid = self.engine.submit(prompt, n,
+                                     temperature=float(temperature),
+                                     seed=seed)
+            token = uuid.uuid4().hex
+            self._streams[token] = rid
+            self._stream_bufs[rid] = []
+            self._stream_seen[token] = time.monotonic()
+            self._ensure_pump()
+            self._cond.notify_all()
         return token
 
-    def stream_poll(self, token: str) -> dict:
-        """Return {"tokens": [...], "done": bool}: everything produced for
-        this stream since the last poll. Advances the engine at most one
-        tick per poll (and only when this stream has nothing buffered), so
-        a fast poller can't starve batch-mates of host cycles."""
-        import time
-
-        rid = self._streams.get(token)
-        if rid is None:
-            raise KeyError(f"unknown or finished stream {token!r}")
-        self._stream_seen[token] = time.monotonic()
-        self._expire_idle_streams()
-        if not self._stream_bufs.get(rid) and rid not in self._stream_done:
-            self._pump()
-        out = self._stream_bufs.get(rid, [])
-        self._stream_bufs[rid] = []
-        done = rid in self._stream_done
-        if done:
-            self._drop_stream(token, rid)
-        return {"tokens": out, "done": done}
+    def stream_poll(self, token: str, wait_s: float = 0.0) -> dict:
+        """Long-poll: block until this stream has tokens (or is done), up
+        to ``wait_s``, then return EVERYTHING buffered —
+        {"tokens": [...], "done": bool}. The pump thread decodes
+        regardless, so a slow poller never slows generation and one reply
+        amortizes many tokens."""
+        deadline = time.monotonic() + max(0.0, float(wait_s))
+        with self._cond:
+            rid = self._streams.get(token)
+            if rid is None:
+                raise KeyError(f"unknown or finished stream {token!r}")
+            self._expire_idle_streams()
+            while True:
+                # Cancelled under us (idle expiry / client cancel raced a
+                # parked poll)? Re-check BEFORE touching _stream_seen: a
+                # refresh for a dropped token would resurrect a seen-entry
+                # nothing ever removes.
+                if self._streams.get(token) != rid:
+                    raise KeyError(f"unknown or finished stream {token!r}")
+                self._stream_seen[token] = time.monotonic()
+                if rid in self._failed:
+                    err = self._failed.pop(rid)
+                    self._drop_stream(token, rid)
+                    raise err
+                out = self._stream_bufs.get(rid, [])
+                done = rid in self._stream_done
+                remaining = deadline - time.monotonic()
+                if out or done or remaining <= 0:
+                    break
+                self._cond.wait(min(0.5, remaining))
+            self._stream_bufs[rid] = []
+            if done:
+                self._drop_stream(token, rid)
+            return {"tokens": out, "done": done}
 
     def stream_cancel(self, token: str) -> bool:
-        rid = self._streams.get(token)
-        if rid is None:
-            return False
-        self.engine.cancel(rid)
-        self._drop_stream(token, rid)
-        return True
+        with self._cond:
+            rid = self._streams.get(token)
+            if rid is None:
+                return False
+            self.engine.cancel(rid)
+            self._drop_stream(token, rid)
+            return True
 
     def _drop_stream(self, token: str, rid: int) -> None:
         self._streams.pop(token, None)
         self._stream_bufs.pop(rid, None)
         self._stream_done.discard(rid)
         self._stream_seen.pop(token, None)
+        self._failed.pop(rid, None)

@@ -142,7 +142,8 @@ class ServeMaster(ray_tpu.Checkpointable):
         current = self.replicas[backend_tag]
         config: BackendConfig = entry["config"]
         while len(current) < target:
-            h = ray_tpu.remote(num_cpus=0)(ReplicaActor).remote(
+            h = ray_tpu.remote(num_cpus=0)(ReplicaActor).options(
+                max_concurrency=config.replica_concurrency).remote(
                 backend_tag, entry["func_or_class"], entry["init_args"],
                 dict(config.user_config),
                 entry.get("init_kwargs") or {})

@@ -169,14 +169,28 @@ class Router:
             raise KeyError(f"unknown or finished stream {token!r}")
         entry[2] = time.monotonic()
         r = entry[1]
-        out = await self._call_replica(r, method, args, kwargs)
+        # Polls/cancels bypass the per-replica semaphore: a LONG-POLL parks
+        # at the replica doing no work (its pump thread decodes regardless),
+        # so letting it hold a max_concurrent_queries slot for up to wait_s
+        # would starve whole-response traffic. Inflight polls are naturally
+        # bounded at one per live stream; the replica's own max_concurrency
+        # (BackendConfig.replica_concurrency) bounds actual execution.
+        out = await self._call_replica(r, method, args, kwargs,
+                                       limit=False)
         if method == "stream_cancel" or (
                 isinstance(out, dict) and out.get("done")):
             self._streams.pop(token, None)
         return out
 
     async def _call_replica(self, r: _Replica, method: str, args: tuple,
-                            kwargs: dict) -> Any:
+                            kwargs: dict, *, limit: bool = True) -> Any:
+        if not limit:
+            r.inflight += 1
+            try:
+                return await r.handle.handle_request.remote(
+                    method, args, kwargs)
+            finally:
+                r.inflight -= 1
         async with r.sem:
             r.inflight += 1
             try:

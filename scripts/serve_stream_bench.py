@@ -1,0 +1,102 @@
+"""A/B: streamed tokens/s vs whole-response tokens/s on the same prompts.
+
+Round-4 verdict: the poll-per-token stream path serialized decode on the
+router RTT. The push redesign (replica pump thread + long-poll token
+batches, serve/lm.py) should bring streaming overhead near zero. This
+script measures both modes end-to-end through serve (router + replica
+actors) and prints one JSON line; run it on CPU or chip.
+
+    python scripts/serve_stream_bench.py [--new-tokens 64] [--streams 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--streams", type=int, default=4,
+                    help="concurrent streams in the concurrent phase")
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=args.d_model, n_layers=args.layers,
+        n_heads=4, n_kv_heads=4, d_ff=args.d_model * 4, max_seq_len=512,
+        dtype=jnp.float32 if jax.default_backend() == "cpu"
+        else jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    serve.init()
+    out = {"backend": jax.default_backend(), "new_tokens": args.new_tokens,
+           "streams": args.streams}
+    try:
+        serve.create_backend(
+            "lm:bench", LMBackend, params, cfg,
+            config=BackendConfig(max_concurrent_queries=16,
+                                 replica_concurrency=args.streams + 2))
+        serve.create_endpoint("gen", backend="lm:bench")
+        h = serve.get_handle("gen")
+        prompt = [1, 2, 3, 4]
+        n = args.new_tokens
+
+        # Warm compiles on both paths.
+        ray_tpu.get(h.remote(prompt, max_new_tokens=4))
+        list(h.stream(prompt, max_new_tokens=4))
+
+        t0 = time.time()
+        whole = ray_tpu.get(h.remote(prompt, max_new_tokens=n))
+        whole_wall = time.time() - t0
+
+        t0 = time.time()
+        streamed = list(h.stream(prompt, max_new_tokens=n))
+        stream_wall = time.time() - t0
+        assert streamed == whole, "stream output diverged from whole-response"
+
+        out["whole_tokens_per_sec"] = round(n / whole_wall, 1)
+        out["stream_tokens_per_sec"] = round(n / stream_wall, 1)
+        out["stream_overhead_pct"] = round(
+            100.0 * (stream_wall - whole_wall) / whole_wall, 1)
+
+        # Concurrent streams: aggregate tokens/s across S generators.
+        results = [None] * args.streams
+        def run(i):
+            results[i] = list(h.stream(prompt, max_new_tokens=n))
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(args.streams)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        conc_wall = time.time() - t0
+        assert all(r == whole for r in results), "concurrent stream diverged"
+        out["concurrent_stream_tokens_per_sec"] = round(
+            args.streams * n / conc_wall, 1)
+        out["concurrent_scaling_x"] = round(
+            (args.streams * n / conc_wall) / (n / stream_wall), 2)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -216,3 +216,33 @@ def test_http_streaming_chunked(local_ray):
         assert saw_incremental >= 2  # arrived over multiple chunks
     finally:
         serve.shutdown()
+
+
+def test_lm_backend_pump_error_propagates():
+    """A failing engine step must surface on the waiting RPCs (whole-
+    response raises; stream_poll raises) instead of silently killing the
+    pump thread and hanging every caller forever."""
+    import pytest
+
+    from ray_tpu.serve.config import ServeRequest
+    from ray_tpu.serve.lm import LMBackend
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    b = LMBackend(params, cfg, max_slots=2)
+
+    def boom():
+        raise RuntimeError("device exploded")
+
+    b.engine.step = lambda: boom()
+    with pytest.raises(RuntimeError, match="device exploded"):
+        b([ServeRequest(([1, 2, 3],), {"max_new_tokens": 4})])
+    # Engine drained: nothing active or queued after the poison.
+    assert not b.engine.queue and not any(
+        r is not None for r in b.engine.active)
+
+    token = b.stream_start([1, 2], max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="device exploded"):
+        b.stream_poll(token, wait_s=5.0)
+    # The failed stream is fully dropped — no leaked bookkeeping.
+    assert not b._streams and not b._stream_seen and not b._failed
