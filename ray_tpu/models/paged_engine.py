@@ -19,6 +19,17 @@ corrupt a live sequence.
 
 Greedy outputs are bit-exact vs the contiguous engine and
 single-request `generate()` (same math, different storage).
+
+Prefix caching (the standard step beyond vLLM's block manager): finished
+prompts leave their IMMUTABLE full page-aligned blocks resident in the
+pool, keyed by a chained content hash; a later prompt with the same head
+joins those pages read-only (refcounted) instead of re-storing them, so
+same-prefix fan-out admits ~pool/incremental-pages concurrent requests
+instead of pool/total-pages. Cache-pinned pages evict LRU under pool
+pressure. Shared pages are never re-written (prefill routes their scatter
+rows to the scratch page): another live sequence may be attending to them,
+and a re-computed row can differ in low bits when the original prefill
+compiled at a different bucket length.
 """
 
 from __future__ import annotations
@@ -159,6 +170,7 @@ class PagedGenerationEngine(GenerationEngine):
         # admit/release; shape is fixed so nothing retraces.
         self._tables = np.full((max_slots, self.pages_per_slot), -1,
                                np.int32)
+        self._prompt_keys: dict = {}  # req_id -> prefix block keys (memo)
 
     # ------------------------------------------------------------ hooks
     def _alloc_cache(self) -> None:
@@ -168,11 +180,56 @@ class PagedGenerationEngine(GenerationEngine):
         materialised — the transient spike would defeat the paged engine's
         HBM bound at exactly the small num_pages configs it exists for."""
 
-    def _pages_needed(self, req: _Request) -> int:
-        return -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
+    def _prefix_keys(self, prompt: List[int]) -> List[int]:
+        """Chained hashes of the prompt's IMMUTABLE full blocks — those
+        strictly before the decode boundary (decode writes start at
+        position len(prompt), so block j is immutable iff
+        (j+1)*page_size <= len(prompt))."""
+        ps = self.page_size
+        keys, h = [], 0
+        for j in range(len(prompt) // ps):
+            h = PagePool.chain_hash(h, prompt[j * ps:(j + 1) * ps])
+            keys.append(h)
+        return keys
+
+    def _keys_for(self, req: _Request) -> List[int]:
+        """Memoized per request: _can_admit runs every engine tick while a
+        request waits at the queue head, and rehashing the whole prompt
+        per generated token of its batch-mates would be O(prompt) host
+        work per tick. Entries for departed requests are pruned against
+        the live queue."""
+        keys = self._prompt_keys.get(req.req_id)
+        if keys is None:
+            live = {r.req_id for r in self.queue}
+            self._prompt_keys = {rid: k for rid, k
+                                 in self._prompt_keys.items() if rid in live}
+            keys = self._prompt_keys[req.req_id] = \
+                self._prefix_keys(req.prompt)
+        return keys
+
+    def _prefix_hits(self, prompt: List[int]) -> int:
+        """Longest run of consecutive cached blocks from the start
+        (non-mutating probe — no LRU promotion)."""
+        hits = 0
+        for key in self._prefix_keys(prompt):
+            if self.pool.cache_peek(key) is None:
+                break
+            hits += 1
+        return hits
 
     def _can_admit(self, req: _Request) -> bool:
-        return self.pool.free_pages >= self._pages_needed(req)
+        total = -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
+        hits = 0
+        for key in self._keys_for(req):
+            if self.pool.cache_peek(key) is None:
+                break
+            hits += 1
+        # Cache-pinned pages no live sequence reads are reclaimable on
+        # demand (alloc evicts LRU) — but the request's own hit pages are
+        # among them and will be share()d, not evicted, so they must not
+        # be double-counted as reclaimable headroom.
+        reclaimable = max(0, self.pool.evictable_pages - hits)
+        return self.pool.free_pages + reclaimable >= total - hits
 
     def _release_slot(self, slot: int) -> None:
         super()._release_slot(slot)
@@ -190,20 +247,36 @@ class PagedGenerationEngine(GenerationEngine):
         T0 = len(req.prompt)
         bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
         padded = req.prompt + [0] * (bucket - T0)
-        # Reserve the request's full page budget up front (admission
-        # checked it fits): growth during decode can't OOM mid-flight.
         self.pool.free(slot)  # defensive: slot ids are reused as seq ids
+        # Prefix reuse: join the longest cached run of immutable prompt
+        # blocks (their K/V is already resident — same tokens at the same
+        # absolute positions), then reserve the REST of the page budget up
+        # front (admission checked it fits): growth during decode can't
+        # OOM mid-flight.
+        keys = self._prompt_keys.pop(req.req_id, None) \
+            or self._prefix_keys(req.prompt)
+        shared: List[int] = []
+        for key in keys:
+            page = self.pool.cache_get(key)
+            if page is None:
+                break
+            shared.append(page)
+        self.pool.share(slot, shared)
         self.pool.alloc(slot, T0 + req.max_new_tokens)
         pages = np.asarray(self.pool.pages_for(slot), np.int32)
         self._tables[slot] = -1
         self._tables[slot, :len(pages)] = pages
         ps = self.page_size
         # Global pool rows for every bucket position; pad positions beyond
-        # the owned range land on scratch page 0 (garbage, never attended).
+        # the owned range AND shared-prefix positions land on scratch page
+        # 0: a shared page is immutable (another live sequence may be
+        # attending to it mid-decode), and this prefill's recomputed rows
+        # could differ in low bits when the original was compiled at a
+        # different bucket length.
         logical = np.arange(bucket)
         page_idx = logical // ps
-        owned = page_idx < len(pages)
-        rows = np.where(owned,
+        writable = (page_idx < len(pages)) & (page_idx >= len(shared))
+        rows = np.where(writable,
                         pages[np.minimum(page_idx, len(pages) - 1)] * ps
                         + logical % ps,
                         logical % ps)  # scratch page 0
@@ -211,6 +284,10 @@ class PagedGenerationEngine(GenerationEngine):
             self.params, jnp.asarray(padded, jnp.int32)[None],
             jnp.asarray(T0, jnp.int32), jnp.asarray(rows, jnp.int32),
             self.k_pages, self.v_pages, self.cfg)
+        # The blocks this prefill just wrote are now resident + immutable:
+        # publish them so later prompts with the same head reuse the pages.
+        for j in range(len(shared), len(keys)):
+            self.pool.cache_put(keys[j], int(pages[j]))
         first = req.pick(np.asarray(logits))
         req.out.append(first)
         self.lengths[slot] = T0

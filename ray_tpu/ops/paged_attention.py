@@ -132,6 +132,13 @@ class PagePool:
     Free pages are a LIFO; sequences append pages as they grow and return
     them on free. Raises when the pool is exhausted — admission control
     (e.g. an engine's slot queue) decides what to do about it.
+
+    Pages are REFCOUNTED so immutable prompt blocks can be shared between
+    sequences (prefix caching — the step beyond vLLM's block manager the
+    reference never had): ``share`` joins an existing page to another
+    sequence; the prefix CACHE maps a chained content hash of page-aligned
+    prompt blocks to the resident page holding its K/V, pinning it (one
+    cache ref) until pool pressure evicts it LRU via ``evict``.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -139,33 +146,110 @@ class PagePool:
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._owned: dict = {}  # seq id -> [page ids]
+        self._refs: List[int] = [0] * num_pages
+        # Chained-hash prefix cache: key -> page id (insertion-ordered =
+        # LRU, refreshed on hit). Each entry holds one pinning ref.
+        self._prefix_cache: dict = {}
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def evictable_pages(self) -> int:
+        """Cached pages pinned ONLY by the cache (refcount 1): reclaimable
+        on demand, so admission may count them as free."""
+        return sum(1 for p in self._prefix_cache.values()
+                   if self._refs[p] == 1)
 
     def pages_for(self, seq: int) -> List[int]:
         return list(self._owned.get(seq, ()))
 
     def alloc(self, seq: int, tokens: int) -> List[int]:
         """Ensure ``seq`` owns enough pages for ``tokens`` total tokens;
-        returns newly allocated page ids (may be empty)."""
+        returns newly allocated page ids (may be empty). Evicts unpinned
+        prefix-cache pages LRU when the free list alone cannot satisfy."""
         owned = self._owned.setdefault(seq, [])
         need = -(-tokens // self.page_size) - len(owned)
         if need <= 0:
             return []
         if need > len(self._free):
+            self.evict(need - len(self._free))
+        if need > len(self._free):
             raise MemoryError(
                 f"page pool exhausted: need {need}, free {len(self._free)}")
         new = [self._free.pop() for _ in range(need)]
+        for p in new:
+            self._refs[p] = 1
         owned.extend(new)
         return new
 
+    def share(self, seq: int, page_ids: List[int]) -> None:
+        """Join existing (immutable) pages to ``seq``'s owned list,
+        bumping their refcounts — the capacity win of prefix reuse."""
+        owned = self._owned.setdefault(seq, [])
+        for p in page_ids:
+            self._refs[p] += 1
+            owned.append(p)
+
     def free(self, seq: int) -> int:
-        """Return all of ``seq``'s pages; returns how many were freed."""
+        """Drop all of ``seq``'s page refs; pages whose refcount reaches 0
+        return to the free list (shared/cached pages survive). Returns how
+        many pages were actually freed."""
         pages = self._owned.pop(seq, [])
-        self._free.extend(reversed(pages))
-        return len(pages)
+        freed = 0
+        for p in reversed(pages):
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    # ------------------------------------------------------- prefix cache
+    @staticmethod
+    def chain_hash(prev: int, block_tokens) -> int:
+        """Key for one page-aligned prompt block: hashing the previous
+        block's key into this one encodes the absolute position, so equal
+        token blocks at different depths never collide (RoPE makes K/V
+        position-dependent)."""
+        return hash((prev, tuple(block_tokens)))
+
+    def cache_get(self, key: int) -> Optional[int]:
+        """Resident page for a block key, refreshing its LRU position."""
+        page = self._prefix_cache.get(key)
+        if page is not None:
+            del self._prefix_cache[key]          # re-insert = most recent
+            self._prefix_cache[key] = page
+        return page
+
+    def cache_peek(self, key: int) -> Optional[int]:
+        """cache_get without the LRU refresh: admission probes run every
+        engine tick and must not promote blocks they aren't (yet) using."""
+        return self._prefix_cache.get(key)
+
+    def cache_put(self, key: int, page_id: int) -> None:
+        """Pin ``page_id`` under ``key``. First writer wins — a duplicate
+        key keeps the already-cached page."""
+        if key in self._prefix_cache:
+            return
+        self._refs[page_id] += 1
+        self._prefix_cache[key] = page_id
+
+    def evict(self, n: int) -> int:
+        """Drop up to ``n`` LRU cache entries whose pages are pinned only
+        by the cache; returns how many pages were reclaimed."""
+        got = 0
+        for key in list(self._prefix_cache):
+            if got >= n:
+                break
+            page = self._prefix_cache[key]
+            if self._refs[page] != 1:
+                continue                     # a live sequence still reads it
+            del self._prefix_cache[key]
+            self._refs[page] = 0
+            self._free.append(page)
+            got += 1
+        return got
 
     def table(self, seqs: List[int], max_pages: Optional[int] = None
               ) -> np.ndarray:

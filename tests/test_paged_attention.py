@@ -284,3 +284,128 @@ def test_paged_lm_backend_behind_serve(local_ray):
         assert streamed == _gen(params, cfg, [2, 3, 4], 4)
     finally:
         serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Page-level prefix reuse (vLLM-style prefix caching — round 5).
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixCache:
+    def test_share_refcounts_and_free(self):
+        pool = PagePool(num_pages=6, page_size=8)
+        a = pool.alloc(seq=1, tokens=16)          # 2 pages
+        pool.share(seq=2, page_ids=a)             # seq 2 joins both
+        assert pool.free(1) == 0                  # still referenced by 2
+        assert pool.free(2) == 2                  # last ref returns them
+        assert pool.free_pages == 6
+
+    def test_cache_pin_and_evict_lru(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        pages = pool.alloc(seq=1, tokens=32)      # all 4 pages
+        k1 = PagePool.chain_hash(0, (1,) * 8)
+        k2 = PagePool.chain_hash(k1, (2,) * 8)
+        pool.cache_put(k1, pages[0])
+        pool.cache_put(k2, pages[1])
+        pool.free(1)
+        assert pool.free_pages == 2               # 2 stay cache-pinned
+        assert pool.evictable_pages == 2
+        # Touch k1 so k2 becomes LRU, then evict one: k2 goes first.
+        assert pool.cache_get(k1) == pages[0]
+        assert pool.evict(1) == 1
+        assert pool.cache_get(k2) is None
+        assert pool.cache_get(k1) == pages[0]
+        # alloc auto-evicts the rest under pressure
+        assert len(pool.alloc(seq=3, tokens=32)) == 4
+        assert pool.cache_get(k1) is None
+
+    def test_cached_page_in_use_not_evicted(self):
+        pool = PagePool(num_pages=3, page_size=8)
+        pages = pool.alloc(seq=1, tokens=8)
+        key = PagePool.chain_hash(0, (5,) * 8)
+        pool.cache_put(key, pages[0])             # refs: seq1 + cache = 2
+        assert pool.evictable_pages == 0
+        assert pool.evict(1) == 0                 # still read by seq 1
+        assert pool.cache_get(key) == pages[0]
+
+
+def test_paged_engine_prefix_reuse_shares_pages():
+    """A second request with the same prompt head reuses the cached prefix
+    pages (fewer new pages) and still produces the exact continuation."""
+    from ray_tpu.models import init_params
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = PagedGenerationEngine(params, cfg, max_slots=2, page_size=8)
+    prompt = [(i % 50) + 1 for i in range(20)]    # 2 immutable full blocks
+    r1 = eng.submit(prompt, 6)
+    out1 = eng.run_until_done()[r1]
+    assert out1 == _gen(params, cfg, prompt, 6)
+    free_after_first = eng.pool.free_pages
+    # The 2 immutable blocks stayed resident, pinned by the cache.
+    assert eng.num_pages - 1 - free_after_first == 2
+    assert eng._prefix_hits(prompt) == 2
+
+    r2 = eng.submit(prompt, 6)
+    out2 = eng.run_until_done()[r2]
+    assert out2 == out1                            # exact reuse
+    # A fresh different-head prompt must not match the cache.
+    other = [60 + (i % 5) for i in range(20)]
+    assert eng._prefix_hits(other) == 0
+    r3 = eng.submit(other, 6)
+    assert eng.run_until_done()[r3] == _gen(params, cfg, other, 6)
+
+
+def test_paged_engine_prefix_reuse_admission_capacity():
+    """The capacity win: at a fixed pool size, same-prefix requests admit
+    concurrently where private copies could not."""
+    from ray_tpu.models import init_params
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [(i % 50) + 1 for i in range(16)]    # 2 full blocks of 8
+    # Each request spans ceil((16+8)/8) = 3 pages privately, but only 1
+    # beyond the shared prefix. Pool: 1 scratch + 6 usable. Private
+    # copies admit floor(6/3) = 2 concurrent; sharing admits all 4
+    # (3 + 1 + 1 + 1 = 6).
+    eng = PagedGenerationEngine(params, cfg, max_slots=4, page_size=8,
+                                max_seq=24, num_pages=7)
+    ids = [eng.submit(prompt, 8) for _ in range(4)]
+    eng.step()
+    assert sum(r is not None for r in eng.active) == 4, \
+        "prefix sharing should admit all four same-prefix requests"
+    out = eng.run_until_done()
+    ref = _gen(params, cfg, prompt, 8)
+    for rid in ids:
+        assert out[rid] == ref
+
+
+def test_paged_engine_own_prefix_hits_not_counted_as_evictable():
+    """Admission must not count the request's OWN cached prefix pages as
+    reclaimable headroom: they will be shared (pinned), not evicted. The
+    buggy check admitted such a request and then MemoryError'd mid-prefill."""
+    from ray_tpu.models import init_params
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt16 = [(i % 50) + 1 for i in range(16)]   # 2 full blocks of 8
+    eng = PagedGenerationEngine(params, cfg, max_slots=2, page_size=8,
+                                max_seq=40, num_pages=7)  # 6 usable
+    # Seed the cache: run the 16-token prompt to completion (2 pinned).
+    r0 = eng.submit(prompt16, 1)
+    eng.run_until_done()
+    assert eng.pool.evictable_pages == 2
+    # A live long-running request holding 2 pages.
+    r_live = eng.submit([3, 4, 5, 6, 7, 8, 9, 10, 11], 7)  # ceil(16/8)=2
+    eng.step()
+    assert eng.active[0] is not None or eng.active[1] is not None
+    # free=2, evictable=2 (both are B's own prefix hits), B needs 3 NEW
+    # pages (total ceil((16+24)/8)=5, hits 2): must queue, not crash.
+    rb = eng.submit(prompt16, 24)
+    eng.step()   # would raise MemoryError with the double-counting check
+    assert any(r is not None and r.req_id == rb for r in eng.active) is False
+    out = eng.run_until_done()   # live finishes -> B admits and completes
+    assert out[rb] == _gen(params, cfg, prompt16, 24)
