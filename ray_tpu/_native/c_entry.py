@@ -20,12 +20,26 @@ import ray_tpu
 from ray_tpu.object_ref import ObjectRef
 
 _refs: Dict[str, ObjectRef] = {}
+_actors: Dict[str, object] = {}  # actor id hex -> ActorHandle
 
 
 def _track(ref: ObjectRef) -> str:
     h = ref.hex()
     _refs[h] = ref
     return h
+
+
+def _resolve_arg_refs(value):
+    """Recursively replace {"__ref__": "<hex>"} markers with live
+    ObjectRefs so a C caller can chain tasks/actor calls on stored
+    objects (arrays included) without pulling them through JSON."""
+    if isinstance(value, dict):
+        if set(value.keys()) == {"__ref__"}:
+            return _resolve(value["__ref__"])
+        return {k: _resolve_arg_refs(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_resolve_arg_refs(v) for v in value]
+    return value
 
 
 def _resolve(ref_hex: str) -> ObjectRef:
@@ -49,6 +63,7 @@ def init(address: str) -> bool:
 
 def shutdown() -> bool:
     _refs.clear()
+    _actors.clear()
     ray_tpu.shutdown()
     return True
 
@@ -76,7 +91,8 @@ def submit(entrypoint: str, args_json: str, num_cpus: float) -> str:
         remote_fn = ray_tpu.remote(num_cpus=num_cpus)(fn)
     else:
         remote_fn = ray_tpu.remote(fn)
-    return _track(remote_fn.remote(*json.loads(args_json)))
+    args = _resolve_arg_refs(json.loads(args_json))
+    return _track(remote_fn.remote(*args))
 
 
 def release(ref_hex: str) -> bool:
@@ -92,3 +108,74 @@ def wait(refs_json: str, num_returns: int, timeout: float) -> int:
         refs, num_returns=num_returns,
         timeout=None if timeout <= 0 else timeout)
     return len(ready)
+
+
+# ---------------------------------------------------------------- actors
+def actor_create(entrypoint: str, args_json: str, num_cpus: float) -> str:
+    """entrypoint = "module:Class" importable on the workers (reference:
+    the typed actor factories of cpp/include/ray/api.h; here the class IS
+    the factory)."""
+    mod_name, sep, cls_name = entrypoint.partition(":")
+    if not sep or not mod_name or not cls_name:
+        raise ValueError(
+            f"entrypoint must be 'module:Class', got {entrypoint!r}")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    if not isinstance(cls, type):
+        raise TypeError(f"{entrypoint!r} is not a class")
+    if num_cpus and num_cpus > 0:
+        actor_cls = ray_tpu.remote(num_cpus=num_cpus)(cls)
+    else:
+        actor_cls = ray_tpu.remote(cls)
+    args = _resolve_arg_refs(json.loads(args_json))
+    handle = actor_cls.remote(*args)
+    h = handle._actor_id.hex()
+    _actors[h] = handle
+    return h
+
+
+def _actor(actor_hex: str):
+    handle = _actors.get(actor_hex)
+    if handle is None:
+        raise KeyError(f"unknown or killed actor {actor_hex!r}")
+    return handle
+
+
+def actor_call(actor_hex: str, method: str, args_json: str) -> str:
+    args = _resolve_arg_refs(json.loads(args_json))
+    return _track(getattr(_actor(actor_hex), method).remote(*args))
+
+
+def actor_kill(actor_hex: str) -> bool:
+    ray_tpu.kill(_actor(actor_hex))
+    del _actors[actor_hex]
+    return True
+
+
+# ------------------------------------------------------- array buffers
+def put_buffer(view, dtype: str, shape_json: str) -> str:
+    """view: a C-memory memoryview (zero-copy from the caller's pointer);
+    the np.frombuffer wrap is also zero-copy — the single copy is the
+    object-store write inside put()."""
+    import numpy as np
+
+    shape = json.loads(shape_json)
+    # copy(): in local mode put() stores the object by reference, and an
+    # aliasing array would dangle the moment the C caller frees or reuses
+    # its buffer (the header promises the buffer is not retained).
+    arr = np.frombuffer(view, dtype=np.dtype(dtype)).reshape(shape).copy()
+    return _track(ray_tpu.put(arr))
+
+
+def get_array(ref_hex: str, timeout: float):
+    """Returns a C-contiguous ndarray for capi.cc to expose through the
+    buffer protocol (scalars become 0-d arrays)."""
+    import numpy as np
+
+    value = ray_tpu.get(_resolve(ref_hex),
+                        timeout=None if timeout <= 0 else timeout)
+    arr = np.asarray(value)
+    if not arr.flags["C_CONTIGUOUS"]:
+        # ascontiguousarray only when needed: it would promote 0-d
+        # scalars to shape (1,), losing the rank.
+        arr = np.ascontiguousarray(arr)
+    return arr

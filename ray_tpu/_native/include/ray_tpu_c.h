@@ -49,6 +49,55 @@ int ray_tpu_wait(const char **ref_hexes, int n, int num_returns,
  * Returns 0 on success. */
 int ray_tpu_release(const char *ref_hex);
 
+/* ---- actors (reference: the actor templates of cpp/include/ray/api.h,
+ * Ray::Actor(Counter::FactoryCreate).Remote() / actor.Task(...)) ---- */
+
+/* Create an actor from an importable Python class ("module:Class") with
+ * JSON-array constructor args; returns the actor handle id (hex string).
+ * num_cpus <= 0 uses the default (1). */
+char *ray_tpu_actor_create(const char *entrypoint, const char *args_json,
+                           double num_cpus);
+
+/* Invoke a method on an actor; returns the result's object ref. Method
+ * calls on one actor execute in submission order. JSON args may embed
+ * {"__ref__": "<hex>"} markers anywhere; each resolves to the value of
+ * that object ref at execution time (also honored by
+ * ray_tpu_submit_json). */
+char *ray_tpu_actor_call_json(const char *actor_hex, const char *method,
+                              const char *args_json);
+
+/* Destroy the actor process and drop the handle. Returns 0 on success. */
+int ray_tpu_actor_kill(const char *actor_hex);
+
+/* ---- zero-copy array buffers (the payload a TPU framework serves;
+ * dlpack-shaped: pointer + dtype + shape) ---- */
+
+#define RAY_TPU_MAX_NDIM 8
+
+typedef struct {
+  const void *data;   /* contiguous, C-order; read-only view */
+  long long nbytes;
+  char dtype[16];     /* numpy dtype name, e.g. "float32" */
+  long long shape[RAY_TPU_MAX_NDIM];
+  int ndim;
+  void *opaque;       /* internal owner; free via ray_tpu_buffer_release */
+} ray_tpu_buffer;
+
+/* Store an n-d array from host memory (one copy into the object store;
+ * the caller's buffer is not retained). dtype is a numpy dtype name.
+ * Returns the object ref as a hex string. */
+char *ray_tpu_put_buffer(const void *data, const char *dtype,
+                         const long long *shape, int ndim);
+
+/* Fetch an object as a contiguous array view. Fills *out; the view stays
+ * valid until ray_tpu_buffer_release(out). timeout_s <= 0 waits forever.
+ * Returns 0 on success. */
+int ray_tpu_get_buffer(const char *ref_hex, double timeout_s,
+                       ray_tpu_buffer *out);
+
+/* Release the array view obtained from ray_tpu_get_buffer. */
+void ray_tpu_buffer_release(ray_tpu_buffer *buf);
+
 const char *ray_tpu_last_error(void);
 
 void ray_tpu_free(char *s);

@@ -178,6 +178,180 @@ char *ray_tpu_submit_json(const char *entrypoint, const char *args_json,
       "submit", Py_BuildValue("(ssd)", entrypoint, args_json, num_cpus)));
 }
 
+char *ray_tpu_actor_create(const char *entrypoint, const char *args_json,
+                           double num_cpus) {
+  if (entrypoint == nullptr || args_json == nullptr) {
+    set_error("entrypoint/args_json must not be NULL");
+    return nullptr;
+  }
+  Gil gil;
+  return steal_string(call_bridge(
+      "actor_create",
+      Py_BuildValue("(ssd)", entrypoint, args_json, num_cpus)));
+}
+
+char *ray_tpu_actor_call_json(const char *actor_hex, const char *method,
+                              const char *args_json) {
+  if (actor_hex == nullptr || method == nullptr || args_json == nullptr) {
+    set_error("actor_hex/method/args_json must not be NULL");
+    return nullptr;
+  }
+  Gil gil;
+  return steal_string(call_bridge(
+      "actor_call", Py_BuildValue("(sss)", actor_hex, method, args_json)));
+}
+
+int ray_tpu_actor_kill(const char *actor_hex) {
+  if (actor_hex == nullptr) {
+    set_error("actor_hex must not be NULL");
+    return -1;
+  }
+  Gil gil;
+  PyObject *out =
+      call_bridge("actor_kill", Py_BuildValue("(s)", actor_hex));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+char *ray_tpu_put_buffer(const void *data, const char *dtype,
+                         const long long *shape, int ndim) {
+  if (data == nullptr || dtype == nullptr || shape == nullptr) {
+    set_error("data/dtype/shape must not be NULL");
+    return nullptr;
+  }
+  if (ndim < 0 || ndim > RAY_TPU_MAX_NDIM) {
+    set_error("ndim out of range");
+    return nullptr;
+  }
+  char shape_json[RAY_TPU_MAX_NDIM * 24 + 4];
+  {
+    size_t off = 0;
+    shape_json[off++] = '[';
+    for (int i = 0; i < ndim; i++) {
+      int wrote = std::snprintf(shape_json + off, sizeof(shape_json) - off,
+                                "%s%lld", i ? "," : "", shape[i]);
+      if (wrote < 0 || off + wrote >= sizeof(shape_json) - 2) {
+        set_error("shape too large");
+        return nullptr;
+      }
+      off += wrote;
+    }
+    shape_json[off++] = ']';
+    shape_json[off] = '\0';
+  }
+  Gil gil;
+  // Resolve itemsize via numpy so the memoryview gets the exact length.
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *dt = PyObject_CallMethod(np, "dtype", "(s)", dtype);
+  Py_DECREF(np);
+  if (dt == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *isz = PyObject_GetAttrString(dt, "itemsize");
+  Py_DECREF(dt);
+  if (isz == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  long long itemsize = PyLong_AsLongLong(isz);
+  Py_DECREF(isz);
+  long long nbytes = itemsize;
+  for (int i = 0; i < ndim; i++) {
+    if (shape[i] < 0) {
+      set_error("negative dimension");
+      return nullptr;
+    }
+    nbytes *= shape[i];
+  }
+  PyObject *view = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(data)),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_READ);
+  if (view == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  // call_bridge steals the args tuple; "N" steals view into it.
+  return steal_string(call_bridge(
+      "put_buffer", Py_BuildValue("(Nss)", view, dtype, shape_json)));
+}
+
+int ray_tpu_get_buffer(const char *ref_hex, double timeout_s,
+                       ray_tpu_buffer *out) {
+  if (ref_hex == nullptr || out == nullptr) {
+    set_error("ref_hex/out must not be NULL");
+    return -1;
+  }
+  std::memset(out, 0, sizeof(*out));
+  Gil gil;
+  PyObject *arr = call_bridge(
+      "get_array", Py_BuildValue("(sd)", ref_hex, timeout_s));
+  if (arr == nullptr) return -1;
+
+  // dtype name
+  PyObject *dt = PyObject_GetAttrString(arr, "dtype");
+  PyObject *dtname = dt ? PyObject_GetAttrString(dt, "name") : nullptr;
+  Py_XDECREF(dt);
+  const char *dstr = dtname ? PyUnicode_AsUTF8(dtname) : nullptr;
+  if (dstr == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(dtname);
+    Py_DECREF(arr);
+    return -1;
+  }
+  std::snprintf(out->dtype, sizeof(out->dtype), "%s", dstr);
+  Py_DECREF(dtname);
+
+  // shape
+  PyObject *shp = PyObject_GetAttrString(arr, "shape");
+  if (shp == nullptr || !PyTuple_Check(shp) ||
+      PyTuple_Size(shp) > RAY_TPU_MAX_NDIM) {
+    set_error(shp ? "array rank exceeds RAY_TPU_MAX_NDIM"
+                  : "array has no shape");
+    Py_XDECREF(shp);
+    Py_DECREF(arr);
+    return -1;
+  }
+  out->ndim = static_cast<int>(PyTuple_Size(shp));
+  for (int i = 0; i < out->ndim; i++) {
+    out->shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  }
+  Py_DECREF(shp);
+
+  // buffer view: holds a reference to arr until released.
+  Py_buffer *view = static_cast<Py_buffer *>(std::malloc(sizeof(Py_buffer)));
+  if (view == nullptr) {
+    set_error("out of memory");
+    Py_DECREF(arr);
+    return -1;
+  }
+  if (PyObject_GetBuffer(arr, view, PyBUF_SIMPLE) != 0) {
+    set_error_from_python();
+    std::free(view);
+    Py_DECREF(arr);
+    return -1;
+  }
+  Py_DECREF(arr);  // the Py_buffer keeps its own reference (view->obj)
+  out->data = view->buf;
+  out->nbytes = static_cast<long long>(view->len);
+  out->opaque = view;
+  return 0;
+}
+
+void ray_tpu_buffer_release(ray_tpu_buffer *buf) {
+  if (buf == nullptr || buf->opaque == nullptr) return;
+  Gil gil;
+  Py_buffer *view = static_cast<Py_buffer *>(buf->opaque);
+  PyBuffer_Release(view);
+  std::free(view);
+  std::memset(buf, 0, sizeof(*buf));
+}
+
 int ray_tpu_wait(const char **ref_hexes, int n, int num_returns,
                  double timeout_s) {
   if (ref_hexes == nullptr || n < 0) {

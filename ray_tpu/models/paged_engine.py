@@ -180,19 +180,21 @@ class PagedGenerationEngine(GenerationEngine):
         materialised — the transient spike would defeat the paged engine's
         HBM bound at exactly the small num_pages configs it exists for."""
 
-    def _prefix_keys(self, prompt: List[int]) -> List[int]:
-        """Chained hashes of the prompt's IMMUTABLE full blocks — those
-        strictly before the decode boundary (decode writes start at
-        position len(prompt), so block j is immutable iff
-        (j+1)*page_size <= len(prompt))."""
+    def _prefix_keys(self, prompt: List[int]):
+        """(chained hash, block tokens) for the prompt's IMMUTABLE full
+        blocks — those strictly before the decode boundary (decode writes
+        start at position len(prompt), so block j is immutable iff
+        (j+1)*page_size <= len(prompt)). The tokens travel with the key so
+        every cache probe verifies content, not just the 64-bit hash."""
         ps = self.page_size
         keys, h = [], 0
         for j in range(len(prompt) // ps):
-            h = PagePool.chain_hash(h, prompt[j * ps:(j + 1) * ps])
-            keys.append(h)
+            blk = tuple(prompt[j * ps:(j + 1) * ps])
+            h = PagePool.chain_hash(h, blk)
+            keys.append((h, blk))
         return keys
 
-    def _keys_for(self, req: _Request) -> List[int]:
+    def _keys_for(self, req: _Request):
         """Memoized per request: _can_admit runs every engine tick while a
         request waits at the queue head, and rehashing the whole prompt
         per generated token of its batch-mates would be O(prompt) host
@@ -207,23 +209,26 @@ class PagedGenerationEngine(GenerationEngine):
                 self._prefix_keys(req.prompt)
         return keys
 
-    def _prefix_hits(self, prompt: List[int]) -> int:
-        """Longest run of consecutive cached blocks from the start
-        (non-mutating probe — no LRU promotion)."""
-        hits = 0
-        for key in self._prefix_keys(prompt):
-            if self.pool.cache_peek(key) is None:
+    def _cached_prefix(self, keys, *, promote: bool) -> List[int]:
+        """Pages of the longest run of consecutive cached blocks from the
+        start. ``promote`` refreshes LRU (use only when actually taking
+        the pages); admission probes peek."""
+        fetch = self.pool.cache_get if promote else self.pool.cache_peek
+        pages: List[int] = []
+        for key, blk in keys:
+            page = fetch(key, blk)
+            if page is None:
                 break
-            hits += 1
-        return hits
+            pages.append(page)
+        return pages
+
+    def _prefix_hits(self, prompt: List[int]) -> int:
+        return len(self._cached_prefix(self._prefix_keys(prompt),
+                                       promote=False))
 
     def _can_admit(self, req: _Request) -> bool:
         total = -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
-        hits = 0
-        for key in self._keys_for(req):
-            if self.pool.cache_peek(key) is None:
-                break
-            hits += 1
+        hits = len(self._cached_prefix(self._keys_for(req), promote=False))
         # Cache-pinned pages no live sequence reads are reclaimable on
         # demand (alloc evicts LRU) — but the request's own hit pages are
         # among them and will be share()d, not evicted, so they must not
@@ -255,12 +260,7 @@ class PagedGenerationEngine(GenerationEngine):
         # OOM mid-flight.
         keys = self._prompt_keys.pop(req.req_id, None) \
             or self._prefix_keys(req.prompt)
-        shared: List[int] = []
-        for key in keys:
-            page = self.pool.cache_get(key)
-            if page is None:
-                break
-            shared.append(page)
+        shared = self._cached_prefix(keys, promote=True)
         self.pool.share(slot, shared)
         self.pool.alloc(slot, T0 + req.max_new_tokens)
         pages = np.asarray(self.pool.pages_for(slot), np.int32)
@@ -287,7 +287,8 @@ class PagedGenerationEngine(GenerationEngine):
         # The blocks this prefill just wrote are now resident + immutable:
         # publish them so later prompts with the same head reuse the pages.
         for j in range(len(shared), len(keys)):
-            self.pool.cache_put(keys[j], int(pages[j]))
+            key, blk = keys[j]
+            self.pool.cache_put(key, int(pages[j]), blk)
         first = req.pick(np.asarray(logits))
         req.out.append(first)
         self.lengths[slot] = T0

@@ -159,7 +159,7 @@ class PagePool:
     def evictable_pages(self) -> int:
         """Cached pages pinned ONLY by the cache (refcount 1): reclaimable
         on demand, so admission may count them as free."""
-        return sum(1 for p in self._prefix_cache.values()
+        return sum(1 for p, _ in self._prefix_cache.values()
                    if self._refs[p] == 1)
 
     def pages_for(self, seq: int) -> List[int]:
@@ -214,26 +214,41 @@ class PagePool:
         position-dependent)."""
         return hash((prev, tuple(block_tokens)))
 
-    def cache_get(self, key: int) -> Optional[int]:
-        """Resident page for a block key, refreshing its LRU position."""
-        page = self._prefix_cache.get(key)
-        if page is not None:
-            del self._prefix_cache[key]          # re-insert = most recent
-            self._prefix_cache[key] = page
+    def cache_get(self, key: int, tokens=None) -> Optional[int]:
+        """Resident page for a block key, refreshing its LRU position.
+        ``tokens``: the block's actual token ids — verified against the
+        entry, because trusting the 64-bit hash alone would let a
+        collision silently serve another prompt's K/V (the vLLM bug
+        class); a mismatch is a miss."""
+        ent = self._prefix_cache.get(key)
+        if ent is None:
+            return None
+        page, blk = ent
+        if tokens is not None and blk is not None and tuple(tokens) != blk:
+            return None
+        del self._prefix_cache[key]              # re-insert = most recent
+        self._prefix_cache[key] = ent
         return page
 
-    def cache_peek(self, key: int) -> Optional[int]:
+    def cache_peek(self, key: int, tokens=None) -> Optional[int]:
         """cache_get without the LRU refresh: admission probes run every
         engine tick and must not promote blocks they aren't (yet) using."""
-        return self._prefix_cache.get(key)
+        ent = self._prefix_cache.get(key)
+        if ent is None:
+            return None
+        page, blk = ent
+        if tokens is not None and blk is not None and tuple(tokens) != blk:
+            return None
+        return page
 
-    def cache_put(self, key: int, page_id: int) -> None:
+    def cache_put(self, key: int, page_id: int, tokens=None) -> None:
         """Pin ``page_id`` under ``key``. First writer wins — a duplicate
         key keeps the already-cached page."""
         if key in self._prefix_cache:
             return
         self._refs[page_id] += 1
-        self._prefix_cache[key] = page_id
+        self._prefix_cache[key] = (
+            page_id, tuple(tokens) if tokens is not None else None)
 
     def evict(self, n: int) -> int:
         """Drop up to ``n`` LRU cache entries whose pages are pinned only
@@ -242,7 +257,7 @@ class PagePool:
         for key in list(self._prefix_cache):
             if got >= n:
                 break
-            page = self._prefix_cache[key]
+            page = self._prefix_cache[key][0]
             if self._refs[page] != 1:
                 continue                     # a live sequence still reads it
             del self._prefix_cache[key]

@@ -74,6 +74,66 @@ int main(int argc, char **argv) {
   CHECK(strlen(ray_tpu_last_error()) > 0, "error message populated");
   printf("error path: %s\n", ray_tpu_last_error());
 
+  /* actor round-trip: stateful stdlib class, method calls in order
+   * (reference: the actor templates of cpp/include/ray/api.h) */
+  char *actor = ray_tpu_actor_create(
+      "collections:Counter", "[[\"a\", \"a\", \"b\"]]", 0.0);
+  CHECK(actor != NULL, "actor_create");
+  char *c1 = ray_tpu_actor_call_json(actor, "update", "[[\"a\", \"c\"]]");
+  CHECK(c1 != NULL, "actor update");
+  char *c2 = ray_tpu_actor_call_json(actor, "most_common", "[1]");
+  CHECK(c2 != NULL, "actor most_common");
+  char *common = ray_tpu_get_json(c2, 60.0);
+  CHECK(common != NULL, "actor result");
+  CHECK(strstr(common, "\"a\"") != NULL && strstr(common, "3") != NULL,
+        "actor state (a: 3 after update)");
+  printf("actor: most_common=%s\n", common);
+  ray_tpu_free(common);
+  CHECK(ray_tpu_release(c1) == 0, "release c1");
+  CHECK(ray_tpu_release(c2) == 0, "release c2");
+  CHECK(ray_tpu_actor_kill(actor) == 0, "actor_kill");
+  char *dead = ray_tpu_actor_call_json(actor, "most_common", "[1]");
+  CHECK(dead == NULL, "call after kill should fail");
+  ray_tpu_free(c1);
+  ray_tpu_free(c2);
+  ray_tpu_free(actor);
+
+  /* zero-copy array round-trip + chaining a task on the stored ref */
+  {
+    float data[6] = {1.0f, 2.0f, 3.0f, 4.0f, 5.0f, 6.0f};
+    long long shape[2] = {2, 3};
+    char *aref = ray_tpu_put_buffer(data, "float32", shape, 2);
+    CHECK(aref != NULL, "put_buffer");
+
+    ray_tpu_buffer buf;
+    CHECK(ray_tpu_get_buffer(aref, 60.0, &buf) == 0, "get_buffer");
+    CHECK(buf.ndim == 2 && buf.shape[0] == 2 && buf.shape[1] == 3,
+          "buffer shape");
+    CHECK(strcmp(buf.dtype, "float32") == 0, "buffer dtype");
+    CHECK(buf.nbytes == (long long)sizeof(data), "buffer nbytes");
+    CHECK(memcmp(buf.data, data, sizeof(data)) == 0, "buffer bytes");
+    ray_tpu_buffer_release(&buf);
+    CHECK(buf.data == NULL, "buffer cleared after release");
+
+    /* pass the stored array to a remote numpy call via a ref marker */
+    char args[128];
+    snprintf(args, sizeof(args), "[{\"__ref__\": \"%s\"}]", aref);
+    char *sref = ray_tpu_submit_json("numpy:sum", args, 0.0);
+    CHECK(sref != NULL, "submit numpy:sum on ref");
+    ray_tpu_buffer sum;
+    CHECK(ray_tpu_get_buffer(sref, 60.0, &sum) == 0, "get sum buffer");
+    CHECK(sum.ndim == 0 && sum.nbytes > 0, "sum is a scalar");
+    CHECK(strcmp(sum.dtype, "float32") == 0, "sum dtype");
+    float total = *(const float *)sum.data;
+    CHECK(total == 21.0f, "sum value");
+    printf("array: sum=%g dtype=%s\n", (double)total, sum.dtype);
+    ray_tpu_buffer_release(&sum);
+    CHECK(ray_tpu_release(aref) == 0, "release aref");
+    CHECK(ray_tpu_release(sref) == 0, "release sref");
+    ray_tpu_free(aref);
+    ray_tpu_free(sref);
+  }
+
   CHECK(ray_tpu_shutdown() == 0, "shutdown");
   printf("CAPI_OK\n");
   return 0;
