@@ -112,6 +112,7 @@ class NodeController:
         # the direct task transport, node_manager.cc HandleRequestWorkerLease):
         # lease_id -> {"worker": WorkerHandle, "task": admission record}.
         self._leases: Dict[bytes, Dict] = {}
+        self._done_buf: List[Dict] = []  # coalesced task_done reports
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
@@ -603,12 +604,34 @@ class NodeController:
         if task.get("released"):
             return
         task["released"] = True
+        self._report_done(task.get("task_id"), task.get("resources", {}))
+
+    def _report_done(self, task_id, resources) -> None:
+        """Coalesce task_done reports into one task_done_batch oneway per
+        event-loop pass (mirror of the GCS's assign_batch: at fan-out
+        rates the per-task socket write dominated both ends' CPU)."""
+        self._done_buf.append({"task_id": task_id, "resources": resources})
+        if len(self._done_buf) == 1:
+            self._spawn_bg(self._flush_done())
+        elif len(self._done_buf) >= 512:
+            buf, self._done_buf = self._done_buf, []
+            self._send_done_batch(buf)
+
+    async def _flush_done(self) -> None:
+        await asyncio.sleep(0)   # let same-pass completions pile up
+        buf, self._done_buf = self._done_buf, []
+        if buf:
+            self._send_done_batch(buf)
+
+    def _send_done_batch(self, buf) -> None:
         try:
-            self._gcs.send_oneway({
-                "type": "task_done", "node_id": self.node_id,
-                "task_id": task.get("task_id"),
-                "resources": task.get("resources", {}),
-            })
+            if len(buf) == 1:
+                self._gcs.send_oneway(dict(
+                    buf[0], type="task_done", node_id=self.node_id))
+            else:
+                self._gcs.send_oneway({"type": "task_done_batch",
+                                       "node_id": self.node_id,
+                                       "items": buf})
         except ConnectionError:
             pass
 
@@ -876,6 +899,29 @@ class NodeController:
             arena (zero-copy); register it (plasma notification path)."""
             self._register_object(msg["object_id"], msg.get("size", 0))
             return {"ok": True}
+
+        @s.handler("fetch_batch")
+        async def fetch_batch(msg, conn):
+            """Many small result blobs in one reply (the fan-out driver's
+            per-oid fetch_object RPCs dominated socket I/O). Response is
+            size-capped; absent oids fall back to the per-oid path (which
+            also serves the native zero-copy plane for big blobs)."""
+            out = {}
+            total = 0
+            for oid in msg["object_ids"]:
+                blob = self._local_blob(oid)
+                if blob is None:
+                    self._drop_location(oid)
+                    continue
+                if len(blob) > 256 << 10 or total + len(blob) > 8 << 20:
+                    # Big blobs belong on the native zero-copy plane (the
+                    # caller's per-oid fallback), not a pickled RPC reply;
+                    # the total cap is checked BEFORE adding so the reply
+                    # never exceeds it.
+                    continue
+                out[oid] = blob
+                total += len(blob)
+            return {"ok": True, "blobs": out}
 
         @s.handler("fetch_object")
         async def fetch_object(msg, conn):

@@ -874,6 +874,40 @@ class ClusterCoreWorker:
                 continue
         return None
 
+    def _fetch_many(self, infos: Dict[bytes, dict]) -> Dict[bytes, bytes]:
+        """Fetch a set of located blobs, coalescing per-node fetch_batch
+        RPCs (one reply carries a whole completion wave of small results);
+        anything the batch misses — evicted, oversized reply cap, node
+        error — falls back to the per-oid path, which also serves the
+        native zero-copy plane."""
+        out: Dict[bytes, bytes] = {}
+        by_addr: Dict[tuple, list] = {}
+        for oid, info in infos.items():
+            addrs = info.get("addresses", [])
+            if addrs:
+                by_addr.setdefault(tuple(addrs[0]), []).append(oid)
+        for addr, oids in by_addr.items():
+            for i in range(0, len(oids), 1024):
+                chunk = oids[i:i + 1024]
+                try:
+                    resp = self._controller(addr).call(
+                        {"type": "fetch_batch", "object_ids": chunk},
+                        timeout=60.0)
+                except (RuntimeError, ConnectionError, TimeoutError):
+                    continue
+                for oid, blob in resp.get("blobs", {}).items():
+                    out[oid] = blob
+                    self._cache_blob(oid, blob)
+        for oid, info in infos.items():
+            if oid in out:
+                continue
+            blob = self._fetch_from(
+                oid, info.get("addresses", []),
+                info.get("transfer_addresses", []))
+            if blob is not None:
+                out[oid] = blob
+        return out
+
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
         if self.local_store is not None:
             blob = self.local_store.get_bytes(oid)
@@ -933,7 +967,8 @@ class ClusterCoreWorker:
         blobs: Dict[bytes, bytes] = {}
         pending = set(oids)
         deadline = None if timeout is None else time.monotonic() + timeout
-        poll = 0.0005
+        first = True
+        last_probe = 0.0
         while pending:
             for oid in list(pending):
                 blob = self._local_blob(oid)
@@ -943,29 +978,54 @@ class ClusterCoreWorker:
                     self._direct_observed(oid)
             if not pending:
                 break
-            resp = self.gcs.call({"type": "locations_batch",
-                                  "object_ids": list(pending)})
+            # LONG-POLL: the GCS parks until one of the requested objects
+            # lands (or the window closes) instead of us sleeping and
+            # re-asking — at large fan-outs the 50 Hz re-scan of every
+            # pending oid dominated GCS CPU. First cycle asks with no wait
+            # so an all-ready get never blocks.
+            wait_s = 0.0 if first else 1.0
+            first = False
+            if deadline is not None:
+                wait_s = max(0.0, min(wait_s,
+                                      deadline - time.monotonic()))
+            # Probe lineage recovery at most every 2 s (not per wake): a
+            # lost object must be re-driven even while OTHER objects keep
+            # completing, but the O(pending) probe can't run per tick.
+            now = time.monotonic()
+            probe = now - last_probe >= 2.0
+            if probe:
+                last_probe = now
+            resp = self.gcs.call(
+                {"type": "locations_batch", "object_ids": list(pending),
+                 "wait_s": wait_s, "probe": probe},
+                timeout=wait_s + 30.0)
+            n_before = len(pending)
+            to_fetch = {}
             for oid, info in resp.get("objects", {}).items():
                 if info.get("error_blob") is not None:
                     blobs[oid] = info["error_blob"]
                     pending.discard(oid)
                     continue
-                blob = self._fetch_from(
-                    oid, info.get("addresses", []),
-                    info.get("transfer_addresses", []))
-                if blob is not None:
-                    blobs[oid] = blob
-                    pending.discard(oid)
-                    self._direct_observed(oid)
+                to_fetch[oid] = info
+            for oid, blob in self._fetch_many(to_fetch).items():
+                blobs[oid] = blob
+                pending.discard(oid)
+                self._direct_observed(oid)
             if not pending:
                 break
+            progressed = len(pending) < n_before
             if deadline is not None and time.monotonic() >= deadline:
                 some = next(iter(pending))
                 raise GetTimeoutError(
                     f"{len(pending)} objects not ready "
                     f"(e.g. {some.hex()[:16]})")
-            time.sleep(poll)
-            poll = min(poll * 2, 0.02)
+            if resp.get("objects") and not progressed:
+                # Located but unfetchable (holder died / blob evicted
+                # before the directory caught up): the long-poll returns
+                # instantly on the stale location, so back off here or
+                # this loop hot-spins connection attempts until the
+                # heartbeat reaper updates the directory.
+                time.sleep(0.05)
         values: Dict[bytes, Any] = {}
         out = []
         for oid in oids:
@@ -980,6 +1040,7 @@ class ClusterCoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = {r.id.binary(): r for r in refs}
         ready: set = set()
+        last_probe = 0.0
         while True:
             unknown = []
             for oid in list(pending):
@@ -991,8 +1052,20 @@ class ClusterCoreWorker:
                     continue
                 unknown.append(oid)
             if unknown:
-                resp = self.gcs.call({"type": "locations_batch",
-                                      "object_ids": unknown})
+                # Long-poll only once nothing new is ready this cycle and
+                # more readies are still needed (same rationale as get()).
+                wait_s = 0.5 if len(ready) < num_returns else 0.0
+                if deadline is not None:
+                    wait_s = max(0.0, min(wait_s,
+                                          deadline - time.monotonic()))
+                now = time.monotonic()
+                probe = now - last_probe >= 2.0
+                if probe:
+                    last_probe = now
+                resp = self.gcs.call(
+                    {"type": "locations_batch", "object_ids": unknown,
+                     "wait_s": wait_s, "probe": probe},
+                    timeout=wait_s + 30.0)
                 ready.update(resp.get("objects", {}).keys())
             expired = deadline is not None and time.monotonic() >= deadline
             if len(ready) >= num_returns or expired:
@@ -1002,7 +1075,6 @@ class ClusterCoreWorker:
                 taken = {r.id.binary() for r in out_ready}
                 out_rest = [r for r in refs if r.id.binary() not in taken]
                 return out_ready, out_rest
-            time.sleep(0.005)
 
     def as_future(self, ref: ObjectRef):
         from concurrent.futures import Future

@@ -916,6 +916,14 @@ class GcsServer:
                     return "kernel"  # warm: a real timed sample exists
                 self._spawn_place_warmup(bucket)
             return "numpy"
+        # Large bucket: the kernel wins at these sizes once compiled, but a
+        # COLD bucket's first XLA compile must not stall the serving path
+        # either (profiled ~3 s per compile on this host — half the wall
+        # clock of a 5k-task burst): warm it in the background and serve
+        # this tick on numpy, exactly like the small-bucket rule.
+        if k is None or k[1] < 1:
+            self._spawn_place_warmup(bucket)
+            return "numpy"
         return "kernel"
 
     def _spawn_place_warmup(self, bucket: int) -> None:
@@ -1266,12 +1274,9 @@ class GcsServer:
                 self._enqueue_task(t, "task", retries=t.get("max_retries", 0))
             return {"ok": True, "count": len(msg["tasks"])}
 
-        @s.handler("locations_batch")
-        async def locations_batch(msg, conn):
-            """Non-blocking location/error lookup for many objects at once
-            (the driver's get() poll loop)."""
+        def _locations_snapshot(object_ids, probe_recovery: bool) -> dict:
             out = {}
-            for oid in msg["object_ids"]:
+            for oid in object_ids:
                 blob = self.error_objects.get(oid)
                 if blob is not None:
                     out[oid] = {"error_blob": blob}
@@ -1281,12 +1286,14 @@ class GcsServer:
                     # Never produced yet (normal poll) or lost with its
                     # entry dropped at node death: recovery is a no-op for
                     # in-flight producers and re-drives lost FINISHED ones.
-                    self._maybe_recover_object(oid)
+                    if probe_recovery:
+                        self._maybe_recover_object(oid)
                     continue
                 alive = [n for n in sorted(entry["locations"])
                          if n in self.nodes and self.nodes[n].alive]
                 if not alive:
-                    self._maybe_recover_object(oid)
+                    if probe_recovery:
+                        self._maybe_recover_object(oid)
                     continue
                 out[oid] = {
                     "addresses": [list(self.nodes[n].address) for n in alive],
@@ -1295,7 +1302,63 @@ class GcsServer:
                         for n in alive
                     ],
                 }
-            return {"ok": True, "objects": out}
+            return out
+
+        @s.handler("locations_batch")
+        async def locations_batch(msg, conn):
+            """Location/error lookup for many objects at once (the
+            driver's get()/wait() loop). With ``wait_s`` it LONG-POLLS:
+            when none of the requested objects are available it parks on
+            their waiter events until the first one lands (or the window
+            closes), so a driver blocked on a big fan-out costs the GCS
+            one O(pending) scan per completion wave instead of one per
+            50 Hz poll tick (at 5k pending oids the polling scans — and
+            their per-oid lineage-recovery probes — dominated GCS CPU)."""
+            oids = msg["object_ids"]
+            # probe=False skips the per-oid lineage-recovery probe: a
+            # caller re-entering right after a long-poll wake knows its
+            # producers are in flight; it re-probes periodically and after
+            # an EMPTY window (the lost-object signature). Default True
+            # for one-shot callers.
+            out = _locations_snapshot(
+                oids, probe_recovery=bool(msg.get("probe", True)))
+            wait_s = float(msg.get("wait_s") or 0.0)
+            if out or wait_s <= 0 or not oids:
+                return {"ok": True, "objects": out}
+
+            async def park():
+                # Detached (self._detach): parking inline would head-of-
+                # line block every other RPC multiplexed on this
+                # connection for up to wait_s.
+                ev = asyncio.Event()
+                for oid in oids:
+                    self._object_waiters.setdefault(oid, []).append(ev)
+                try:
+                    # Re-check AFTER registering: an object landing between
+                    # the inline snapshot and this detached task running
+                    # would otherwise be missed and cost the full window.
+                    if not _locations_snapshot(oids, probe_recovery=False):
+                        await asyncio.wait_for(ev.wait(), wait_s)
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    for oid in oids:
+                        ws = self._object_waiters.get(oid)
+                        if ws is not None:
+                            try:
+                                ws.remove(ev)
+                            except ValueError:
+                                pass
+                            if not ws:
+                                del self._object_waiters[oid]
+                # No recovery probe on the wake path: the park began right
+                # after a probed scan, and the wake means something landed.
+                return {"ok": True,
+                        "objects": _locations_snapshot(
+                            oids, probe_recovery=False)}
+
+            self._detach(msg, conn, park())
+            return None
 
         @s.handler("submit_task")
         async def submit_task(msg, conn):
@@ -1332,8 +1395,7 @@ class GcsServer:
                                retries=msg.get("max_restarts", 0))
             return {"ok": True}
 
-        @s.handler("task_done")
-        async def task_done(msg, conn):
+        def _handle_task_done(msg) -> None:
             self._release(msg["node_id"], msg.get("resources", {}))
             rec = self.task_table.get(msg.get("task_id"))
             # Only the node currently owning the dispatch may finish it: a
@@ -1351,6 +1413,20 @@ class GcsServer:
                     while len(self._early_task_done_order) > 10_000:
                         self._early_task_done.discard(
                             self._early_task_done_order.popleft())
+
+        @s.handler("task_done")
+        async def task_done(msg, conn):
+            _handle_task_done(msg)
+            return None  # one-way
+
+        @s.handler("task_done_batch")
+        async def task_done_batch(msg, conn):
+            """Coalesced completions from one controller (one pickle + one
+            socket write for a tick's worth — at fan-out rates the
+            per-task oneway dominated GCS socket I/O)."""
+            node_id = msg["node_id"]
+            for item in msg["items"]:
+                _handle_task_done({"node_id": node_id, **item})
             return None  # one-way
 
         @s.handler("task_failed")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import signal
 import sys
 
@@ -105,6 +106,19 @@ async def run_node(gcs_host: str, gcs_port: int, resources: dict,
         config, (gcs_host, gcs_port), resources, num_workers=num_workers,
         worker_env=worker_env, label=label,
     )
+    # RAY_TPU_PROFILE_NODE=<path>: cProfile this controller's event loop
+    # (colocated head controllers append "-head" to avoid clobbering).
+    profiler = None
+    prof_path = os.environ.get("RAY_TPU_PROFILE_NODE")
+    if prof_path:
+        import cProfile
+
+        # Distinct file per process: the colocated head controller and
+        # each worker-node process must not clobber one another.
+        prof_path += "-head" if stop_signal is not None \
+            else f"-{os.getpid()}"
+        profiler = cProfile.Profile()
+        profiler.enable()
     port = await node.start()
     print(json.dumps({"event": "node_started", "port": port,
                       "node_id": node.node_id}), flush=True)
@@ -119,6 +133,9 @@ async def run_node(gcs_host: str, gcs_port: int, resources: dict,
         else:
             await stop.wait()
     finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(prof_path)
         await node.stop()
 
 

@@ -267,6 +267,15 @@ class TestAdaptivePlacementCrossover:
         g = self._gcs()
         g._seed = 1  # not a multiple of 16: no exploration
         assert g._choose_place_backend(8) == "numpy"
+        # Large bucket, COLD: never pay the first XLA compile on the
+        # serving path — warm in background, serve numpy this tick
+        # (r5: profiled ~3 s inline compile per cold bucket).
+        warmed = []
+        g._spawn_place_warmup = lambda bucket: warmed.append(bucket)
+        assert g._choose_place_backend(1024) == "numpy"
+        assert warmed == [1024]
+        # Large bucket, WARM (a real timed sample exists): kernel.
+        g._place_perf[("kernel", 1024)] = [0.002, 1]
         assert g._choose_place_backend(1024) == "kernel"
 
     def test_small_batches_explore_kernel_boundedly(self):
