@@ -54,9 +54,12 @@ def schedule_dag_reference(
             break
 
         bits = task_bits_host(key, round_idx, np.asarray(ready_idx), chunk)
-        # Prefix-sum admission: accumulate the demand of every task that
-        # *prefers* a node (admitted or not), in submission order.
+        # Pass 1 — prefix-sum admission: accumulate the demand of every
+        # task that *prefers* a node (admitted or not), in submission
+        # order.
         prefix = np.zeros((N, R), dtype=np.int64)
+        survivors = []  # (pick, demand_sum, j, t) for deferred tasks
+        used = np.zeros((N, R), dtype=np.int64)
         for j, t in enumerate(ready_idx):
             feas = (demand[t] <= avail).all(axis=1)
             cnt = int(feas.sum())
@@ -69,6 +72,19 @@ def schedule_dag_reference(
                 pick = loc
             prefix[pick] += demand[t]
             if (prefix[pick] <= avail[pick]).all():
+                placement[t] = pick
+                used[pick] += demand[t]
+            else:
+                survivors.append((pick, int(demand[t].sum()), j, t))
+        # Pass 2 — survivors vs residual capacity, ascending demand within
+        # each node (ties: submission order), prefix counting every
+        # survivor in the stream (admitted or not) — mirrors the kernel's
+        # second sort+scan bit-for-bit.
+        residual = avail - used
+        prefix2 = np.zeros((N, R), dtype=np.int64)
+        for pick, _, _, t in sorted(survivors):
+            prefix2[pick] += demand[t]
+            if (prefix2[pick] <= residual[pick]).all():
                 placement[t] = pick
             # else: deferred; retries next round with a fresh draw
         round_idx += 1
