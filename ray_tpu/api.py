@@ -188,8 +188,11 @@ def available_resources() -> Dict[str, float]:
     return worker.core.available_resources()
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Export profile events as chrome://tracing JSON.
+def timeline(filename: Optional[str] = None,
+             limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Export profile events as chrome://tracing JSON. ``limit`` keeps only
+    the newest N spans (fetched server-side in cluster mode — the
+    dashboard polls with this so it never ships the whole table).
 
     Reference: python/ray/state.py:914 timeline() / chrome_tracing_dump.
     """
@@ -201,14 +204,18 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         # the GCS profile table (reference: state.py chrome_tracing_dump
         # reads GCS-side profile events the same way).
         worker.core.flush_events()
-        for ev in worker.core.cluster_profile_events():
+        for ev in worker.core.cluster_profile_events(limit=limit):
             events.append({
                 "cat": ev["cat"],
                 "name": ev["name"],
                 "ph": "X",
                 "ts": ev["start"] * 1e6,
                 "dur": (ev["end"] - ev["start"]) * 1e6,
-                "pid": ev["extra"].get("actor_id", ev.get("origin", "worker")),
+                "pid": ev["extra"].get(
+                    "actor_id",
+                    (f"worker-{ev['extra']['worker_pid']}"
+                     if "worker_pid" in ev["extra"]
+                     else ev.get("origin", "worker"))),
                 "tid": ev["extra"].get("task_id", "0"),
                 "args": ev["extra"],
             })
@@ -224,6 +231,8 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "tid": extra.get("task_id", "0"),
                 "args": extra,
             })
+    if limit is not None and len(events) > limit:
+        events = events[-limit:]
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -1131,7 +1131,13 @@ class ClusterCoreWorker:
 
     def flush_events(self) -> int:
         """Push locally recorded profile spans to the GCS profile table
-        (reference: core_worker/profiling.cc batched flush). Returns count."""
+        (reference: core_worker/profiling.cc batched flush). Returns count.
+
+        Spans are recorded in time.monotonic() (exact durations) but each
+        process has its own monotonic epoch — cross-machine lanes would be
+        hours apart. Anchor to wall clock here: the offset is constant per
+        process, so durations stay exact while epochs become comparable."""
+        offset = time.time() - time.monotonic()
         batch = []
         while self.events.events:
             try:
@@ -1139,7 +1145,8 @@ class ClusterCoreWorker:
             except IndexError:
                 break
             batch.append({
-                "cat": kind, "name": name, "start": start, "end": end,
+                "cat": kind, "name": name,
+                "start": start + offset, "end": end + offset,
                 "extra": {k: v for k, v in extra.items()
                           if isinstance(v, (str, int, float, bool))},
                 "origin": self.role,
@@ -1153,8 +1160,11 @@ class ClusterCoreWorker:
                 return 0
         return len(batch)
 
-    def cluster_profile_events(self):
-        return self.gcs.call({"type": "get_profile_data"})["events"]
+    def cluster_profile_events(self, limit: Optional[int] = None):
+        msg = {"type": "get_profile_data"}
+        if limit:
+            msg["limit"] = int(limit)
+        return self.gcs.call(msg)["events"]
 
     def shutdown(self):
         self._flush_submits()

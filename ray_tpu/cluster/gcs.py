@@ -1776,6 +1776,17 @@ class GcsServer:
 
         @s.handler("get_profile_data")
         async def get_profile_data(msg, conn):
+            limit = msg.get("limit")
+            if limit:
+                # Tail only (dashboard polls every 2 s — shipping the full
+                # 200k-span table per poll would grow per-poll latency and
+                # GCS load for no reason). Deque is insertion-ordered.
+                n = len(self.profile_events)
+                start = max(0, n - int(limit))
+                import itertools
+
+                return {"ok": True, "events": list(itertools.islice(
+                    self.profile_events, start, n))}
             return {"ok": True, "events": list(self.profile_events)}
 
         @s.handler("list_objects")

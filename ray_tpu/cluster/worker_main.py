@@ -16,6 +16,7 @@ import pickle
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, Optional
 
@@ -229,9 +230,22 @@ def main():
         finally:
             finish(msg)
 
+    def record_span(kind: str, name: str, t0: float,
+                    id_key: str, id_val) -> None:
+        """Execution span for the timeline lanes (reference: profiling.cc
+        task spans). Called from success AND failure paths — a failed
+        task's span is exactly what a user debugging a job needs to see."""
+        ident = id_val or b""
+        core.events.record(
+            kind, name, t0, time.monotonic(),
+            **{id_key: ident.hex() if isinstance(ident, bytes)
+               else str(ident),
+               "worker_pid": os.getpid()})
+
     def run_actor_method(msg) -> None:
         """One actor method: resolve, run, complete. Used inline (plain
         actors) and from pool threads (max_concurrency)."""
+        t0 = time.monotonic()
         try:
             method = getattr(actor_instance, msg["method"])
             pos, kwargs = resolve_args(msg)
@@ -241,6 +255,9 @@ def main():
         except BaseException as e:  # noqa: BLE001 - task errors are data
             complete_actor_method(msg, error=e)
             return
+        finally:
+            record_span("actor_task", msg.get("method", "method"), t0,
+                        "actor_id", msg.get("actor_id"))
         complete_actor_method(msg, result)
 
     async def run_actor_method_async(msg) -> None:
@@ -249,6 +266,7 @@ def main():
         BLOCKING pieces (ref-arg resolution, result store / checkpoint /
         task_done RPCs) run via asyncio.to_thread so they never stall the
         loop and re-serialize the in-flight coroutines."""
+        t0 = time.monotonic()
         try:
             pos, kwargs = await asyncio.to_thread(resolve_args, msg)
             method = getattr(actor_instance, msg["method"])
@@ -258,6 +276,9 @@ def main():
         except BaseException as e:  # noqa: BLE001 - task errors are data
             await asyncio.to_thread(complete_actor_method, msg, None, e)
             return
+        finally:
+            record_span("actor_task", msg.get("method", "method"), t0,
+                        "actor_id", msg.get("actor_id"))
         await asyncio.to_thread(complete_actor_method, msg, result)
 
     while True:
@@ -283,7 +304,12 @@ def main():
             if mtype == "execute_task":
                 fn = load_function(msg["fn_id"])
                 pos, kwargs = resolve_args(msg)
-                result = fn(*pos, **kwargs)
+                t0 = time.monotonic()
+                try:
+                    result = fn(*pos, **kwargs)
+                finally:
+                    record_span("task", getattr(fn, "__name__", "task"),
+                                t0, "task_id", msg.get("task_id"))
                 run_returns(msg, result)
             elif mtype == "create_actor_instance":
                 cls = load_function(msg["fn_id"])

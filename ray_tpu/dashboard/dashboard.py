@@ -35,11 +35,52 @@ function bar(pct) {
          `<div style="width:${p}%;background:${p>85?"#f66":"#7fc"};` +
          `height:10px"></div></div> ${p}%`;
 }
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+function laneView(events) {
+  // One lane per pid (worker/actor/node origin); spans positioned
+  // proportionally over the visible window. Colors by category.
+  if (!events || !events.length) return "<i>no profile events yet</i>";
+  const t0 = Math.min(...events.map(e => e.ts));
+  const t1 = Math.max(...events.map(e => e.ts + (e.dur || 0)));
+  const span = Math.max(t1 - t0, 1);
+  const colors = {task: "#7fc", actor_task: "#9cf", user: "#fc7",
+                  get: "#c9f", put: "#f9c"};
+  const lanes = new Map();
+  for (const e of events) {
+    const key = String(e.pid);
+    if (!lanes.has(key)) lanes.set(key, []);
+    lanes.get(key).push(e);
+  }
+  let h = `<div style="color:#888">window ${(span/1e6).toFixed(2)}s, ` +
+          `${events.length} spans, ${lanes.size} lanes</div>`;
+  for (const [pid, evs] of [...lanes.entries()].slice(0, 24)) {
+    h += `<div style="display:flex;align-items:center;margin:2px 0">` +
+         `<div style="width:130px;overflow:hidden;color:#9cf">` +
+         `${esc(pid.slice(0,14))}</div>` +
+         `<div style="position:relative;height:14px;width:640px;` +
+         `background:#1a1a1a;border:1px solid #333">`;
+    for (const e of evs.slice(-200)) {
+      const l = ((e.ts - t0) / span) * 640;
+      const w = Math.max(((e.dur || 0) / span) * 640, 1);
+      const c = colors[e.cat] || "#7a7";
+      h += `<div title="${esc(e.name)} (${((e.dur||0)/1e3).toFixed(2)}ms)" ` +
+           `style="position:absolute;left:${l.toFixed(1)}px;` +
+           `width:${w.toFixed(1)}px;height:12px;top:1px;` +
+           `background:${c}"></div>`;
+    }
+    h += `</div></div>`;
+  }
+  return h;
+}
 async function refresh() {
-  const [nodes, actors, objects, resources, tasks, nstats, memory, serve] =
+  const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
+         timeline] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
-       "memory","serve"].map(
+       "memory","serve","timeline"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th><th>workers (pid: cpu%, MB)</th></tr>";
@@ -88,6 +129,9 @@ async function refresh() {
          `<td class=num>${m.contained_children}</td>` +
          `<td>${m.in_directory}</td></tr>`;
   h += "</table>";
+  // task/placement timeline lanes (chrome-trace events, one lane per
+  // worker/actor — placement-kernel behavior visually inspectable)
+  h += "<h2>timeline</h2>" + laneView(Array.isArray(timeline) ? timeline : []);
   // serve stats when a serve control plane is running
   if (serve && Object.keys(serve).length) {
     h += "<h2>serve</h2><table><tr><th>endpoint</th><th>routed</th>" +
@@ -150,6 +194,17 @@ def _collect(endpoint: str):
         from ..metrics import collect_all
 
         return collect_all()
+    if endpoint == "timeline":
+        # Task-lifecycle lanes (reference: the dashboard timeline +
+        # state.py chrome_tracing_dump): the newest execution spans from
+        # the profile table, grouped client-side into one lane per
+        # worker/actor. Same event schema as ray_tpu.timeline().
+        import ray_tpu
+
+        # Newest spans only, sliced server-side; flush order is close
+        # enough to time order for lane rendering (the client computes its
+        # own min/max window).
+        return ray_tpu.timeline(limit=800)
     if endpoint == "serve":
         # Live serve stats when a control plane exists in this cluster
         # (reference: the dashboard's serve tab); {} otherwise. Queries
