@@ -213,9 +213,11 @@ def timeline(filename: Optional[str] = None,
                 "dur": (ev["end"] - ev["start"]) * 1e6,
                 "pid": ev["extra"].get(
                     "actor_id",
-                    (f"worker-{ev['extra']['worker_pid']}"
-                     if "worker_pid" in ev["extra"]
-                     else ev.get("origin", "worker"))),
+                    ev["extra"].get(
+                        "lane",                    # cluster-unique worker
+                        (f"worker-{ev['extra']['worker_pid']}"
+                         if "worker_pid" in ev["extra"]
+                         else ev.get("origin", "worker")))),
                 "tid": ev["extra"].get("task_id", "0"),
                 "args": ev["extra"],
             })

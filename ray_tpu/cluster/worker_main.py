@@ -240,7 +240,11 @@ def main():
             kind, name, t0, time.monotonic(),
             **{id_key: ident.hex() if isinstance(ident, bytes)
                else str(ident),
-               "worker_pid": os.getpid()})
+               "worker_pid": os.getpid(),
+               # Cluster-unique lane key: bare OS pids collide across
+               # nodes (containers reuse low pids), which would merge two
+               # machines' spans into one timeline lane.
+               "lane": f"{core.worker_uid[:8]}:{os.getpid()}"})
 
     def run_actor_method(msg) -> None:
         """One actor method: resolve, run, complete. Used inline (plain

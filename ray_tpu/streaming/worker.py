@@ -218,7 +218,7 @@ class JobWorker:
         self._sink_results: List[Any] = []
         self._out_buffers: Dict[int, List[Any]] = defaultdict(list)
         self._native_readers: Dict[str, Tuple[Any, Any]] = {}
-        self._native_errors: Dict[str, bool] = {}
+        self._native_errors: Dict[str, str] = {}  # channel -> cause traceback
         self.records_in = 0
         self.records_out = 0
 
@@ -276,7 +276,10 @@ class JobWorker:
                     import traceback
 
                     traceback.print_exc()
-                    self._native_errors[channel_id] = True
+                    # Keep the formatted cause: push_eof re-raises with it,
+                    # so a user-fn bug surfaces as ITS traceback instead of
+                    # an opaque "reader failed mid-stream".
+                    self._native_errors[channel_id] = traceback.format_exc()
                     reader.mark_dead()  # unblock a backpressured producer
                     return
 
@@ -309,9 +312,11 @@ class JobWorker:
                     f"native channel {channel_id} still draining after "
                     f"300s; refusing EOF")
             reader.close()
-            if self._native_errors.pop(channel_id, None):
+            cause = self._native_errors.pop(channel_id, None)
+            if cause:
                 raise RuntimeError(
-                    f"native channel {channel_id} reader failed mid-stream")
+                    f"native channel {channel_id} reader failed mid-stream; "
+                    f"cause:\n{cause}")
         with self._lock:
             self._eof_inputs.add(channel_id)
             if self._eof_inputs >= self._expected_inputs:
