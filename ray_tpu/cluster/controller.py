@@ -776,6 +776,12 @@ class NodeController:
         @s.handler("task_done")
         async def task_done(msg, conn):
             """Worker finished: blobs already stored via store_object."""
+            # Result blobs the worker wrote straight into the arena,
+            # registered here instead of one object_added oneway each —
+            # carried IN the finish message, so registration still
+            # strictly precedes the finish processing below.
+            for oid, size in msg.get("added", []):
+                self._register_object(oid, size)
             pid = msg.get("pid") or conn.meta.get("worker_pid")
             w = self.workers.get(pid)
             for rid in msg.get("return_ids", []):
@@ -786,15 +792,8 @@ class NodeController:
                     if done is not None and done.get("direct"):
                         # Finish the direct task's lineage record; resources
                         # are empty — the lease keeps holding the share.
-                        try:
-                            self._gcs.send_oneway({
-                                "type": "task_done",
-                                "node_id": self.node_id,
-                                "task_id": done.get("task_id"),
-                                "resources": {},
-                            })
-                        except ConnectionError:
-                            pass
+                        # Coalesced with queued-task completions.
+                        self._report_done(done.get("task_id"), {})
                 task = w.current_task
                 w.current_task = None
                 # not w.inflight: a lease released mid-run leaves later
@@ -992,6 +991,10 @@ class NodeController:
             return {"ok": True, "node_id": self.node_id,
                     "store": st,
                     "num_objects": st["num_objects"],
+                    # Per-RPC-type counts + cumulative seconds: the
+                    # cProfile-free view of where this controller's event
+                    # loop goes (GCS exposes the same via debug_stats).
+                    "handler_stats": dict(self.server.handler_stats),
                     "num_workers": len(self.workers),
                     "workers": [
                         {"pid": pid, "registered": w.conn is not None,

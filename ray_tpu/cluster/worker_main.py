@@ -147,18 +147,40 @@ def main():
                     type(ser.serialize(None)).from_bytes(payload))
         return pos, kwargs
 
+    # Result blobs written by the CURRENT task, registered with the
+    # controller inside the task_done message instead of one object_added
+    # oneway each — at fan-out rates the per-result socket write was half
+    # the worker->controller traffic. Same connection + same FIFO slot, so
+    # the registration-before-finish invariant is unchanged. Keyed per
+    # thread: concurrent actor methods (max_concurrency/asyncio) each
+    # accumulate their own adds.
+    _pending_adds: Dict[int, list] = {}
+
+    def _store_blob(oid: bytes, blob: bytes) -> None:
+        """Arena write with DEFERRED registration (falls back to the
+        immediate path when the arena is unavailable/full)."""
+        if core.local_store is not None:
+            try:
+                core.local_store.put(oid, blob)
+                _pending_adds.setdefault(
+                    threading.get_ident(), []).append([oid, len(blob)])
+                return
+            except Exception:  # noqa: BLE001 - arena full: RPC path
+                pass
+        core.put_blob(oid, blob)
+
     def store_result(oid: bytes, value: Any):
         sobj = ser.serialize(value)
         # Refs returned inside the result stay pinned while it lives.
         core._report_contained(oid, sobj.contained_refs)
-        core.put_blob(oid, VAL_PREFIX + sobj.to_bytes())
+        _store_blob(oid, VAL_PREFIX + sobj.to_bytes())
 
     def store_error(msg, exc: BaseException):
         if not isinstance(exc, TaskError):
             exc = TaskError(msg.get("name", "task"), exc)
         blob = ERR_PREFIX + pickle.dumps(exc)
         for oid in msg["return_ids"]:
-            core.put_blob(oid, blob)
+            _store_blob(oid, blob)
 
     def run_returns(msg, result):
         oids = msg["return_ids"]
@@ -203,6 +225,9 @@ def main():
                 "type": "task_done",
                 "pid": os.getpid(),
                 "return_ids": msg.get("return_ids", []),
+                # This task's result blobs: registered by the controller
+                # BEFORE it processes the finish (same message).
+                "added": _pending_adds.pop(threading.get_ident(), []),
             })
             return True
         except (ConnectionError, OSError):
