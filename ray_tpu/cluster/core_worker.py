@@ -107,6 +107,13 @@ class ClusterCoreWorker:
         self._direct_outstanding: Dict[bytes, float] = {}  # rid -> push time
         self._direct_expire_last = 0.0
         self._direct_janitor: Any = None
+        # Shared as_future resolver (one thread + one directory long-poll
+        # for every outstanding future).
+        self._future_lock = threading.Lock()
+        self._future_waiters: Dict[bytes, list] = {}
+        self._future_thread: Any = None
+        self._future_event = threading.Event()
+        self._future_probe_last = 0.0
         self._ref_lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
         self._ref_inc: List[bytes] = []
@@ -1077,18 +1084,119 @@ class ClusterCoreWorker:
                 return out_ready, out_rest
 
     def as_future(self, ref: ObjectRef):
+        """Future that resolves when the object lands — via ONE shared
+        resolver thread batch-long-polling the directory for every
+        outstanding future, not a thread per ref: an async ingress with N
+        in-flight requests costs one poll connection, not N threads."""
         from concurrent.futures import Future
 
+        self._flush_submits()   # the producing task may still be buffered
         fut: Future = Future()
-
-        def run():
-            try:
-                fut.set_result(self.get([ref])[0])
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
-
-        threading.Thread(target=run, daemon=True).start()
+        oid = ref.id.binary()
+        blob = self._local_blob(oid)
+        if blob is not None:
+            self._resolve_future(fut, blob)
+            self._direct_observed(oid)
+            return fut
+        with self._future_lock:
+            self._future_waiters.setdefault(oid, []).append(fut)
+            if self._future_thread is None \
+                    or not self._future_thread.is_alive():
+                self._future_thread = threading.Thread(
+                    target=self._future_resolver_loop, daemon=True,
+                    name="future-resolver")
+                self._future_thread.start()
+        self._future_event.set()
         return fut
+
+    def _resolve_future(self, fut, blob: bytes) -> None:
+        """Settle one future; tolerant of caller-side cancellation (e.g.
+        asyncio.wait_for timing out wrap_future) — an InvalidStateError
+        here must never escape into the SHARED resolver thread, where it
+        would strand every other outstanding future."""
+        try:
+            value, exc = self._blob_value(blob), None
+        except BaseException as e:  # noqa: BLE001 - error blob -> exception
+            value, exc = None, e
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except Exception:  # noqa: BLE001 - cancelled/already settled
+            pass
+
+    def _future_resolver_loop(self) -> None:
+        while not self._ref_shutdown.is_set():
+            try:
+                self._future_resolver_tick()
+            except Exception:  # noqa: BLE001 - resolver must survive
+                import traceback
+
+                traceback.print_exc()
+                time.sleep(0.5)
+
+    def _future_resolver_tick(self) -> None:
+        with self._future_lock:
+            # Prune futures the caller abandoned (cancelled): their oids
+            # must not pin the poll set forever.
+            for oid in list(self._future_waiters):
+                live = [f for f in self._future_waiters[oid]
+                        if not f.cancelled()]
+                if live:
+                    self._future_waiters[oid] = live
+                else:
+                    del self._future_waiters[oid]
+            pending = dict(self._future_waiters)
+        if not pending:
+            self._future_event.wait(timeout=5.0)
+            self._future_event.clear()
+            return
+
+        settled = 0
+
+        def settle(oid: bytes, blob: bytes) -> None:
+            nonlocal settled
+            with self._future_lock:
+                futs = self._future_waiters.pop(oid, [])
+            for f in futs:
+                self._resolve_future(f, blob)
+            self._direct_observed(oid)
+            settled += 1
+
+        for oid in list(pending):
+            blob = self._local_blob(oid)
+            if blob is not None:
+                settle(oid, blob)
+                del pending[oid]
+        if not pending:
+            return
+        now = time.monotonic()
+        probe = now - self._future_probe_last >= 2.0
+        if probe:
+            self._future_probe_last = now
+        try:
+            resp = self.gcs.call(
+                {"type": "locations_batch",
+                 "object_ids": list(pending), "wait_s": 1.0,
+                 "probe": probe}, timeout=31.0)
+        except (ConnectionError, OSError):
+            time.sleep(0.5)
+            return
+        to_fetch = {}
+        for oid, info in resp.get("objects", {}).items():
+            if info.get("error_blob") is not None:
+                settle(oid, info["error_blob"])
+                continue
+            to_fetch[oid] = info
+        for oid, blob in self._fetch_many(to_fetch).items():
+            settle(oid, blob)
+        if resp.get("objects") and not settled:
+            # Located but unfetchable (dead holder / evicted blob): the
+            # long-poll returns instantly on the stale location — back off
+            # or this loop hot-spins until the reaper fixes the directory
+            # (same guard as get()).
+            time.sleep(0.05)
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
         """Eagerly delete objects cluster-wide: the GCS drops directory

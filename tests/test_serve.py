@@ -215,3 +215,69 @@ def test_serve_metrics_and_exporters(serve_instance):
     text = serve.stat(exporter=PrometheusExporter())
     assert 'ray_serve_endpoint_count{endpoint="met"} 25' in text
     assert 'ray_serve_backend_latency_ms_p50{backend="met:v1"}' in text
+
+
+def test_http_ingress_concurrent_with_idle_connections(local_ray):
+    """The asyncio ingress serves concurrent requests correctly while many
+    idle keep-alive connections are parked on its event loop (r5: the
+    thread-per-connection stdlib server capped connection scale)."""
+    import json as _json
+    import socket
+    import threading
+    import time as _time
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.serve import BackendConfig
+
+    def double(x):
+        _time.sleep(0.01)
+        return x * 2
+
+    serve.init(http_port=0)
+    try:
+        serve.create_backend("http-conc", double,
+                             config=BackendConfig(num_replicas=2,
+                                                  max_concurrent_queries=32))
+        serve.create_endpoint("http-conc-ep", backend="http-conc",
+                              route="/dbl", methods=["POST"])
+        addr = serve.http_address()
+        host, port = addr.split("//")[1].split(":")
+        idle = [socket.create_connection((host, int(port)), timeout=10)
+                for _ in range(100)]
+        try:
+            results = [None] * 20
+            def req(i):
+                body = _json.dumps({"args": [i]}).encode()
+                r = urllib.request.Request(
+                    f"{addr}/dbl", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(r, timeout=60) as resp:
+                    results[i] = _json.loads(resp.read())["result"]
+            ts = [threading.Thread(target=req, args=(i,)) for i in range(20)]
+            for t in ts: t.start()
+            for t in ts: t.join()
+            assert results == [i * 2 for i in range(20)]
+            # keep-alive: one connection serves several sequential requests
+            s = socket.create_connection((host, int(port)), timeout=10)
+            for i in (3, 5):
+                body = _json.dumps({"args": [i]}).encode()
+                s.sendall((f"POST /dbl HTTP/1.1\r\nHost: x\r\n"
+                           f"Content-Type: application/json\r\n"
+                           f"Content-Length: {len(body)}\r\n\r\n"
+                           ).encode() + body)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += s.recv(4096)
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                length = int([ln.split(b":")[1] for ln in head.split(b"\r\n")
+                              if ln.lower().startswith(b"content-length")][0])
+                while len(rest) < length:
+                    rest += s.recv(4096)
+                assert _json.loads(rest[:length])["result"] == i * 2
+            s.close()
+        finally:
+            for c in idle:
+                c.close()
+    finally:
+        serve.shutdown()
