@@ -1176,13 +1176,19 @@ class ClusterCoreWorker:
         if probe:
             self._future_probe_last = now
         try:
+            # wait_s = 0.25, not 1.0: a future registered AFTER this poll
+            # started is invisible to it (the park cannot be interrupted),
+            # so the window is the worst-case added latency for every new
+            # request — 4 idle RPCs/s while futures are outstanding buys a
+            # 250 ms tail bound.
             resp = self.gcs.call(
                 {"type": "locations_batch",
-                 "object_ids": list(pending), "wait_s": 1.0,
+                 "object_ids": list(pending), "wait_s": 0.25,
                  "probe": probe}, timeout=31.0)
         except (ConnectionError, OSError):
             time.sleep(0.5)
             return
+        before_rpc = settled
         to_fetch = {}
         for oid, info in resp.get("objects", {}).items():
             if info.get("error_blob") is not None:
@@ -1191,11 +1197,12 @@ class ClusterCoreWorker:
             to_fetch[oid] = info
         for oid, blob in self._fetch_many(to_fetch).items():
             settle(oid, blob)
-        if resp.get("objects") and not settled:
+        if resp.get("objects") and settled == before_rpc:
             # Located but unfetchable (dead holder / evicted blob): the
             # long-poll returns instantly on the stale location — back off
-            # or this loop hot-spins until the reaper fixes the directory
-            # (same guard as get()).
+            # or this loop hot-spins until the reaper fixes the directory.
+            # Compared per-RPC (not tick-wide): pre-RPC local settles must
+            # not mask the stall (same guard as get()'s progressed flag).
             time.sleep(0.05)
 
     def free(self, refs: Sequence[ObjectRef]) -> None:

@@ -27,7 +27,8 @@ class _BadRequest(Exception):
     pass
 
 
-async def _read_request(reader: asyncio.StreamReader):
+async def _read_request(reader: asyncio.StreamReader,
+                        writer: asyncio.StreamWriter):
     """Parse one HTTP/1.1 request; returns (method, path, headers, body)
     or None on clean EOF between requests."""
     try:
@@ -53,6 +54,11 @@ async def _read_request(reader: asyncio.StreamReader):
     length = int(headers.get("content-length") or 0)
     if length < 0 or length > _MAX_BODY:
         raise _BadRequest("bad content-length")
+    if "100-continue" in headers.get("expect", "").lower():
+        # curl sends Expect: 100-continue for larger POST bodies and
+        # waits ~1s for this interim response before transmitting.
+        writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+        await writer.drain()
     body = await reader.readexactly(length) if length else b""
     return method.upper(), path, headers, body
 
@@ -119,11 +125,17 @@ class HTTPProxyActor:
             loop.close()   # local ref: stop() nulls self._loop
 
     async def _route_call(self, endpoint: str, method: str, args, kwargs):
-        """One router call, awaited on the event loop: the ObjectRef
-        resolves through the core's shared future resolver."""
-        ref = self.router.route.remote(endpoint, method, args, kwargs)
-        return await asyncio.wait_for(
-            asyncio.wrap_future(ref.future()), 600.0)
+        """One router call: the submit itself does synchronous RPCs (and
+        actor-resolution retries on router restart), so it runs in a
+        worker thread — ON the event loop it would freeze every
+        connection for its duration. The resulting ObjectRef resolves
+        through the core's shared future resolver."""
+        def submit():
+            ref = self.router.route.remote(endpoint, method, args, kwargs)
+            return ref.future()
+
+        fut = await asyncio.to_thread(submit)
+        return await asyncio.wait_for(asyncio.wrap_future(fut), 600.0)
 
     # ------------------------------------------------------------ connection
     async def _handle_conn(self, reader: asyncio.StreamReader,
@@ -131,7 +143,7 @@ class HTTPProxyActor:
         try:
             while True:
                 try:
-                    req = await _read_request(reader)
+                    req = await _read_request(reader, writer)
                 except (_BadRequest, asyncio.IncompleteReadError,
                         UnicodeDecodeError, ValueError):
                     writer.write(_response(
@@ -143,11 +155,15 @@ class HTTPProxyActor:
                 keep = headers.get("connection", "").lower() != "close"
                 try:
                     await self._serve_one(writer, method, raw_path, body)
+                    await writer.drain()
                 except (ConnectionResetError, BrokenPipeError):
-                    return
+                    return  # client went away: nothing to report
                 except Exception as e:  # noqa: BLE001 - reply, keep serving
-                    writer.write(_response(500, {"error": str(e)}))
-                await writer.drain()
+                    try:
+                        writer.write(_response(500, {"error": str(e)}))
+                        await writer.drain()
+                    except (ConnectionResetError, BrokenPipeError, OSError):
+                        return
                 if not keep:
                     break
         finally:
