@@ -131,7 +131,7 @@ def _prefill_into_slot(params: Params, tokens: jax.Array,
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "out", "temperature",
-                 "rng")
+                 "rng", "ng")
 
     def __init__(self, req_id: int, prompt: List[int], max_new_tokens: int,
                  temperature: float = 0.0, seed: Optional[int] = None):
@@ -144,6 +144,7 @@ class _Request:
         # regardless of batch composition; no seed -> fresh OS entropy
         # (req_id would repeat identically across engine restarts).
         self.rng = np.random.default_rng(seed)
+        self.ng = None   # lazy NgramIndex (speculative decoding)
 
     def pick(self, logits_row: np.ndarray) -> int:
         """Greedy at temperature 0; softmax-sample otherwise (host-side,
@@ -171,12 +172,18 @@ class GenerationEngine:
 
     def __init__(self, params: Params, cfg: TransformerConfig, *,
                  max_slots: int = 4, max_seq: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, speculative_k: int = 0,
+                 speculative_ngram: int = 2):
         self.params = params
         self.cfg = cfg
         self.slots = max_slots
         self.max_seq = max_seq or cfg.max_seq_len
         self.eos_id = eos_id
+        # N-gram speculative decoding (models/speculative.py): verify K
+        # prompt-lookup drafts per step in one (K+1)-position forward.
+        # Greedy outputs stay bit-exact; 0 disables.
+        self.speculative_k = int(speculative_k)
+        self.speculative_ngram = int(speculative_ngram)
         self._alloc_cache()
         self.lengths = np.zeros(max_slots, np.int32)
         self.tokens = np.zeros(max_slots, np.int32)   # last token per slot
@@ -243,6 +250,8 @@ class GenerationEngine:
         events = self._admit()
         if not any(r is not None for r in self.active):
             return events
+        if self.speculative_k > 0:
+            return self._spec_step(events)
         logits = self._decode_all()
         # Hot path stays device-side: greedy slots get the [B] int32 argmax
         # transfer; only the sampling slots' logits ROWS come to the host
@@ -289,6 +298,95 @@ class GenerationEngine:
             self.step()
         out, self.done = self.done, {}
         return out
+
+    # ------------------------------------------------------ speculative
+    def _spec_possible(self) -> bool:
+        """The (K+1)-wide verify chunk writes cache rows lengths..lengths+K
+        for EVERY slot; a slot within K+1 rows of max_seq would write
+        (clamped) over valid rows, so such ticks run a width-1 chunk —
+        only the last few tokens of a nearly-full slot."""
+        K = self.speculative_k
+        for slot, req in enumerate(self.active):
+            if req is not None \
+                    and self.lengths[slot] + K + 1 > self.max_seq:
+                return False
+        return True
+
+    def _spec_step(self, events: List[Tuple[int, int, bool]]
+                   ) -> List[Tuple[int, int, bool]]:
+        """One speculative tick: propose prompt-lookup drafts per slot
+        (incremental NgramIndex, O(1)/token), verify them all in a single
+        (K+1)-position forward, emit the longest verified prefix + one
+        bonus token per slot. Draft-less ticks (no n-gram hit anywhere,
+        cache-boundary slots, all-sampling batches) run the SAME verify
+        program at width 1 — with speculation on, every logit comes from
+        one kernel, so greedy acceptance is exact by construction (a
+        near-tie argmax between the flash-decode kernel and this chunk
+        forward can never flip a decision mid-stream). Sampling slots
+        accept no drafts; their next token samples from chunk position 0.
+        """
+        from .speculative import NgramIndex, _batched_verify, longest_accept
+
+        B, K = self.slots, self.speculative_k
+        drafts = np.zeros((B, K), np.int32)
+        dlen = np.zeros(B, np.int32)
+        if self._spec_possible():
+            for slot, req in enumerate(self.active):
+                if req is None or req.temperature > 0:
+                    continue
+                if req.ng is None:
+                    req.ng = NgramIndex(self.speculative_ngram,
+                                        req.prompt + req.out)
+                room = min(K, self.max_seq - len(req.ng.ctx) - 1,
+                           req.max_new_tokens - len(req.out) - 1)
+                if room <= 0:
+                    continue
+                d = req.ng.propose(room)
+                dlen[slot] = len(d)
+                drafts[slot, :len(d)] = d
+        width = K + 1 if dlen.any() else 1
+        chunk = np.concatenate(
+            [self.tokens[:, None], drafts[:, :width - 1]], axis=1)
+        logits, self.cache_k, self.cache_v = _batched_verify(
+            self.params, jnp.asarray(chunk), jnp.asarray(self.lengths),
+            self.cache_k, self.cache_v, self.cfg)
+        greedy = np.asarray(jnp.argmax(
+            logits, axis=-1).astype(jnp.int32))               # [B, K+1]
+        sampling_slots = [s for s, r in enumerate(self.active)
+                          if r is not None and r.temperature > 0]
+        rows = (np.asarray(logits[jnp.asarray(sampling_slots), 0])
+                if sampling_slots else None)
+        row_of = {s: i for i, s in enumerate(sampling_slots)}
+        for slot, req in enumerate(self.active):
+            if req is None:
+                continue
+            if slot in row_of:
+                emitted = [req.pick(rows[row_of[slot]])]
+            else:
+                a = longest_accept(drafts[slot], int(dlen[slot]),
+                                   greedy[slot])
+                emitted = [int(t) for t in greedy[slot, :a + 1]]
+            # Truncate at max_new_tokens / EOS (either finishes the slot).
+            out_tokens: List[int] = []
+            finished = False
+            for t in emitted:
+                out_tokens.append(t)
+                if (len(req.out) + len(out_tokens) >= req.max_new_tokens
+                        or (self.eos_id is not None and t == self.eos_id)):
+                    finished = True
+                    break
+            req.out.extend(out_tokens)
+            if req.ng is not None:
+                req.ng.extend(out_tokens)
+            self.lengths[slot] += len(out_tokens)
+            self.tokens[slot] = out_tokens[-1]
+            for i, t in enumerate(out_tokens):
+                events.append((req.req_id, t,
+                               finished and i == len(out_tokens) - 1))
+            if finished:
+                self.done[req.req_id] = req.out
+                self._release_slot(slot)
+        return events
 
     # ---- internals (subclass hooks: _decode_all / _prefill_slot /
     #      _release_slot / _can_admit — the paged engine overrides these) --

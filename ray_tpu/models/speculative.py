@@ -1,0 +1,172 @@
+"""N-gram (prompt-lookup) speculative decoding for the generation engine.
+
+The engine's decode step normally advances every slot by ONE token per
+jitted call. Decode on TPU is HBM-bound — the weights stream through the
+MXU once per step regardless of how many positions ride along — so
+verifying K draft tokens in one (K+1)-position forward costs barely more
+than a single-token step while potentially emitting K+1 tokens.
+
+Drafts come from PROMPT LOOKUP (no draft model): the most recent earlier
+occurrence of the slot's trailing n-gram in its own context proposes the
+tokens that followed it — highly effective on repetitive/structured text
+(code, extraction, summarization quoting the source). Verification is
+exact for greedy requests: with speculation ON, every logit (draft-less
+ticks included — they run this program at width 1) comes from this one
+chunk forward, so an accepted token is, by construction, the argmax the
+same-kernel one-at-a-time loop would have produced. Spec-on vs spec-OFF
+outputs are bit-identical wherever this forward and the flash-decode
+kernel agree on argmax (always on CPU/XLA; on chip a pathological
+near-tie logit pair could differ in low bits — the standard caveat for
+any speculative scheme whose verify kernel differs from its decode
+kernel). SAMPLING slots (temperature > 0) draw from this chunk
+forward's position-0 logits; since chunk width varies with batch-mates'
+drafts, a seeded sampled stream is reproducible across runs of the same
+workload but is NOT bit-matched to the spec-off engine on hardware
+where the kernels' low bits differ — run sampling-critical workloads
+with speculation off if spec-off reproducibility matters.
+
+Reference counterpart: none (Ray 0.9 predates LLM serving); the
+technique is the standard assisted-generation/prompt-lookup decoding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import masked_gqa_attention
+from .transformer import Params, TransformerConfig, _mlp, _rms_norm, _rope
+
+
+def _rope_positions(x: jax.Array, positions: jax.Array,
+                    theta: float) -> jax.Array:
+    """x [B, S, H, D] rotated at per-slot-and-position angles
+    (positions [B, S]) — the verify chunk starts at a different absolute
+    position per slot. vmaps the SHARED _rope over the batch axis so the
+    rotation math keeps exactly one implementation."""
+    return jax.vmap(
+        lambda xb, pb: _rope(xb[None], pb, theta)[0])(x, positions)
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache_k", "cache_v"))
+def _batched_verify(params: Params, tokens: jax.Array, lengths: jax.Array,
+                    cache_k: jax.Array, cache_v: jax.Array,
+                    cfg: TransformerConfig):
+    """Verify forward: tokens [B, S] (current token + S-1 drafts) at
+    positions lengths..lengths+S-1 -> logits [B, S, V].
+
+    Every chunk position's K/V is written into the slot's cache rows
+    (donated buffers); position i attends cache rows 0..lengths+i (its
+    own row included). Rows written for REJECTED drafts hold garbage
+    afterwards — safe by the engine's standing invariant: decode/verify
+    overwrites row `length` before any attend reaches it, and the attend
+    bound never passes the accepted length.
+    """
+    B, S = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]                    # [B, S, E]
+    positions = lengths[:, None] + jnp.arange(S)[None, :]     # [B, S]
+    S_max = cache_k.shape[2]
+    # mask [B, S, S_max]: position i sees cache rows <= lengths+i.
+    attend = (jnp.arange(S_max)[None, None, :]
+              <= positions[:, :, None])
+
+    def write_slot(buf, kv, pos):
+        # buf [S_max, KH, Dh], kv [S, KH, Dh] written at rows pos..pos+S-1
+        return jax.lax.dynamic_update_slice(buf, kv, (pos, 0, 0))
+
+    def block(x, xs):
+        layer, ck, cv = xs                                 # ck [B,Smax,KH,Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope_positions((h @ layer["wq"].astype(dt)).reshape(
+            B, S, H, Dh), positions, cfg.rope_theta)
+        k = _rope_positions((h @ layer["wk"].astype(dt)).reshape(
+            B, S, KH, Dh), positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, S, KH, Dh)
+        ck = jax.vmap(write_slot)(ck, k, lengths)
+        cv = jax.vmap(write_slot)(cv, v, lengths)
+        attn = masked_gqa_attention(q, ck, cv, attend).reshape(
+            B, S, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache_k, cache_v))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["embed"].astype(dt).T                 # [B, S, V]
+    return logits, new_k, new_v
+
+
+def propose_ngram(context: Sequence[int], k: int,
+                  ngram: int = 2) -> List[int]:
+    """Prompt-lookup draft: find the most recent EARLIER occurrence of the
+    trailing ``ngram`` tokens in ``context`` and propose the k tokens that
+    followed it. Returns [] when there is no match (or not enough
+    context). O(context) scan — the engine uses the incremental
+    NgramIndex instead; this form remains as the executable spec."""
+    n = len(context)
+    if n <= ngram:
+        return []
+    tail = tuple(context[-ngram:])
+    # Search right-to-left for the previous occurrence (excluding the
+    # trailing position itself).
+    for start in range(n - ngram - 1, -1, -1):
+        if tuple(context[start:start + ngram]) == tail:
+            follow = context[start + ngram:start + ngram + k]
+            return list(follow)
+    return []
+
+
+class NgramIndex:
+    """Incremental last-occurrence index of n-grams over one request's
+    context: O(1) per appended token, O(k) per proposal — a per-tick
+    O(context) rescan would dominate the host side of long-context
+    serving. Tracks the last TWO start positions per gram so the lookup
+    can skip the trailing gram itself. Proposals match propose_ngram
+    exactly (asserted in tests)."""
+
+    __slots__ = ("n", "ctx", "map")
+
+    def __init__(self, n: int, context: Sequence[int] = ()):
+        self.n = n
+        self.ctx: List[int] = []
+        self.map: dict = {}      # gram -> (last_start, previous_start)
+        self.extend(context)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        for t in tokens:
+            self.ctx.append(int(t))
+            m = len(self.ctx)
+            if m >= self.n:
+                g = tuple(self.ctx[m - self.n:])
+                self.map[g] = (m - self.n, self.map.get(g, (None,))[0])
+
+    def propose(self, k: int) -> List[int]:
+        m = len(self.ctx)
+        if m <= self.n or k <= 0:
+            return []
+        tail = tuple(self.ctx[m - self.n:])
+        last, prev = self.map.get(tail, (None, None))
+        pos = prev if last == m - self.n else last
+        if pos is None:
+            return []
+        return self.ctx[pos + self.n:pos + self.n + k]
+
+
+def longest_accept(drafts: np.ndarray, draft_len: int,
+                   greedy: np.ndarray) -> int:
+    """Number of leading drafts verified: draft i is accepted iff it
+    equals the greedy continuation after consuming drafts 0..i-1
+    (greedy[i] is the argmax at chunk position i)."""
+    a = 0
+    while a < draft_len and int(drafts[a]) == int(greedy[a]):
+        a += 1
+    return a

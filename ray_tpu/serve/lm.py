@@ -57,8 +57,14 @@ class LMBackend:
                  max_seq: Optional[int] = None,
                  stream_idle_timeout_s: float = 120.0,
                  paged: bool = False, page_size: int = 128,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 speculative_k: int = 0):
         if paged:
+            if speculative_k:
+                raise ValueError(
+                    "speculative_k requires the contiguous engine "
+                    "(paged=False): the paged engine has no speculative "
+                    "verify path yet")
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
             # FIFO on page budget. Same outputs.
@@ -70,9 +76,11 @@ class LMBackend:
         else:
             from ..models.engine import GenerationEngine
 
+            # speculative_k > 0: n-gram speculative decoding (exact for
+            # greedy requests; see models/speculative.py).
             self.engine = GenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
-                max_seq=max_seq)
+                max_seq=max_seq, speculative_k=speculative_k)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
         # RLock: stream_poll -> _expire_idle_streams -> stream_cancel
