@@ -184,6 +184,9 @@ class GenerationEngine:
         # Greedy outputs stay bit-exact; 0 disables.
         self.speculative_k = int(speculative_k)
         self.speculative_ngram = int(speculative_ngram)
+        # Subclass knob: run draft-less spec ticks through _decode_all
+        # (flash kernel) instead of a width-1 verify chunk.
+        self._spec_plain_when_draftless = False
         self._alloc_cache()
         self.lengths = np.zeros(max_slots, np.int32)
         self.tokens = np.zeros(max_slots, np.int32)   # last token per slot
@@ -252,11 +255,17 @@ class GenerationEngine:
             return events
         if self.speculative_k > 0:
             return self._spec_step(events)
-        logits = self._decode_all()
-        # Hot path stays device-side: greedy slots get the [B] int32 argmax
-        # transfer; only the sampling slots' logits ROWS come to the host
-        # ([k, V], not [B, V]), so one temperature>0 request doesn't impose
-        # the full-matrix bandwidth cliff on its greedy batch-mates.
+        return self._emit_single(self._decode_all(), events)
+
+    def _emit_single(self, logits: jax.Array,
+                     events: List[Tuple[int, int, bool]]
+                     ) -> List[Tuple[int, int, bool]]:
+        """Emit one token per active slot from decode logits [B, V].
+
+        Hot path stays device-side: greedy slots get the [B] int32 argmax
+        transfer; only the sampling slots' logits ROWS come to the host
+        ([k, V], not [B, V]), so one temperature>0 request doesn't impose
+        the full-matrix bandwidth cliff on its greedy batch-mates."""
         sampling_slots = [s for s, r in enumerate(self.active)
                           if r is not None and r.temperature > 0]
         nxt = np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32))
@@ -269,6 +278,8 @@ class GenerationEngine:
             token = (req.pick(rows[row_of[slot]]) if slot in row_of
                      else int(nxt[slot]))
             req.out.append(token)
+            if req.ng is not None:
+                req.ng.extend([token])
             self.lengths[slot] += 1
             self.tokens[slot] = token
             finished = (len(req.out) >= req.max_new_tokens
@@ -325,7 +336,7 @@ class GenerationEngine:
         forward can never flip a decision mid-stream). Sampling slots
         accept no drafts; their next token samples from chunk position 0.
         """
-        from .speculative import NgramIndex, _batched_verify, longest_accept
+        from .speculative import NgramIndex, longest_accept
 
         B, K = self.slots, self.speculative_k
         drafts = np.zeros((B, K), np.int32)
@@ -345,11 +356,16 @@ class GenerationEngine:
                 dlen[slot] = len(d)
                 drafts[slot, :len(d)] = d
         width = K + 1 if dlen.any() else 1
+        if width == 1 and self._spec_plain_when_draftless:
+            # Paged engine: a width-1 verify would gather the FULL page
+            # pool per layer (dense XLA attention) — exactly the HBM sweep
+            # the pallas paged-decode kernel exists to skip. Draft-less
+            # ticks take the flash path instead (the greedy low-bit
+            # cross-kernel caveat applies; see speculative.py docstring).
+            return self._emit_single(self._decode_all(), events)
         chunk = np.concatenate(
             [self.tokens[:, None], drafts[:, :width - 1]], axis=1)
-        logits, self.cache_k, self.cache_v = _batched_verify(
-            self.params, jnp.asarray(chunk), jnp.asarray(self.lengths),
-            self.cache_k, self.cache_v, self.cfg)
+        logits = self._verify_all(chunk)
         greedy = np.asarray(jnp.argmax(
             logits, axis=-1).astype(jnp.int32))               # [B, K+1]
         sampling_slots = [s for s, r in enumerate(self.active)
@@ -388,8 +404,20 @@ class GenerationEngine:
                 self._release_slot(slot)
         return events
 
+    def _verify_all(self, chunk: np.ndarray) -> jax.Array:
+        """Speculative verify over every slot (chunk [B, S]); returns
+        logits [B, S, V]. Subclass hook: the paged engine routes the
+        chunk's cache writes through its page tables."""
+        from .speculative import _batched_verify
+
+        logits, self.cache_k, self.cache_v = _batched_verify(
+            self.params, jnp.asarray(chunk), jnp.asarray(self.lengths),
+            self.cache_k, self.cache_v, self.cfg)
+        return logits
+
     # ---- internals (subclass hooks: _decode_all / _prefill_slot /
-    #      _release_slot / _can_admit — the paged engine overrides these) --
+    #      _release_slot / _can_admit / _verify_all — the paged engine
+    #      overrides these) --
 
     def _decode_all(self) -> jax.Array:
         """One lockstep decode over every slot; returns logits [B, V]."""

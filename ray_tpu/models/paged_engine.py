@@ -42,7 +42,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import masked_gqa_attention
-from ..ops.paged_attention import PagePool, paged_decode_attention
+from ..ops.paged_attention import (
+    PagePool,
+    paged_decode_attention,
+    paged_gather,
+)
 from .engine import GenerationEngine, _Request, _rope_at
 from .transformer import Params, TransformerConfig, _mlp, _rms_norm, _rope
 
@@ -89,6 +93,68 @@ def _paged_decode(params: Params, tokens: jax.Array, lengths: jax.Array,
         block, x, (params["layers"], k_pages, v_pages))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x[:, 0] @ params["embed"].astype(dt).T
+    return logits, new_k, new_v
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("k_pages", "v_pages"))
+def _paged_verify(params: Params, tokens: jax.Array, lengths: jax.Array,
+                  tables: jax.Array, k_pages: jax.Array,
+                  v_pages: jax.Array, cfg: TransformerConfig):
+    """Speculative verify through page indirection: tokens [B, S]
+    (current + S-1 drafts) at positions lengths..lengths+S-1 -> logits
+    [B, S, V]. Chunk K/V rows scatter through each slot's page table
+    (out-of-range / -1 pages route to the scratch page 0, so a draft
+    position past a request's reserved range can never corrupt a live
+    page — including another request's shared prefix pages, which all
+    lie strictly before the prompt end and are never written here).
+    Attention gathers the pool to the logical layout and masks col <=
+    lengths+i (XLA path; chunk widths are small)."""
+    from .speculative import _rope_positions
+
+    B, S = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pages.shape[2]
+    P = tables.shape[1]
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]                    # [B, S, E]
+    positions = lengths[:, None] + jnp.arange(S)[None, :]     # [B, S]
+    page_idx = positions // ps
+    inb = page_idx < P
+    page = jnp.where(
+        inb,
+        jnp.take_along_axis(tables, jnp.minimum(page_idx, P - 1), axis=1),
+        -1)
+    rows = (jnp.maximum(page, 0) * ps + positions % ps).reshape(-1)  # [B*S]
+    attend = (jnp.arange(P * ps)[None, None, :]
+              <= positions[:, :, None])                       # [B, S, P*ps]
+
+    def block(x, xs):
+        layer, kp, vp = xs                    # kp [num_pages, ps, KH, Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope_positions((h @ layer["wq"].astype(dt)).reshape(
+            B, S, H, Dh), positions, cfg.rope_theta)
+        k = _rope_positions((h @ layer["wk"].astype(dt)).reshape(
+            B, S, KH, Dh), positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, S, KH, Dh)
+        shape = kp.shape
+        kp = kp.reshape(-1, KH, Dh).at[rows].set(
+            k.reshape(-1, KH, Dh)).reshape(shape)
+        vp = vp.reshape(-1, KH, Dh).at[rows].set(
+            v.reshape(-1, KH, Dh)).reshape(shape)
+        buf_k = paged_gather(kp, tables)
+        buf_v = paged_gather(vp, tables)
+        attn = masked_gqa_attention(q, buf_k, buf_v, attend).reshape(
+            B, S, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["embed"].astype(dt).T                 # [B, S, V]
     return logits, new_k, new_v
 
 
@@ -148,9 +214,11 @@ class PagedGenerationEngine(GenerationEngine):
     def __init__(self, params: Params, cfg: TransformerConfig, *,
                  max_slots: int = 4, max_seq: Optional[int] = None,
                  eos_id: Optional[int] = None, page_size: int = 128,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, speculative_k: int = 0,
+                 speculative_ngram: int = 2):
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
-                         eos_id=eos_id)
+                         eos_id=eos_id, speculative_k=speculative_k,
+                         speculative_ngram=speculative_ngram)
         L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         self.page_size = ps = page_size
         self.pages_per_slot = -(-self.max_seq // ps)
@@ -171,6 +239,9 @@ class PagedGenerationEngine(GenerationEngine):
         self._tables = np.full((max_slots, self.pages_per_slot), -1,
                                np.int32)
         self._prompt_keys: dict = {}  # req_id -> prefix block keys (memo)
+        # Draft-less speculative ticks use the pallas paged-decode kernel:
+        # a width-1 verify would gather the whole page pool per layer.
+        self._spec_plain_when_draftless = True
 
     # ------------------------------------------------------------ hooks
     def _alloc_cache(self) -> None:
@@ -246,6 +317,13 @@ class PagedGenerationEngine(GenerationEngine):
             self.params, jnp.asarray(self.tokens),
             jnp.asarray(self.lengths), jnp.asarray(self._tables),
             self.k_pages, self.v_pages, self.cfg)
+        return logits
+
+    def _verify_all(self, chunk: np.ndarray) -> jax.Array:
+        logits, self.k_pages, self.v_pages = _paged_verify(
+            self.params, jnp.asarray(chunk), jnp.asarray(self.lengths),
+            jnp.asarray(self._tables), self.k_pages, self.v_pages,
+            self.cfg)
         return logits
 
     def _prefill_slot(self, slot: int, req: _Request) -> bool:

@@ -60,19 +60,16 @@ class LMBackend:
                  num_pages: Optional[int] = None,
                  speculative_k: int = 0):
         if paged:
-            if speculative_k:
-                raise ValueError(
-                    "speculative_k requires the contiguous engine "
-                    "(paged=False): the paged engine has no speculative "
-                    "verify path yet")
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
-            # FIFO on page budget. Same outputs.
+            # FIFO on page budget. Same outputs; speculation verifies
+            # through the page tables.
             from ..models.paged_engine import PagedGenerationEngine
 
             self.engine = PagedGenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
-                max_seq=max_seq, page_size=page_size, num_pages=num_pages)
+                max_seq=max_seq, page_size=page_size, num_pages=num_pages,
+                speculative_k=speculative_k)
         else:
             from ..models.engine import GenerationEngine
 
