@@ -173,12 +173,22 @@ class GenerationEngine:
     def __init__(self, params: Params, cfg: TransformerConfig, *,
                  max_slots: int = 4, max_seq: Optional[int] = None,
                  eos_id: Optional[int] = None, speculative_k: int = 0,
-                 speculative_ngram: int = 2):
-        self.params = params
+                 speculative_ngram: int = 2,
+                 mesh: Optional["jax.sharding.Mesh"] = None):
         self.cfg = cfg
         self.slots = max_slots
         self.max_seq = max_seq or cfg.max_seq_len
         self.eos_id = eos_id
+        # Multi-chip serving: place params in the Megatron tp decode
+        # layout and shard the KV cache on the kv-head axis — the jitted
+        # prefill/decode/verify programs then run SPMD over the mesh with
+        # GSPMD-inserted collectives; the host loop is unchanged.
+        self.mesh = mesh
+        if mesh is not None:
+            from .transformer import decode_shardings
+
+            params = jax.device_put(params, decode_shardings(cfg, mesh))
+        self.params = params
         # N-gram speculative decoding (models/speculative.py): verify K
         # prompt-lookup drafts per step in one (K+1)-position forward.
         # Greedy outputs stay bit-exact; 0 disables.
@@ -206,6 +216,12 @@ class GenerationEngine:
         self.cache_k = jnp.zeros((L, self.slots, self.max_seq, KH, Dh),
                                  cfg.dtype)
         self.cache_v = jnp.zeros_like(self.cache_k)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ns = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+            self.cache_k = jax.device_put(self.cache_k, ns)
+            self.cache_v = jax.device_put(self.cache_v, ns)
 
     # ---- public API ----
 

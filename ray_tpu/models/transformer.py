@@ -118,6 +118,32 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
     }
 
 
+def decode_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
+    """Megatron tp layout for DECODE/serving: generation runs as one fused
+    program, so there is no pipeline axis — layer-stacked arrays shard
+    over tp only and replicate elsewhere. Used by the generation engines
+    to serve a model bigger than one chip (GSPMD inserts the collectives;
+    the KV cache shards on the kv-head axis with the same tp split)."""
+    tp = mesh.shape.get("tp", 1)
+    if cfg.n_kv_heads % max(tp, 1) or cfg.n_heads % max(tp, 1):
+        raise ValueError(
+            f"tp ({tp}) must divide both n_heads ({cfg.n_heads}) and "
+            f"n_kv_heads ({cfg.n_kv_heads}) for sharded decode")
+
+    def strip_pp(spec: P) -> P:
+        return P(*[None if axis == "pp" else axis for axis in spec])
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": ns("tp", None),
+        "layers": {k: NamedSharding(mesh, strip_pp(v))
+                   for k, v in _LAYER_PSPECS.items()},
+        "final_norm": ns(None),
+    }
+
+
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     # Fused pallas kernel on TPU, XLA reference elsewhere (ops/fused.py).
     return rms_norm(x, weight.astype(x.dtype), eps)

@@ -58,8 +58,12 @@ class LMBackend:
                  stream_idle_timeout_s: float = 120.0,
                  paged: bool = False, page_size: int = 128,
                  num_pages: Optional[int] = None,
-                 speculative_k: int = 0):
+                 speculative_k: int = 0, tp: int = 1):
         if paged:
+            if tp > 1:
+                raise ValueError(
+                    "tp > 1 requires the contiguous engine (paged=False): "
+                    "the paged engine has no sharded cache layout yet")
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
             # FIFO on page budget. Same outputs; speculation verifies
@@ -74,10 +78,25 @@ class LMBackend:
             from ..models.engine import GenerationEngine
 
             # speculative_k > 0: n-gram speculative decoding (exact for
-            # greedy requests; see models/speculative.py).
+            # greedy requests; see models/speculative.py). tp > 1: serve
+            # a model bigger than one chip — Megatron decode layout over
+            # this replica's first tp local devices.
+            mesh = None
+            if tp > 1:
+                import jax
+                import numpy as _np
+                from jax.sharding import Mesh
+
+                # local_devices, not devices: in multi-process jax the
+                # global list contains non-addressable remote devices.
+                devs = jax.local_devices()
+                if len(devs) < tp:
+                    raise ValueError(
+                        f"tp={tp} but only {len(devs)} local devices")
+                mesh = Mesh(_np.array(devs[:tp]).reshape(tp), ("tp",))
             self.engine = GenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
-                max_seq=max_seq, speculative_k=speculative_k)
+                max_seq=max_seq, speculative_k=speculative_k, mesh=mesh)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
         # RLock: stream_poll -> _expire_idle_streams -> stream_cancel

@@ -155,6 +155,9 @@ def bench_decode(*, batch: int, seq: int, new_tokens: int, cfg=None):
         spec_wall = engine_wall(
             GenerationEngine(params, cfg, max_slots=batch, max_seq=seq,
                              speculative_k=4))
+        rep_paged_wall = engine_wall(
+            PagedGenerationEngine(params, cfg, max_slots=batch,
+                                  max_seq=seq))
         spec_paged_wall = engine_wall(
             PagedGenerationEngine(params, cfg, max_slots=batch,
                                   max_seq=seq, speculative_k=4))
@@ -172,6 +175,8 @@ def bench_decode(*, batch: int, seq: int, new_tokens: int, cfg=None):
         "speculative_speedup_repetitive": round(rep_wall / spec_wall, 2),
         "speculative_paged_tokens_per_sec": round(
             total / spec_paged_wall, 1),
+        "speculative_paged_speedup_repetitive": round(
+            rep_paged_wall / spec_paged_wall, 2),
     }
 
 
