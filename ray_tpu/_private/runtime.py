@@ -148,6 +148,17 @@ class LocalActor:
             target=self._run, name=f"actor-{actor_id.hex()[:8]}", daemon=True
         )
 
+    def _died_error(self) -> "ActorDiedError":
+        """Death error that names the CAUSE when the constructor failed —
+        a bare "died unexpectedly" sent callers hunting through logs."""
+        if self.creation_error is not None:
+            return ActorDiedError(
+                self.actor_id,
+                f"actor constructor failed: "
+                f"{type(self.creation_error).__name__}: "
+                f"{self.creation_error}")
+        return ActorDiedError(self.actor_id)
+
     def start(self, creation_spec: TaskSpec, cls: type, args, kwargs):
         self._creation = (creation_spec, cls, args, kwargs)
         self.restarts_left = creation_spec.max_restarts
@@ -156,7 +167,7 @@ class LocalActor:
     def submit(self, seq_no: int, spec: TaskSpec):
         with self.cv:
             if self.dead:
-                self._fail_spec(spec, ActorDiedError(self.actor_id))
+                self._fail_spec(spec, self._died_error())
                 return
             if seq_no == self.next_seq:
                 self.queue.append((seq_no, spec))
@@ -188,7 +199,7 @@ class LocalActor:
             self.pending_out_of_order.clear()
             self.cv.notify_all()
         for spec in pending:
-            self._fail_spec(spec, ActorDiedError(self.actor_id))
+            self._fail_spec(spec, self._died_error())
         self._wake_loop()
         if (no_restart or already_dead or self.creation_error is not None
                 or self.restarts_left == 0):
@@ -247,6 +258,15 @@ class LocalActor:
             self.runtime.store.put(creation_spec.return_ids()[0], StoredObject(error=err))
             with self.cv:
                 self.dead = True
+                # Calls submitted between thread start and this failure sit
+                # in the queue; abandoning them would hang their callers
+                # forever (observed: serve master blocked on ready()).
+                pending = [spec for _, spec in self.queue]
+                pending.extend(self.pending_out_of_order.values())
+                self.queue.clear()
+                self.pending_out_of_order.clear()
+            for spec in pending:
+                self._fail_spec(spec, self._died_error())
             self.created.set()
             # Release lifetime resources reserved in create_actor, else a
             # failed constructor permanently leaks them.

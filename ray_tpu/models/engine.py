@@ -204,6 +204,9 @@ class GenerationEngine:
         self.queue: List[_Request] = []
         self.done: Dict[int, List[int]] = {}
         self._next_id = 0
+        # Speculation telemetry: acceptance rate = accepted / drafted.
+        self.spec_stats = {"ticks": 0, "drafted": 0, "accepted": 0,
+                           "emitted": 0}
 
     def _alloc_cache(self) -> None:
         """Materialise the KV store on device. A hook so subclasses with a
@@ -371,6 +374,7 @@ class GenerationEngine:
                 d = req.ng.propose(room)
                 dlen[slot] = len(d)
                 drafts[slot, :len(d)] = d
+        self.spec_stats["ticks"] += 1
         width = K + 1 if dlen.any() else 1
         if width == 1 and self._spec_plain_when_draftless:
             # Paged engine: a width-1 verify would gather the FULL page
@@ -392,12 +396,13 @@ class GenerationEngine:
         for slot, req in enumerate(self.active):
             if req is None:
                 continue
-            if slot in row_of:
-                emitted = [req.pick(rows[row_of[slot]])]
-            else:
+            greedy_slot = slot not in row_of
+            if greedy_slot:
                 a = longest_accept(drafts[slot], int(dlen[slot]),
                                    greedy[slot])
                 emitted = [int(t) for t in greedy[slot, :a + 1]]
+            else:
+                emitted = [req.pick(rows[row_of[slot]])]
             # Truncate at max_new_tokens / EOS (either finishes the slot).
             out_tokens: List[int] = []
             finished = False
@@ -407,6 +412,13 @@ class GenerationEngine:
                         or (self.eos_id is not None and t == self.eos_id)):
                     finished = True
                     break
+            if greedy_slot:
+                # Telemetry AFTER truncation: EOS/max_new-discarded tokens
+                # must not inflate the acceptance-rate canary signal.
+                st = self.spec_stats
+                st["drafted"] += int(dlen[slot])
+                st["accepted"] += min(a, len(out_tokens) - 1)
+                st["emitted"] += len(out_tokens)
             req.out.extend(out_tokens)
             if req.ng is not None:
                 req.ng.extend(out_tokens)

@@ -124,11 +124,13 @@ def decode_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
     over tp only and replicate elsewhere. Used by the generation engines
     to serve a model bigger than one chip (GSPMD inserts the collectives;
     the KV cache shards on the kv-head axis with the same tp split)."""
-    tp = mesh.shape.get("tp", 1)
-    if cfg.n_kv_heads % max(tp, 1) or cfg.n_heads % max(tp, 1):
+    tp = max(mesh.shape.get("tp", 1), 1)
+    if (cfg.n_kv_heads % tp or cfg.n_heads % tp or cfg.d_ff % tp
+            or cfg.vocab_size % tp):
         raise ValueError(
-            f"tp ({tp}) must divide both n_heads ({cfg.n_heads}) and "
-            f"n_kv_heads ({cfg.n_kv_heads}) for sharded decode")
+            f"tp ({tp}) must divide n_heads ({cfg.n_heads}), n_kv_heads "
+            f"({cfg.n_kv_heads}), d_ff ({cfg.d_ff}) and vocab_size "
+            f"({cfg.vocab_size}) for sharded decode")
 
     def strip_pp(spec: P) -> P:
         return P(*[None if axis == "pp" else axis for axis in spec])

@@ -269,6 +269,23 @@ class LMBackend:
                 self._drop_stream(token, rid)
             return {"tokens": out, "done": done}
 
+    def stats(self) -> dict:
+        """Engine/speculation telemetry for dashboards and canarying:
+        call via ``handle.options(method="stats").remote()``."""
+        with self._cond:
+            eng = self.engine
+            st = dict(eng.spec_stats)
+            if st["drafted"]:
+                st["acceptance_rate"] = round(
+                    st["accepted"] / st["drafted"], 3)
+            return {
+                "slots": eng.slots,
+                "active": sum(r is not None for r in eng.active),
+                "queued": len(eng.queue),
+                "streams": len(self._streams),
+                "speculative": st,
+            }
+
     def stream_cancel(self, token: str) -> bool:
         with self._cond:
             rid = self._streams.get(token)

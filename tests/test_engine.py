@@ -408,3 +408,28 @@ def test_tp_sharded_engine_matches_unsharded():
                             speculative_k=3)
     r3 = spec.submit(prompt, 8)
     assert spec.run_until_done()[r3] == ref
+
+
+def test_lm_backend_tp_behind_serve(local_ray):
+    """serve-level e2e on a tp=2 mesh (virtual CPU devices): exact
+    continuations + speculation telemetry via the stats method."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    serve.init()
+    try:
+        serve.create_backend(
+            "lm:tp", LMBackend, params, cfg, tp=2, speculative_k=3,
+            config=BackendConfig(max_concurrent_queries=8))
+        serve.create_endpoint("gen_tp", backend="lm:tp")
+        h = serve.get_handle("gen_tp")
+        prompt = [5, 6, 7, 5, 6, 7, 5]
+        out = ray_tpu.get(h.remote(prompt, max_new_tokens=6), timeout=300)
+        assert out == _ref(params, cfg, prompt, 6)
+        st = ray_tpu.get(h.options(method="stats").remote(), timeout=60)
+        assert st["slots"] == 8 and st["speculative"]["ticks"] > 0
+    finally:
+        serve.shutdown()

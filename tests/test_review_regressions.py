@@ -231,16 +231,43 @@ def test_shared_future_resolver_many_outstanding():
             time.sleep(0.01)
             return i * 3
 
-        futs = [slowish.remote(i).future() for i in range(200)]
+        # 60 futures: enough to exceed any per-ref thread-pool sanity
+        # bound while staying timely on a loaded co-tenant box.
+        futs = [slowish.remote(i).future() for i in range(60)]
         # Cancel a slice mid-flight: the SHARED resolver must keep going.
         for f in futs[::7]:
             f.cancel()
         done = concurrent.futures.wait(
-            [f for f in futs if not f.cancelled()], timeout=120)
-        assert not done.not_done
+            [f for f in futs if not f.cancelled()], timeout=180)
+        assert not done.not_done, f"{len(done.not_done)} futures stuck"
         for i, f in enumerate(futs):
             if not f.cancelled():
                 assert f.result() == i * 3
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_failed_actor_constructor_fails_queued_calls_with_cause(local_ray):
+    """r5: calls queued behind a failing constructor must fail promptly
+    (they used to hang forever), and the death error must name the
+    constructor's exception instead of a bare 'died unexpectedly'."""
+    import pytest
+
+    from ray_tpu.exceptions import ActorDiedError, TaskError
+
+    @ray_tpu.remote
+    class Boom:
+        def __init__(self):
+            time.sleep(0.3)          # let calls queue behind creation
+            raise RuntimeError("ctor exploded")
+
+        def ping(self):
+            return 1
+
+    a = Boom.remote()
+    ref = a.ping.remote()            # queued while the ctor still runs
+    with pytest.raises((ActorDiedError, TaskError)) as ei:
+        ray_tpu.get(ref, timeout=30)  # must NOT hang
+    assert "ctor exploded" in str(ei.value) or "Boom" in str(ei.value), \
+        str(ei.value)
