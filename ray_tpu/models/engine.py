@@ -129,6 +129,62 @@ def _prefill_into_slot(params: Params, tokens: jax.Array,
     return logits, new_k, new_v
 
 
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache_k", "cache_v"))
+def _prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
+                   slot: jax.Array, last_idx: jax.Array,
+                   cache_k: jax.Array, cache_v: jax.Array,
+                   cfg: TransformerConfig):
+    """One CHUNK of a long prompt: tokens [1, C] at positions
+    start..start+C-1 of `slot` -> logits [V] at in-chunk row
+    ``last_idx`` (meaningful on the final chunk), chunk K/V written into
+    the slot's cache rows in place (donated pools). Position i attends
+    cache rows 0..start+i — previous chunks' rows plus the in-chunk
+    causal prefix — so a T-token prompt costs O(T*S) attention across
+    ceil(T/C) calls of ONE compiled program, instead of the bucketed
+    path's O(T^2) single program with a [T, T] mask (prohibitive memory
+    at long context). Pad rows in the final chunk hold garbage beyond the
+    real length — the same overwrite-before-attend invariant as bucketed
+    prefill covers them."""
+    _, C = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    S = cache_k.shape[2]
+    x = params["embed"].astype(dt)[tokens]                      # [1, C, E]
+    positions = start + jnp.arange(C)
+    attend = (jnp.arange(S)[None, :] <= positions[:, None])     # [C, S]
+
+    def block(x, xs):
+        layer, ck, cv = xs                              # ck [slots, S, KH, Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope((h @ layer["wq"].astype(dt)).reshape(1, C, H, Dh),
+                  positions, cfg.rope_theta)
+        k = _rope((h @ layer["wk"].astype(dt)).reshape(1, C, KH, Dh),
+                  positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(1, C, KH, Dh)
+        ck = jax.lax.dynamic_update_slice(ck, k, (slot, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (slot, start, 0, 0))
+        my_k = jax.lax.dynamic_slice_in_dim(ck, slot, 1, axis=0)
+        my_v = jax.lax.dynamic_slice_in_dim(cv, slot, 1, axis=0)
+        attn = masked_gqa_attention(q, my_k, my_v, attend).reshape(
+            1, C, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache_k, cache_v))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # Single-row lm head: only the final chunk's real-last row is ever
+    # consumed — projecting all C rows against [E, V] per chunk would
+    # waste the dominant share of prefill FLOPs at real vocab sizes.
+    last = jax.lax.dynamic_index_in_dim(x[0], last_idx, axis=0,
+                                        keepdims=False)         # [E]
+    logits = last @ params["embed"].astype(dt).T                # [V]
+    return logits, new_k, new_v
+
+
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "out", "temperature",
                  "rng", "ng")
@@ -174,7 +230,8 @@ class GenerationEngine:
                  max_slots: int = 4, max_seq: Optional[int] = None,
                  eos_id: Optional[int] = None, speculative_k: int = 0,
                  speculative_ngram: int = 2,
-                 mesh: Optional["jax.sharding.Mesh"] = None):
+                 mesh: Optional["jax.sharding.Mesh"] = None,
+                 prefill_chunk: int = 0):
         self.cfg = cfg
         self.slots = max_slots
         self.max_seq = max_seq or cfg.max_seq_len
@@ -197,6 +254,18 @@ class GenerationEngine:
         # Subclass knob: run draft-less spec ticks through _decode_all
         # (flash kernel) instead of a width-1 verify chunk.
         self._spec_plain_when_draftless = False
+        # Long-context prefill: prompts longer than this process in
+        # fixed chunks (one compiled program, O(T*S) attention) instead
+        # of one power-of-2 bucket (O(T^2) mask memory). 0 = bucketed
+        # only, the right choice for short-prompt serving.
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk and self.max_seq % self.prefill_chunk:
+            # A final chunk crossing max_seq would have its cache write
+            # CLAMPED by dynamic_update_slice — silently shifted onto
+            # earlier rows, corrupting real prompt K/V.
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) must divide "
+                f"max_seq ({self.max_seq})")
         self._alloc_cache()
         self.lengths = np.zeros(max_slots, np.int32)
         self.tokens = np.zeros(max_slots, np.int32)   # last token per slot
@@ -482,17 +551,32 @@ class GenerationEngine:
         return events
 
     def _prefill_slot(self, slot: int, req: _Request) -> bool:
-        """Bucketed in-place prefill of this slot's cache region; the first
+        """In-place prefill of this slot's cache region; the first
         generated token comes from the real-last-position logits. Returns
-        True if the request finished at prefill (one token or EOS)."""
+        True if the request finished at prefill (one token or EOS).
+        Prompts longer than ``prefill_chunk`` (when set) stream through
+        the chunked program; shorter ones take the pow-2 bucket path."""
         T0 = len(req.prompt)
-        bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
-        padded = req.prompt + [0] * (bucket - T0)
-        tokens = jnp.asarray(padded, jnp.int32)[None]           # [1, Tb]
-        logits, self.cache_k, self.cache_v = _prefill_into_slot(
-            self.params, tokens, jnp.asarray(T0, jnp.int32),
-            jnp.asarray(slot, jnp.int32), self.cache_k, self.cache_v,
-            self.cfg)
+        C = self.prefill_chunk
+        if C and T0 > C:
+            logits = None
+            for s0 in range(0, T0, C):
+                chunk = req.prompt[s0:s0 + C]
+                chunk = chunk + [0] * (C - len(chunk))
+                logits, self.cache_k, self.cache_v = _prefill_chunk(
+                    self.params, jnp.asarray(chunk, jnp.int32)[None],
+                    jnp.asarray(s0, jnp.int32),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray((T0 - 1) % C, jnp.int32),
+                    self.cache_k, self.cache_v, self.cfg)
+        else:
+            bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
+            padded = req.prompt + [0] * (bucket - T0)
+            tokens = jnp.asarray(padded, jnp.int32)[None]       # [1, Tb]
+            logits, self.cache_k, self.cache_v = _prefill_into_slot(
+                self.params, tokens, jnp.asarray(T0, jnp.int32),
+                jnp.asarray(slot, jnp.int32), self.cache_k, self.cache_v,
+                self.cfg)
         first = req.pick(np.asarray(logits))
         req.out.append(first)
         # Next decode for this slot attends from `first` at position T0.

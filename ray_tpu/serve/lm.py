@@ -58,12 +58,17 @@ class LMBackend:
                  stream_idle_timeout_s: float = 120.0,
                  paged: bool = False, page_size: int = 128,
                  num_pages: Optional[int] = None,
-                 speculative_k: int = 0, tp: int = 1):
+                 speculative_k: int = 0, tp: int = 1,
+                 prefill_chunk: int = 0):
         if paged:
             if tp > 1:
                 raise ValueError(
                     "tp > 1 requires the contiguous engine (paged=False): "
                     "the paged engine has no sharded cache layout yet")
+            if prefill_chunk:
+                raise ValueError(
+                    "prefill_chunk requires the contiguous engine "
+                    "(paged=False): paged prefill is bucketed-only")
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
             # FIFO on page budget. Same outputs; speculation verifies
@@ -96,7 +101,8 @@ class LMBackend:
                 mesh = Mesh(_np.array(devs[:tp]).reshape(tp), ("tp",))
             self.engine = GenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
-                max_seq=max_seq, speculative_k=speculative_k, mesh=mesh)
+                max_seq=max_seq, speculative_k=speculative_k, mesh=mesh,
+                prefill_chunk=prefill_chunk)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
         # RLock: stream_poll -> _expire_idle_streams -> stream_cancel
