@@ -73,6 +73,23 @@ def main(smoke: bool = False):
     print(f"streamed token-by-token: {streamed}")
     stats = serve.stat()
     print("endpoint metrics:", stats["metrics"]["endpoints"]["generate"])
+
+    # Speculative decoding (n-gram prompt lookup): a second backend with
+    # speculative_k — repetitive prompts accept drafts, outputs stay
+    # exactly equal to plain greedy decode; acceptance telemetry via the
+    # backend's stats method.
+    serve.create_backend("lm:spec", LMBackend, params, cfg,
+                         speculative_k=4,
+                         config=BackendConfig(max_concurrent_queries=8))
+    serve.create_endpoint("generate_spec", backend="lm:spec")
+    hs = serve.get_handle("generate_spec")
+    rep = [3, 4, 5, 3, 4, 5, 3, 4]
+    spec_out = ray_tpu.get(hs.remote(rep, max_new_tokens=10), timeout=600)
+    exp = np.asarray(generate(params, jnp.asarray([rep], jnp.int32), cfg,
+                              max_new_tokens=10))[0].tolist()
+    assert spec_out == exp, (spec_out, exp)
+    st = ray_tpu.get(hs.options(method="stats").remote(), timeout=60)
+    print(f"speculative: {spec_out}  telemetry: {st['speculative']}")
     serve.shutdown()
     return outs
 

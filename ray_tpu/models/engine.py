@@ -145,7 +145,14 @@ def _prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
     path's O(T^2) single program with a [T, T] mask (prohibitive memory
     at long context). Pad rows in the final chunk hold garbage beyond the
     real length — the same overwrite-before-attend invariant as bucketed
-    prefill covers them."""
+    prefill covers them.
+
+    NOTE: the block body is the third copy of the layer math (with
+    _prefill_into_slot and _batched_decode) — they differ in cache
+    write/attend plumbing, and the exactness tests
+    (test_chunked_prefill_exact_long_prompt and the engine-vs-generate
+    suites) pin all three to generate(); touch the layer math in one,
+    touch it in all."""
     _, C = tokens.shape
     H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -259,6 +266,9 @@ class GenerationEngine:
         # of one power-of-2 bucket (O(T^2) mask memory). 0 = bucketed
         # only, the right choice for short-prompt serving.
         self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
         if self.prefill_chunk and self.max_seq % self.prefill_chunk:
             # A final chunk crossing max_seq would have its cache write
             # CLAMPED by dynamic_update_slice — silently shifted onto
