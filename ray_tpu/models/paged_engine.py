@@ -160,6 +160,62 @@ def _paged_verify(params: Params, tokens: jax.Array, lengths: jax.Array,
 
 @partial(jax.jit, static_argnames=("cfg",),
          donate_argnames=("k_pages", "v_pages"))
+def _paged_prefill_chunk(params: Params, tokens: jax.Array,
+                         start: jax.Array, last_idx: jax.Array,
+                         rows: jax.Array, table_row: jax.Array,
+                         k_pages: jax.Array, v_pages: jax.Array,
+                         cfg: TransformerConfig):
+    """One CHUNK of a long prompt through page indirection: tokens [1, C]
+    at positions start..start+C-1 -> logits [V] at in-chunk row
+    ``last_idx``. Chunk K/V scatter to pool rows ``rows`` [C]
+    (shared-prefix and pad positions route to the scratch page — their
+    valid K/V already live in shared pages / are never attended); each
+    position attends the slot's gathered pool at cols 0..start+i, which
+    covers previous chunks AND shared prefix pages — so fully-shared
+    chunks can be SKIPPED entirely by the caller (prefix-cache COMPUTE
+    reuse, not just memory reuse). O(C * P*ps) per chunk, one compiled
+    program for any prompt length."""
+    _, C = tokens.shape
+    H, KH, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pages.shape[2]
+    P = table_row.shape[0]
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]                      # [1, C, E]
+    positions = start + jnp.arange(C)
+    attend = (jnp.arange(P * ps)[None, :]
+              <= positions[:, None])                            # [C, P*ps]
+
+    def block(x, xs):
+        layer, kp, vp = xs                    # kp [num_pages, ps, KH, Dh]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = _rope((h @ layer["wq"].astype(dt)).reshape(1, C, H, Dh),
+                  positions, cfg.rope_theta)
+        k = _rope((h @ layer["wk"].astype(dt)).reshape(1, C, KH, Dh),
+                  positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(1, C, KH, Dh)
+        shape = kp.shape
+        kp = kp.reshape(-1, KH, Dh).at[rows].set(k[0]).reshape(shape)
+        vp = vp.reshape(-1, KH, Dh).at[rows].set(v[0]).reshape(shape)
+        buf_k = paged_gather(kp, table_row[None])   # [1, P*ps, KH, Dh]
+        buf_v = paged_gather(vp, table_row[None])
+        attn = masked_gqa_attention(q, buf_k, buf_v, attend).reshape(
+            1, C, H * Dh)
+        h2 = x + attn @ layer["wo"].astype(dt)
+        out = h2 + _mlp(_rms_norm(h2, layer["mlp_norm"], cfg.norm_eps),
+                        layer, cfg)
+        return out, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], last_idx, axis=0,
+                                        keepdims=False)
+    logits = last @ params["embed"].astype(dt).T                # [V]
+    return logits, new_k, new_v
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("k_pages", "v_pages"))
 def _paged_prefill(params: Params, tokens: jax.Array, real_len: jax.Array,
                    rows: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                    cfg: TransformerConfig):
@@ -215,10 +271,11 @@ class PagedGenerationEngine(GenerationEngine):
                  max_slots: int = 4, max_seq: Optional[int] = None,
                  eos_id: Optional[int] = None, page_size: int = 128,
                  num_pages: Optional[int] = None, speculative_k: int = 0,
-                 speculative_ngram: int = 2):
+                 speculative_ngram: int = 2, prefill_chunk: int = 0):
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
                          eos_id=eos_id, speculative_k=speculative_k,
-                         speculative_ngram=speculative_ngram)
+                         speculative_ngram=speculative_ngram,
+                         prefill_chunk=prefill_chunk)
         L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         self.page_size = ps = page_size
         self.pages_per_slot = -(-self.max_seq // ps)
@@ -328,8 +385,8 @@ class PagedGenerationEngine(GenerationEngine):
 
     def _prefill_slot(self, slot: int, req: _Request) -> bool:
         T0 = len(req.prompt)
-        bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
-        padded = req.prompt + [0] * (bucket - T0)
+        C = self.prefill_chunk
+        chunked = bool(C and T0 > C)
         self.pool.free(slot)  # defensive: slot ids are reused as seq ids
         # Prefix reuse: join the longest cached run of immutable prompt
         # blocks (their K/V is already resident — same tokens at the same
@@ -345,23 +402,50 @@ class PagedGenerationEngine(GenerationEngine):
         self._tables[slot] = -1
         self._tables[slot, :len(pages)] = pages
         ps = self.page_size
-        # Global pool rows for every bucket position; pad positions beyond
+        # Layout width: pow-2 bucket, or the chunk SPAN ceil(T0/C)*C —
+        # which can exceed the bucket when T0 is itself a power of two.
+        bucket = min(1 << (T0 - 1).bit_length(), self.max_seq)
+        width = -(-T0 // C) * C if chunked else bucket
+        # Global pool rows for every layout position; pad positions beyond
         # the owned range AND shared-prefix positions land on scratch page
         # 0: a shared page is immutable (another live sequence may be
         # attending to it mid-decode), and this prefill's recomputed rows
         # could differ in low bits when the original was compiled at a
-        # different bucket length.
-        logical = np.arange(bucket)
+        # different bucket length. ONE copy of this routing — it is the
+        # shared-page-immutability safety logic.
+        logical = np.arange(width)
         page_idx = logical // ps
         writable = (page_idx < len(pages)) & (page_idx >= len(shared))
         rows = np.where(writable,
                         pages[np.minimum(page_idx, len(pages) - 1)] * ps
                         + logical % ps,
                         logical % ps)  # scratch page 0
-        logits, self.k_pages, self.v_pages = _paged_prefill(
-            self.params, jnp.asarray(padded, jnp.int32)[None],
-            jnp.asarray(T0, jnp.int32), jnp.asarray(rows, jnp.int32),
-            self.k_pages, self.v_pages, self.cfg)
+        if chunked:
+            # Chunked long-context prefill. Chunks lying entirely inside
+            # the shared-prefix region are SKIPPED: their K/V already
+            # live in shared pages, and no later computation reads their
+            # hidden states — prefix-cache COMPUTE reuse.
+            shared_rows = len(shared) * ps
+            table_row = jnp.asarray(self._tables[slot])
+            logits = None
+            for s0 in range(0, T0, C):
+                is_final = s0 + C >= T0
+                if not is_final and s0 + C <= shared_rows:
+                    continue
+                chunk = req.prompt[s0:s0 + C]
+                chunk = chunk + [0] * (C - len(chunk))
+                logits, self.k_pages, self.v_pages = _paged_prefill_chunk(
+                    self.params, jnp.asarray(chunk, jnp.int32)[None],
+                    jnp.asarray(s0, jnp.int32),
+                    jnp.asarray((T0 - 1) % C, jnp.int32),
+                    jnp.asarray(rows[s0:s0 + C], jnp.int32),
+                    table_row, self.k_pages, self.v_pages, self.cfg)
+        else:
+            padded = req.prompt + [0] * (bucket - T0)
+            logits, self.k_pages, self.v_pages = _paged_prefill(
+                self.params, jnp.asarray(padded, jnp.int32)[None],
+                jnp.asarray(T0, jnp.int32), jnp.asarray(rows, jnp.int32),
+                self.k_pages, self.v_pages, self.cfg)
         # The blocks this prefill just wrote are now resident + immutable:
         # publish them so later prompts with the same head reuse the pages.
         for j in range(len(shared), len(keys)):

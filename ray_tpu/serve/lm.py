@@ -65,10 +65,6 @@ class LMBackend:
                 raise ValueError(
                     "tp > 1 requires the contiguous engine (paged=False): "
                     "the paged engine has no sharded cache layout yet")
-            if prefill_chunk:
-                raise ValueError(
-                    "prefill_chunk requires the contiguous engine "
-                    "(paged=False): paged prefill is bucketed-only")
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
             # FIFO on page budget. Same outputs; speculation verifies
@@ -78,7 +74,8 @@ class LMBackend:
             self.engine = PagedGenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
                 max_seq=max_seq, page_size=page_size, num_pages=num_pages,
-                speculative_k=speculative_k)
+                speculative_k=speculative_k,
+                prefill_chunk=prefill_chunk)
         else:
             from ..models.engine import GenerationEngine
 

@@ -409,3 +409,56 @@ def test_paged_engine_own_prefix_hits_not_counted_as_evictable():
     assert any(r is not None and r.req_id == rb for r in eng.active) is False
     out = eng.run_until_done()   # live finishes -> B admits and completes
     assert out[rb] == _gen(params, cfg, prompt16, 24)
+
+
+def test_paged_chunked_prefill_exact_and_prefix_skip():
+    """Chunked long-context prefill through page tables (r5): exact vs
+    generate() for crossing/exact/straddling lengths, and a same-prefix
+    follow-up SKIPS fully-shared chunks (compute reuse) while still
+    producing the exact continuation."""
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models import paged_engine as pe
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(5)
+    for T0 in (65, 128, 180):
+        prompt = rng.integers(1, 60, size=T0).tolist()
+        ref = _gen(params, cfg, prompt, 6)
+        eng = PagedGenerationEngine(params, cfg, max_slots=2, page_size=16,
+                                    prefill_chunk=64)
+        rid = eng.submit(prompt, 6)
+        assert eng.run_until_done()[rid] == ref, T0
+
+    # Prefix-skip: same long prompt twice; count chunk program calls.
+    prompt = (list(range(1, 17)) * 12)[:160]   # 160 tokens, 10 pages of 16
+    ref = _gen(params, cfg, prompt, 6)
+    eng = PagedGenerationEngine(params, cfg, max_slots=2, page_size=16,
+                                prefill_chunk=64)
+    calls = []
+    orig = pe._paged_prefill_chunk
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    pe._paged_prefill_chunk = counting
+    try:
+        r1 = eng.submit(prompt, 6)
+        out1 = eng.run_until_done()[r1]
+        first_calls = len(calls)
+        calls.clear()
+        r2 = eng.submit(prompt, 6)
+        out2 = eng.run_until_done()[r2]
+        second_calls = len(calls)
+    finally:
+        pe._paged_prefill_chunk = orig
+    assert out1 == ref and out2 == ref
+    assert first_calls == 3                    # ceil(160/64) chunks
+    # 160 prompt tokens -> blocks 0..9 immutable; chunks 0-1 (rows
+    # 0..127) fully shared on the second request -> only the final
+    # chunk runs.
+    assert second_calls == 1, second_calls
