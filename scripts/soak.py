@@ -223,6 +223,71 @@ def serve_failure(duration_s: float) -> int:
                  setup=setup, teardown=teardown)
 
 
+def lm_serve(duration_s: float) -> int:
+    """The full LM serving stack under sustained mixed load: whole-
+    response + streamed + sampled requests against a speculative paged
+    engine with prefix caching and chunked prefill — every round-5
+    serving feature in one loop, outputs pinned exact each iteration."""
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.generate import generate
+    from ray_tpu.serve import BackendConfig, LMBackend
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def ref(prompt, n):
+        return np.asarray(generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+            max_new_tokens=n))[0].tolist()
+
+    def setup():
+        serve.init()
+        serve.create_backend(
+            "soak:lm", LMBackend, params, cfg,
+            paged=True, page_size=16, speculative_k=3, prefill_chunk=32,
+            config=BackendConfig(max_concurrent_queries=16,
+                                 replica_concurrency=4))
+        serve.create_endpoint("soak_lm", backend="soak:lm")
+        h = serve.get_handle("soak_lm")
+        shared = [(i % 50) + 1 for i in range(48)]   # prefix-cache fodder
+        return {"handle": h, "shared": shared,
+                "refs": {}, "expected": {}}
+
+    def body(state, i):
+        h, shared = state["handle"], state["shared"]
+        # whole-response batch over a shared prefix (prefix cache +
+        # chunked prefill + speculation all engage)
+        prompts = [shared + [(i + j) % 50 + 1] for j in range(3)]
+        outs = ray_tpu.get(
+            [h.remote(p, max_new_tokens=6) for p in prompts], timeout=120)
+        for p, out in zip(prompts, outs):
+            exp = state["expected"].setdefault(tuple(p), ref(p, 6))
+            assert out == exp, (p, out, exp)
+        # one streamed request, pinned vs whole-response
+        sp = [7, 8, 9, (i % 40) + 1]
+        streamed = list(h.stream(sp, max_new_tokens=5))
+        exp = state["expected"].setdefault(tuple(sp) + ("s",), ref(sp, 5))
+        assert streamed == exp, (sp, streamed, exp)
+        # one seeded sampled request, reproducible across iterations
+        samp = ray_tpu.get(h.remote([5, 6], max_new_tokens=5,
+                                    temperature=0.8, seed=11), timeout=120)
+        prev = state["expected"].setdefault("samp", samp)
+        assert samp == prev
+
+    def teardown(state):
+        serve.shutdown()
+
+    return _loop("lm_serve", duration_s, body,
+                 setup=setup, teardown=teardown)
+
+
 def pbt(duration_s: float) -> int:
     """Repeated short PBT runs (reference workloads/pbt.py)."""
     import tempfile
@@ -261,6 +326,7 @@ WORKLOADS = {
     "actor_deaths": actor_deaths,
     "node_failures": node_failures,
     "serve_failure": serve_failure,
+    "lm_serve": lm_serve,
     "pbt": pbt,
 }
 # Workloads that own their cluster; a leftover local-mode runtime would
