@@ -58,8 +58,8 @@ class LMBackend:
                  stream_idle_timeout_s: float = 120.0,
                  paged: bool = False, page_size: int = 128,
                  num_pages: Optional[int] = None,
-                 speculative_k: int = 0, tp: int = 1,
-                 prefill_chunk: int = 0):
+                 speculative_k: int = 0, speculative_ngram: int = 2,
+                 tp: int = 1, prefill_chunk: int = 0):
         if paged:
             if tp > 1:
                 raise ValueError(
@@ -75,6 +75,7 @@ class LMBackend:
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
                 max_seq=max_seq, page_size=page_size, num_pages=num_pages,
                 speculative_k=speculative_k,
+                speculative_ngram=speculative_ngram,
                 prefill_chunk=prefill_chunk)
         else:
             from ..models.engine import GenerationEngine
@@ -98,7 +99,8 @@ class LMBackend:
                 mesh = Mesh(_np.array(devs[:tp]).reshape(tp), ("tp",))
             self.engine = GenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
-                max_seq=max_seq, speculative_k=speculative_k, mesh=mesh,
+                max_seq=max_seq, speculative_k=speculative_k,
+                speculative_ngram=speculative_ngram, mesh=mesh,
                 prefill_chunk=prefill_chunk)
         self.default_max_new_tokens = default_max_new_tokens
         self.stream_idle_timeout_s = stream_idle_timeout_s
