@@ -194,20 +194,35 @@ def _prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "out", "temperature",
-                 "rng", "ng")
+                 "rng", "ng", "stop")
 
     def __init__(self, req_id: int, prompt: List[int], max_new_tokens: int,
-                 temperature: float = 0.0, seed: Optional[int] = None):
+                 temperature: float = 0.0, seed: Optional[int] = None,
+                 stop: Optional[List[List[int]]] = None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.out: List[int] = []
+        self.stop = [list(sq) for sq in stop] if stop else []
         self.temperature = float(temperature)
         # Per-request stream: an explicit seed -> same sampled continuation
         # regardless of batch composition; no seed -> fresh OS entropy
         # (req_id would repeat identically across engine restarts).
         self.rng = np.random.default_rng(seed)
         self.ng = None   # lazy NgramIndex (speculative decoding)
+
+    def hit_stop(self, extra: Optional[List[int]] = None) -> bool:
+        """True when the output (plus tentative ``extra`` tokens) ends
+        with any stop sequence — stop tokens stay IN the output, like
+        EOS. Only the tail ever needs inspecting: copying the whole
+        output per emitted token would be O(n^2) over a generation."""
+        if not self.stop:
+            return False
+        longest = max(len(sq) for sq in self.stop)
+        out = self.out[-longest:] + extra if extra else self.out
+        n_real = len(self.out) + len(extra or [])
+        return any(n_real >= len(sq) and out[-len(sq):] == sq
+                   for sq in self.stop)
 
     def pick(self, logits_row: np.ndarray) -> int:
         """Greedy at temperature 0; softmax-sample otherwise (host-side,
@@ -308,7 +323,7 @@ class GenerationEngine:
     # ---- public API ----
 
     def validate(self, prompt: List[int], max_new_tokens: int,
-                 temperature: float = 0.0, seed=None) -> None:
+                 temperature: float = 0.0, seed=None, stop=None) -> None:
         """Raise ValueError if this request can never be served — callers
         submitting several requests atomically validate ALL first (submit
         raising mid-batch would orphan the already-queued batch-mates)."""
@@ -329,16 +344,29 @@ class GenerationEngine:
                 not isinstance(seed, (int, np.integer)) or seed < 0):
             raise ValueError(
                 f"seed must be a non-negative int, got {seed!r}")
+        for sq in (stop or []):
+            # isinstance list/tuple FIRST: a flat token list (stop=[220],
+            # the common API mistake) must raise the documented
+            # ValueError, not TypeError from iterating an int.
+            if (not isinstance(sq, (list, tuple)) or not sq
+                    or not all(isinstance(t, (int, np.integer))
+                               for t in sq)):
+                raise ValueError(
+                    f"stop sequences must be non-empty token-id lists "
+                    f"(e.g. stop=[[220]]), got {sq!r}")
 
     def submit(self, prompt: List[int], max_new_tokens: int,
-               temperature: float = 0.0, seed: Optional[int] = None) -> int:
+               temperature: float = 0.0, seed: Optional[int] = None,
+               stop: Optional[List[List[int]]] = None) -> int:
         """temperature 0 = greedy (bit-exact vs generate()); > 0 samples
         host-side from the same logits with a per-request PRNG (same seed
         -> same continuation; not bit-matched to generate()'s jax-PRNG
-        stream)."""
-        self.validate(prompt, max_new_tokens, temperature, seed)
+        stream). ``stop``: token-id sequences that end generation the
+        moment the output ends with one (stop tokens included, like
+        EOS)."""
+        self.validate(prompt, max_new_tokens, temperature, seed, stop)
         req = _Request(self._next_id, prompt, max_new_tokens,
-                       temperature=temperature, seed=seed)
+                       temperature=temperature, seed=seed, stop=stop)
         self._next_id += 1
         self.queue.append(req)
         return req.req_id
@@ -381,7 +409,8 @@ class GenerationEngine:
             self.lengths[slot] += 1
             self.tokens[slot] = token
             finished = (len(req.out) >= req.max_new_tokens
-                        or (self.eos_id is not None and token == self.eos_id))
+                        or (self.eos_id is not None and token == self.eos_id)
+                        or req.hit_stop())
             events.append((req.req_id, token, finished))
             if finished:
                 self.done[req.req_id] = req.out
@@ -488,7 +517,8 @@ class GenerationEngine:
             for t in emitted:
                 out_tokens.append(t)
                 if (len(req.out) + len(out_tokens) >= req.max_new_tokens
-                        or (self.eos_id is not None and t == self.eos_id)):
+                        or (self.eos_id is not None and t == self.eos_id)
+                        or req.hit_stop(out_tokens)):
                     finished = True
                     break
             if greedy_slot:
@@ -593,7 +623,8 @@ class GenerationEngine:
         self.lengths[slot] = T0
         self.tokens[slot] = first
         if (len(req.out) >= req.max_new_tokens
-                or (self.eos_id is not None and first == self.eos_id)):
+                or (self.eos_id is not None and first == self.eos_id)
+                or req.hit_stop()):
             self.done[req.req_id] = req.out
             self.lengths[slot] = 0
             return True

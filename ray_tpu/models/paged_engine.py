@@ -456,7 +456,8 @@ class PagedGenerationEngine(GenerationEngine):
         self.lengths[slot] = T0
         self.tokens[slot] = first
         if (len(req.out) >= req.max_new_tokens
-                or (self.eos_id is not None and first == self.eos_id)):
+                or (self.eos_id is not None and first == self.eos_id)
+                or req.hit_stop()):
             self.done[req.req_id] = req.out
             self._release_slot(slot)
             return True

@@ -129,7 +129,8 @@ class LMBackend:
                                  self.default_max_new_tokens))
         temperature = float(r.kwargs.get("temperature", 0.0))
         seed = r.kwargs.get("seed")
-        return prompt, n, temperature, seed
+        stop = r.kwargs.get("stop")
+        return prompt, n, temperature, seed, stop
 
     # -------------------------------------------------------------- pump
     def _ensure_pump(self) -> None:
@@ -192,10 +193,10 @@ class LMBackend:
             # not leave its batch-mates orphaned inside the engine (they
             # would keep decoding with no caller and leak into engine.done
             # forever).
-            for prompt, n, t, sd in parsed:
-                self.engine.validate(prompt, n, t, sd)
-            ids = [self.engine.submit(p, n, temperature=t, seed=s)
-                   for p, n, t, s in parsed]
+            for prompt, n, t, sd, stp in parsed:
+                self.engine.validate(prompt, n, t, sd, stp)
+            ids = [self.engine.submit(p, n, temperature=t, seed=s, stop=stp)
+                   for p, n, t, s, stp in parsed]
             self._ensure_pump()
             self._cond.notify_all()
             while not all(rid in self.engine.done or rid in self._failed
@@ -219,7 +220,8 @@ class LMBackend:
                 self.stream_cancel(token)
 
     def stream_start(self, prompt, max_new_tokens: Optional[int] = None,
-                     temperature: float = 0.0, seed=None) -> str:
+                     temperature: float = 0.0, seed=None,
+                     stop=None) -> str:
         import uuid
 
         prompt = list(prompt)
@@ -227,10 +229,10 @@ class LMBackend:
                 else self.default_max_new_tokens)
         with self._cond:
             self._expire_idle_streams()
-            self.engine.validate(prompt, n, float(temperature), seed)
+            self.engine.validate(prompt, n, float(temperature), seed, stop)
             rid = self.engine.submit(prompt, n,
                                      temperature=float(temperature),
-                                     seed=seed)
+                                     seed=seed, stop=stop)
             token = uuid.uuid4().hex
             self._streams[token] = rid
             self._stream_bufs[rid] = []

@@ -462,3 +462,56 @@ def test_chunked_prefill_exact_long_prompt():
     eng2 = GenerationEngine(params, cfg, max_slots=2, prefill_chunk=64)
     r2 = eng2.submit([4, 5, 6], 5)
     assert eng2.run_until_done()[r2] == _ref(params, cfg, [4, 5, 6], 5)
+
+
+def test_stop_sequences():
+    """stop= ends generation the moment the output ends with any stop
+    sequence (stop tokens included, like EOS) — on the plain path, under
+    speculation (mid-acceptance truncation), on the paged engine, and
+    through the serve backend."""
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [5, 6, 7, 5, 6, 7, 5]
+    full = _ref(params, cfg, prompt, 12)
+    one = [full[2]]
+    two = full[3:5]
+
+    def stop_at(seqs):
+        """Spec: the shortest prefix of `full` ending with a stop seq."""
+        for i in range(1, len(full) + 1):
+            out = full[:i]
+            if any(out[-len(sq):] == sq for sq in seqs
+                   if len(out) >= len(sq)):
+                return out
+        return full
+
+    eng = GenerationEngine(params, cfg, max_slots=2)
+    r = eng.submit(prompt, 12, stop=[one])
+    assert eng.run_until_done()[r] == stop_at([one])
+
+    eng = GenerationEngine(params, cfg, max_slots=2, speculative_k=4)
+    r = eng.submit(prompt, 12, stop=[two])
+    assert eng.run_until_done()[r] == stop_at([two])
+
+    eng = PagedGenerationEngine(params, cfg, max_slots=2, page_size=16)
+    r = eng.submit(prompt, 12, stop=[one, two])   # earliest wins
+    assert eng.run_until_done()[r] == stop_at([one, two])
+
+    # behind serve (kwarg passthrough)
+    from ray_tpu.serve.config import ServeRequest
+    from ray_tpu.serve.lm import LMBackend
+
+    b = LMBackend(params, cfg)
+    out = b([ServeRequest((prompt,), {"max_new_tokens": 12,
+                                      "stop": [one]})])
+    assert out == [stop_at([one])]
+
+    # invalid stop rejected with the documented ValueError — including
+    # the common flat-list mistake (stop=[220] instead of [[220]])
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="stop"):
+        GenerationEngine(params, cfg).submit(prompt, 4, stop=[[]])
+    with _pytest.raises(ValueError, match="stop"):
+        GenerationEngine(params, cfg).submit(prompt, 4, stop=[220])
