@@ -217,6 +217,29 @@ def test_serve_metrics_and_exporters(serve_instance):
     assert 'ray_serve_backend_latency_ms_p50{backend="met:v1"}' in text
 
 
+
+
+def _read_http_response(s):
+    """Read one HTTP response (head + Content-Length body) from a raw
+    socket; fails fast on early close instead of spinning on empty
+    recv()."""
+    import json as _json
+
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(4096)
+        assert chunk, "connection closed mid-response"
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = int([ln.split(b":")[1] for ln in head.split(b"\r\n")
+                  if ln.lower().startswith(b"content-length")][0])
+    while len(rest) < length:
+        chunk = s.recv(4096)
+        assert chunk, "connection closed mid-body"
+        rest += chunk
+    return head, _json.loads(rest[:length])
+
+
 def test_http_ingress_concurrent_with_idle_connections(local_ray):
     """The asyncio ingress serves concurrent requests correctly while many
     idle keep-alive connections are parked on its event loop (r5: the
@@ -266,18 +289,49 @@ def test_http_ingress_concurrent_with_idle_connections(local_ray):
                            f"Content-Type: application/json\r\n"
                            f"Content-Length: {len(body)}\r\n\r\n"
                            ).encode() + body)
-                buf = b""
-                while b"\r\n\r\n" not in buf:
-                    buf += s.recv(4096)
-                head, _, rest = buf.partition(b"\r\n\r\n")
-                length = int([ln.split(b":")[1] for ln in head.split(b"\r\n")
-                              if ln.lower().startswith(b"content-length")][0])
-                while len(rest) < length:
-                    rest += s.recv(4096)
-                assert _json.loads(rest[:length])["result"] == i * 2
+                _, payload = _read_http_response(s)
+                assert payload["result"] == i * 2
             s.close()
         finally:
             for c in idle:
                 c.close()
+    finally:
+        serve.shutdown()
+
+
+def test_http_ingress_expect_100_continue(local_ray):
+    """Clients sending Expect: 100-continue (curl with larger POST
+    bodies) must get the interim response before the body — otherwise
+    every such request stalls ~1s on the client's expect timeout."""
+    import json as _json
+    import socket
+
+    from ray_tpu import serve
+
+    serve.init(http_port=0)
+    try:
+        serve.create_backend("http-exp", lambda x: len(x))
+        serve.create_endpoint("http-exp-ep", backend="http-exp",
+                              route="/len", methods=["POST"])
+        addr = serve.http_address()
+        host, port = addr.split("//")[1].split(":")
+        body = _json.dumps({"args": ["z" * 3000]}).encode()
+        s = socket.create_connection((host, int(port)), timeout=15)
+        s.sendall((f"POST /len HTTP/1.1\r\nHost: x\r\n"
+                   f"Content-Type: application/json\r\n"
+                   f"Expect: 100-continue\r\n"
+                   f"Content-Length: {len(body)}\r\n\r\n").encode())
+        # The server must answer 100 Continue BEFORE seeing any body byte.
+        interim = b""
+        while b"\r\n\r\n" not in interim:
+            chunk = s.recv(4096)
+            assert chunk, "connection closed before 100 Continue"
+            interim += chunk
+        assert interim.startswith(b"HTTP/1.1 100"), interim[:40]
+        s.sendall(body)
+        head, payload = _read_http_response(s)
+        assert b"200" in head.split(b"\r\n")[0], head
+        assert payload["result"] == 3000
+        s.close()
     finally:
         serve.shutdown()
