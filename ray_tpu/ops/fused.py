@@ -30,6 +30,13 @@ def _rms_norm_kernel(x_ref, w_ref, o_ref, *, eps):
     o_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+# Interpret-mode escape hatch, same pattern as attention._INTERPRET: lets
+# CPU CI and scripts/onchip_smoke.py execute the pallas kernels themselves
+# (the public dispatchers below route CPU callers to the XLA reference, so
+# without this the kernels would only ever run on real TPU).
+_INTERPRET = False
+
+
 def _rms_norm_pallas(x2d, weight, eps, block_rows):
     import jax.experimental.pallas as pl
 
@@ -44,6 +51,7 @@ def _rms_norm_pallas(x2d, weight, eps, block_rows):
         ],
         out_specs=pl.BlockSpec((block_rows, E), lambda r: (r, 0)),
         out_shape=jax.ShapeDtypeStruct((R, E), x2d.dtype),
+        interpret=_INTERPRET,
     )(x2d, weight)
 
 
@@ -121,6 +129,7 @@ def _xent_pallas(logits, labels, block_b):
         ],
         out_specs=pl.BlockSpec((block_b, 1), lambda b: (b, 0)),
         out_shape=jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        interpret=_INTERPRET,
     )(logits, labels.astype(jnp.int32)[:, None])
     return out[:, 0]
 

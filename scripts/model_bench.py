@@ -265,40 +265,103 @@ def main():
         kind = "unknown"
     print(f"# backend: {backend} ({kind}, peak {args.peak_tflops} TFLOPs)",
           file=sys.stderr)
-    out = {"backend": backend, "device_kind": kind, "batch": args.batch,
-           "seq": args.seq, "peak_tflops": args.peak_tflops,
-           "captured_unix": int(time.time())}
+    path = args.out or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MODEL_BENCH.json")
+
+    # RESUME + INCREMENTAL PERSIST: the axon tunnel has dropped mid-run
+    # (round-5: died 25 min in, losing the whole capture). Each section is
+    # written to disk the moment it lands, and a fresh same-config partial
+    # from an earlier window is reused instead of re-paying its compiles.
+    out = {}
+    config_key = {"backend": backend, "batch": args.batch, "seq": args.seq,
+                  "steps": args.steps, "new_tokens": args.new_tokens,
+                  "peak_tflops": args.peak_tflops}
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        # Resume ONLY an INCOMPLETE same-config capture (a tunnel drop
+        # mid-run): a complete artifact that the daemon decided is stale
+        # must be fully re-measured — resuming it would be a no-op that
+        # re-stamps old numbers as a fresh capture.
+        if (not prev.get("complete")
+                and all(prev.get(k) == v for k, v in config_key.items())
+                and time.time() - prev.get("captured_unix", 0) < 6 * 3600):
+            out = {k: v for k, v in prev.items()
+                   if not (isinstance(v, dict) and "error" in v)}
+            done = [k for k in ("xla_attention", "pallas_attention",
+                                "decode", "decode_dma_truncation")
+                    if k in out]
+            if done:
+                print(f"# resuming same-config capture, keeping {done}",
+                      file=sys.stderr)
+    except (OSError, ValueError):
+        pass
+    # captured_unix stays anchored at the ORIGINAL capture when resuming:
+    # re-stamping it would let a complete-but-aging artifact slide both
+    # the 6h resume window and the daemon's freshness check forever,
+    # re-labelling old numbers as new without ever re-measuring.
+    out.setdefault("captured_unix", int(time.time()))
+    out.update({"backend": backend, "device_kind": kind,
+                "batch": args.batch, "seq": args.seq, "steps": args.steps,
+                "new_tokens": args.new_tokens,
+                "peak_tflops": args.peak_tflops,
+                "refreshed_unix": int(time.time())})
+    out.pop("complete", None)
+
+    def persist():
+        # Only write once `out` holds at least one real measurement:
+        # a metadata-only stub must never clobber a last-good artifact
+        # when a fresh attempt dies before its first section lands.
+        if not any(k in out for k in ("xla_attention", "pallas_attention",
+                                      "decode", "decode_dma_truncation")):
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=2)
+        os.replace(tmp, path)
+
     for name, use_pallas in (("xla_attention", False),
                              ("pallas_attention", True)):
+        if name in out:
+            continue
         r = bench_config(use_pallas, batch=args.batch, seq=args.seq,
                          steps=args.steps)
         r["mfu_pct"] = round(100.0 * r["achieved_tflops"]
                              / args.peak_tflops, 2)
         out[name] = r
+        persist()
         print(f"# {name}: {r}", file=sys.stderr)
     fast = max(("xla_attention", "pallas_attention"),
                key=lambda n: out[n]["tokens_per_sec"])
     out["winner"] = fast
     if not args.skip_decode:
-        try:
-            out["decode"] = bench_decode(batch=args.batch, seq=args.seq,
-                                         new_tokens=args.new_tokens)
-            print(f"# decode: {out['decode']}", file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 - keep the attention results
-            out["decode"] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"# decode failed: {e}", file=sys.stderr)
-        try:
-            out["decode_dma_truncation"] = bench_decode_truncation()
-            print(f"# decode_dma_truncation: {out['decode_dma_truncation']}",
-                  file=sys.stderr)
-        except Exception as e:  # noqa: BLE001
-            out["decode_dma_truncation"] = {
-                "error": f"{type(e).__name__}: {e}"}
-            print(f"# decode truncation A/B failed: {e}", file=sys.stderr)
-    path = args.out or os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "MODEL_BENCH.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+        if "decode" not in out:
+            try:
+                out["decode"] = bench_decode(batch=args.batch, seq=args.seq,
+                                             new_tokens=args.new_tokens)
+                print(f"# decode: {out['decode']}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 - keep attention results
+                out["decode"] = {"error": f"{type(e).__name__}: {e}"}
+                print(f"# decode failed: {e}", file=sys.stderr)
+            persist()
+        if "decode_dma_truncation" not in out:
+            try:
+                out["decode_dma_truncation"] = bench_decode_truncation()
+                print("# decode_dma_truncation: "
+                      f"{out['decode_dma_truncation']}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                out["decode_dma_truncation"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+                print(f"# decode truncation A/B failed: {e}", file=sys.stderr)
+            persist()
+    # "complete" = every section present AND error-free; a --skip-decode
+    # or partial run must not look like a full capture to the daemon.
+    sections = ("xla_attention", "pallas_attention", "decode",
+                "decode_dma_truncation")
+    out["complete"] = all(
+        k in out and not (isinstance(out[k], dict) and "error" in out[k])
+        for k in sections)
+    persist()
     print(json.dumps(out))
 
 

@@ -1,0 +1,238 @@
+"""Cheap on-chip pallas smoke: every kernel, tiny shapes, one compile each.
+
+The round-4 verdict asked for capture that lands inside a ~10-minute
+healthy-tunnel window. The previous smoke gate ran the whole
+``tests/test_fused_ops.py`` on-chip (12 tests x multiple pallas compiles
+over a slow tunnel) and blew a 30-minute timeout. This script is the
+replacement: each pallas kernel family compiles ONCE at its smallest
+TPU-tileable shape, is checked against the XLA reference, and its result
+row is persisted to ``ONCHIP_SMOKE.json`` IMMEDIATELY — a tunnel drop
+mid-run still leaves evidence for every kernel that finished.
+
+Kernels covered (reference bar: every hot op the repo ships):
+  flash_fwd_bwd   ops/attention.py::_flash        (causal + GQA, fwd+vjp)
+  flash_decode    ops/attention.py::_flash_decode (varied lengths + DMA trunc)
+  paged_decode    ops/paged_attention.py::_paged_flash_decode
+  rms_norm        ops/fused.py::rms_norm          (fwd+vjp)
+  xent            ops/fused.py::softmax_cross_entropy (fwd+vjp)
+
+Exit 0 iff every kernel row is ok AND the backend is really TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "ONCHIP_SMOKE.json")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms="axon,cpu" via jax.config at
+# interpreter startup (env vars alone cannot override it). CPU CI runs set
+# RAY_TPU_SMOKE_CPU=1 to force the CPU backend + interpret-mode kernels.
+if os.environ.get("RAY_TPU_SMOKE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _persist(doc: dict) -> None:
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, OUT)
+
+
+def _run(doc: dict, name: str, fn) -> None:
+    t0 = time.time()
+    row: dict = {}
+    try:
+        row = fn()
+        row["ok"] = True
+    except Exception as e:  # noqa: BLE001 - persist the failure and move on
+        row = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    row["wall_s"] = round(time.time() - t0, 2)
+    doc["kernels"][name] = row
+    _persist(doc)
+    print(f"# {name}: {'OK' if row['ok'] else 'FAIL'} in {row['wall_s']}s "
+          f"{row.get('error', '')}", flush=True)
+
+
+def smoke_flash_fwd_bwd():
+    from ray_tpu.ops import attention as att
+    B, T, H, KH, D, blk = 1, 16, 4, 2, 128, 8
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, T, KH, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, KH, D), jnp.float32)
+    g = jax.random.normal(kg, (B, T, H, D), jnp.float32)
+
+    ref_out, ref_vjp = jax.vjp(
+        lambda q, k, v: att.attention_reference(q, k, v, causal=True),
+        q, k, v)
+    ref_grads = ref_vjp(g)
+
+    out, vjp = jax.vjp(lambda q, k, v: att._flash(q, k, v, True, blk, blk),
+                       q, k, v)
+    grads = vjp(g)
+    jax.block_until_ready((out, grads))
+    errs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip((out,) + tuple(grads),
+                            (ref_out,) + tuple(ref_grads))]
+    assert max(errs) < 2e-4, errs
+    return {"shape": f"B{B} T{T} H{H}/KH{KH} D{D} causal gqa",
+            "max_abs_err": max(errs)}
+
+
+def smoke_flash_decode():
+    from ray_tpu.ops import attention as att
+    B, H, KH, D, S, bk = 4, 8, 1, 128, 32, 8
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, KH, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, KH, D), jnp.float32)
+    lens = jnp.asarray([0, 7, 16, 31], jnp.int32)
+
+    mask = (jnp.arange(S)[None, :] <= lens[:, None])[:, None, :]
+    ref = att.masked_gqa_attention(q[:, None], k, v, mask)[:, 0]
+
+    full = att._flash_decode(q, k, v, lens, bk, truncate_dma=False)
+    trunc = att._flash_decode(q, k, v, lens, bk, truncate_dma=True)
+    jax.block_until_ready((full, trunc))
+    err = float(np.max(np.abs(np.asarray(full) - np.asarray(ref))))
+    err_t = float(np.max(np.abs(np.asarray(trunc) - np.asarray(full))))
+    assert err < 2e-5 and err_t < 1e-6, (err, err_t)
+    return {"shape": f"B{B} H{H}/KH{KH} D{D} S{S}",
+            "max_abs_err": err, "trunc_vs_full_err": err_t}
+
+
+def smoke_paged_decode():
+    from ray_tpu.ops import attention as att
+    from ray_tpu.ops import paged_attention as pa
+    B, H, KH, D, ps, P, npg = 2, 8, 1, 128, 128, 3, 8
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, D), jnp.float32)
+    k_pages = jax.random.normal(kk, (npg, ps, KH, D), jnp.float32)
+    v_pages = jax.random.normal(kv, (npg, ps, KH, D), jnp.float32)
+    pt = jnp.asarray([[1, 4, -1], [2, 6, 7]], jnp.int32)
+    lens = jnp.asarray([130, 300], jnp.int32)
+
+    out = pa._paged_flash_decode(q, k_pages, v_pages, pt, lens)
+    jax.block_until_ready(out)
+
+    buf_k = pa.paged_gather(k_pages, pt)
+    buf_v = pa.paged_gather(v_pages, pt)
+    S = P * ps
+    mask = (jnp.arange(S)[None, :] <= lens[:, None])[:, None, :]
+    ref = att.masked_gqa_attention(q[:, None], buf_k, buf_v, mask)[:, 0]
+    err = float(np.max(np.abs(np.asarray(out) - np.asarray(ref))))
+    assert err < 2e-5, err
+    return {"shape": f"B{B} H{H}/KH{KH} D{D} ps{ps} P{P}",
+            "max_abs_err": err}
+
+
+def smoke_rms_norm():
+    # Call the PRIVATE pallas entry (like the flash smokes): the public
+    # rms_norm dispatches to the XLA reference for rows % 256 != 0 or on
+    # CPU, which would make a ref-vs-ref comparison pass vacuously.
+    from ray_tpu.ops import fused
+    key = jax.random.PRNGKey(7)
+    x = jax.random.normal(key, (256, 256), jnp.float32)
+    w = jnp.ones((256,), jnp.float32) * 1.1
+
+    out = fused._rms_norm_pallas(x, w, 1e-5, 256)
+    jax.block_until_ready(out)
+    ref_out = fused._rms_norm_ref(x, w, 1e-5)
+    err = float(np.max(np.abs(np.asarray(out) - np.asarray(ref_out))))
+    assert err < 2e-4, err
+    # Gradient path through the public API (pallas fwd on TPU at this
+    # shape; the custom-vjp backward is XLA either way).
+    g, ref_vjp = jax.vjp(lambda x, w: fused._rms_norm_ref(x, w, 1e-5), x, w)
+    ref_grads = ref_vjp(jnp.ones_like(g))
+    out2, vjp = jax.vjp(lambda x, w: fused.rms_norm(x, w), x, w)
+    grads = vjp(jnp.ones_like(out2))
+    jax.block_until_ready(grads)
+    gerr = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+               for a, b in zip(grads, ref_grads))
+    assert gerr < 2e-4, gerr
+    return {"shape": "256x256 (pallas direct)", "max_abs_err": err,
+            "max_grad_err": gerr}
+
+
+def smoke_xent():
+    from ray_tpu.ops import fused
+    key = jax.random.PRNGKey(11)
+    logits = jax.random.normal(key, (16, 512), jnp.float32)
+    labels = jnp.arange(16, dtype=jnp.int32) % 512
+
+    out = fused._xent_pallas(logits, labels, 8)  # private: real kernel
+    jax.block_until_ready(out)
+    ref_out = fused._xent_ref(logits, labels)
+    err = float(np.max(np.abs(np.asarray(out) - np.asarray(ref_out))))
+    assert err < 2e-4, err
+    _, ref_vjp = jax.vjp(lambda l: fused._xent_ref(l, labels), logits)
+    (ref_g,) = ref_vjp(jnp.ones_like(ref_out))
+    _, vjp = jax.vjp(
+        lambda l: fused.softmax_cross_entropy(l, labels), logits)
+    (g,) = vjp(jnp.ones_like(ref_out))
+    jax.block_until_ready(g)
+    gerr = float(np.max(np.abs(np.asarray(g) - np.asarray(ref_g))))
+    assert gerr < 2e-4, gerr
+    return {"shape": "16x512 (pallas direct)", "max_abs_err": err,
+            "max_grad_err": gerr}
+
+
+def main() -> int:
+    global OUT
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    if backend != "tpu":
+        # CPU runs land in a SIBLING artifact (MODEL_BENCH_CPU.json
+        # convention): a tunnel-drop CPU fallback must never clobber the
+        # last-good on-chip ONCHIP_SMOKE.json.
+        OUT = os.path.join(REPO, "ONCHIP_SMOKE_CPU.json")
+    doc = {
+        "backend": backend, "device_kind": kind,
+        "started": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "captured_unix": int(time.time()),
+        "interpret": False, "kernels": {},
+    }
+    if backend != "tpu":
+        # Still runnable on CPU for CI, but mark it loudly and force
+        # interpret mode so pallas kernels execute at all.
+        from ray_tpu.ops import attention as att
+        from ray_tpu.ops import fused
+        att._INTERPRET = True
+        fused._INTERPRET = True
+        doc["interpret"] = True
+    _persist(doc)
+    print(f"# onchip smoke on {backend} ({kind})", flush=True)
+
+    t0 = time.time()
+    _run(doc, "flash_fwd_bwd", smoke_flash_fwd_bwd)
+    _run(doc, "flash_decode", smoke_flash_decode)
+    _run(doc, "paged_decode", smoke_paged_decode)
+    _run(doc, "rms_norm", smoke_rms_norm)
+    _run(doc, "xent", smoke_xent)
+
+    doc["total_wall_s"] = round(time.time() - t0, 1)
+    ok = all(r.get("ok") for r in doc["kernels"].values())
+    doc["all_ok"] = bool(ok and backend == "tpu")
+    _persist(doc)
+    print(json.dumps({"all_ok": doc["all_ok"], "backend": backend,
+                      "total_wall_s": doc["total_wall_s"]}))
+    return 0 if doc["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

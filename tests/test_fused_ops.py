@@ -39,6 +39,37 @@ def test_rms_norm_grads_match_autodiff():
     np.testing.assert_allclose(gw1, gw2, rtol=1e-5, atol=1e-6)
 
 
+def test_rms_norm_pallas_kernel_interpret():
+    """The pallas kernel ITSELF (public rms_norm routes CPU callers to the
+    XLA reference, so without this the kernel only ever runs on real TPU —
+    scripts/onchip_smoke.py exercises the same private entry on-chip)."""
+    from ray_tpu.ops import fused
+
+    x = jax.random.normal(jax.random.PRNGKey(6), (256, 256), jnp.float32)
+    w = jnp.ones(256) * 1.1
+    prev, fused._INTERPRET = fused._INTERPRET, True
+    try:
+        out = fused._rms_norm_pallas(x, w, 1e-5, 256)
+    finally:
+        fused._INTERPRET = prev
+    np.testing.assert_allclose(
+        out, _rms_norm_ref(x, w, 1e-5), rtol=1e-5, atol=1e-6)
+
+
+def test_xent_pallas_kernel_interpret():
+    from ray_tpu.ops import fused
+
+    logits = jax.random.normal(jax.random.PRNGKey(7), (16, 512))
+    labels = jax.random.randint(jax.random.PRNGKey(8), (16,), 0, 512)
+    prev, fused._INTERPRET = fused._INTERPRET, True
+    try:
+        out = fused._xent_pallas(logits, labels, 8)
+    finally:
+        fused._INTERPRET = prev
+    np.testing.assert_allclose(
+        out, _xent_ref(logits, labels), rtol=1e-5, atol=1e-6)
+
+
 def test_xent_matches_reference_and_optax():
     import optax
 
