@@ -83,7 +83,12 @@ def _init_backend() -> str:
         if attempt == 1 and result:
             time.sleep(5.0)
         elif attempt == 1:
-            break  # hang won't heal in 5s; go straight to CPU
+            # A hung probe is NOT always a dead tunnel: the axon client is
+            # single-session, and a just-killed process's chip session can
+            # linger ~30s (observed round-5: the capture daemon killed a
+            # timed-out stage and the very next probe hung while the chip
+            # was healthy). Settle and retry once before giving up.
+            time.sleep(30.0)
     if not os.environ.get(_CPU_CHILD_ENV):
         print("TPU backend unusable; re-execing on CPU", file=sys.stderr,
               flush=True)

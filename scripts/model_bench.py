@@ -265,8 +265,13 @@ def main():
         kind = "unknown"
     print(f"# backend: {backend} ({kind}, peak {args.peak_tflops} TFLOPs)",
           file=sys.stderr)
+    # CPU runs land in a SIBLING artifact unless --out says otherwise: a
+    # manual tunnel-down run must never clobber the last-good on-chip
+    # MODEL_BENCH.json (same convention as ONCHIP_SMOKE_CPU.json).
+    default_name = ("MODEL_BENCH.json" if backend == "tpu"
+                    else "MODEL_BENCH_CPU.json")
     path = args.out or os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "MODEL_BENCH.json")
+        os.path.abspath(__file__))), default_name)
 
     # RESUME + INCREMENTAL PERSIST: the axon tunnel has dropped mid-run
     # (round-5: died 25 min in, losing the whole capture). Each section is
@@ -296,10 +301,13 @@ def main():
                       file=sys.stderr)
     except (OSError, ValueError):
         pass
-    # captured_unix stays anchored at the ORIGINAL capture when resuming:
-    # re-stamping it would let a complete-but-aging artifact slide both
-    # the 6h resume window and the daemon's freshness check forever,
-    # re-labelling old numbers as new without ever re-measuring.
+    # captured_unix stays anchored at the ORIGINAL capture while resuming:
+    # re-stamping a measurement-free rewrite would let an aging artifact
+    # slide the freshness windows forever. When this run DOES land new
+    # sections, the stamp moves to now (see below) so a capture completed
+    # across two windows counts as fresh from its completion, with
+    # oldest_section_unix recording the older half's age honestly.
+    resumed_from = out.get("captured_unix")
     out.setdefault("captured_unix", int(time.time()))
     out.update({"backend": backend, "device_kind": kind,
                 "batch": args.batch, "seq": args.seq, "steps": args.steps,
@@ -320,6 +328,7 @@ def main():
             json.dump(out, f, indent=2)
         os.replace(tmp, path)
 
+    new_sections = 0
     for name, use_pallas in (("xla_attention", False),
                              ("pallas_attention", True)):
         if name in out:
@@ -329,6 +338,7 @@ def main():
         r["mfu_pct"] = round(100.0 * r["achieved_tflops"]
                              / args.peak_tflops, 2)
         out[name] = r
+        new_sections += 1
         persist()
         print(f"# {name}: {r}", file=sys.stderr)
     fast = max(("xla_attention", "pallas_attention"),
@@ -343,6 +353,7 @@ def main():
             except Exception as e:  # noqa: BLE001 - keep attention results
                 out["decode"] = {"error": f"{type(e).__name__}: {e}"}
                 print(f"# decode failed: {e}", file=sys.stderr)
+            new_sections += 1
             persist()
         if "decode_dma_truncation" not in out:
             try:
@@ -353,6 +364,7 @@ def main():
                 out["decode_dma_truncation"] = {
                     "error": f"{type(e).__name__}: {e}"}
                 print(f"# decode truncation A/B failed: {e}", file=sys.stderr)
+            new_sections += 1
             persist()
     # "complete" = every section present AND error-free; a --skip-decode
     # or partial run must not look like a full capture to the daemon.
@@ -361,6 +373,12 @@ def main():
     out["complete"] = all(
         k in out and not (isinstance(out[k], dict) and "error" in out[k])
         for k in sections)
+    if new_sections and resumed_from:
+        # A capture finished across two tunnel windows: stamp freshness at
+        # completion (so the daemon doesn't immediately re-measure what it
+        # just finished) and record the older half's age honestly.
+        out["captured_unix"] = int(time.time())
+        out["oldest_section_unix"] = resumed_from
     persist()
     print(json.dumps(out))
 
