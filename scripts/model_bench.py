@@ -350,21 +350,21 @@ def main():
                 out["decode"] = bench_decode(batch=args.batch, seq=args.seq,
                                              new_tokens=args.new_tokens)
                 print(f"# decode: {out['decode']}", file=sys.stderr)
+                new_sections += 1
             except Exception as e:  # noqa: BLE001 - keep attention results
                 out["decode"] = {"error": f"{type(e).__name__}: {e}"}
                 print(f"# decode failed: {e}", file=sys.stderr)
-            new_sections += 1
             persist()
         if "decode_dma_truncation" not in out:
             try:
                 out["decode_dma_truncation"] = bench_decode_truncation()
                 print("# decode_dma_truncation: "
                       f"{out['decode_dma_truncation']}", file=sys.stderr)
+                new_sections += 1
             except Exception as e:  # noqa: BLE001
                 out["decode_dma_truncation"] = {
                     "error": f"{type(e).__name__}: {e}"}
                 print(f"# decode truncation A/B failed: {e}", file=sys.stderr)
-            new_sections += 1
             persist()
     # "complete" = every section present AND error-free; a --skip-decode
     # or partial run must not look like a full capture to the daemon.
@@ -374,11 +374,15 @@ def main():
         k in out and not (isinstance(out[k], dict) and "error" in out[k])
         for k in sections)
     if new_sections and resumed_from:
-        # A capture finished across two tunnel windows: stamp freshness at
+        # A capture finished across tunnel windows: stamp freshness at
         # completion (so the daemon doesn't immediately re-measure what it
-        # just finished) and record the older half's age honestly.
+        # just finished) and keep the OLDEST window's stamp honest across
+        # chained resumes. new_sections counts SUCCESSFUL sections only —
+        # a resume whose remaining stages all fail must not re-slide the
+        # resume window around old measurements.
         out["captured_unix"] = int(time.time())
-        out["oldest_section_unix"] = resumed_from
+        out["oldest_section_unix"] = min(
+            resumed_from, out.get("oldest_section_unix", resumed_from))
     persist()
     print(json.dumps(out))
 

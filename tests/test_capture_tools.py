@@ -1,0 +1,89 @@
+"""Regression tests for the on-chip capture tooling (scripts/tpu_capture.py).
+
+The daemon's freshness-skip decides whether a healthy-tunnel window
+re-pays multi-minute tunnel compiles; its rules were previously only
+exercised by hand. Reference bar: the per-release measured-numbers
+culture of doc/dev/release_logs/ — the capture artifacts ARE the
+product here, so their guards get tests like any other component.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tpu_capture():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_capture", os.path.join(REPO, "scripts", "tpu_capture.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, name, doc, age_s=0):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    if age_s:
+        os.utime(p, (time.time() - age_s,) * 2)
+    return p
+
+
+def test_fresh_artifact_rules(tmp_path, monkeypatch):
+    tc = _load_tpu_capture()
+    monkeypatch.setattr(tc, "REPO", str(tmp_path))
+
+    # A young on-chip artifact is fresh; CPU backend never is.
+    _write(tmp_path, "a.json", {"backend": "tpu"})
+    assert tc._fresh_tpu_artifact("a.json")
+    _write(tmp_path, "b.json", {"backend": "cpu"})
+    assert not tc._fresh_tpu_artifact("b.json")
+
+    # Missing / unparsable files are not fresh.
+    assert not tc._fresh_tpu_artifact("nope.json")
+    (tmp_path / "junk.json").write_text("{not json")
+    assert not tc._fresh_tpu_artifact("junk.json")
+
+    # ok_key gates on the recorded flag.
+    _write(tmp_path, "c.json", {"backend": "tpu", "complete": False})
+    assert not tc._fresh_tpu_artifact("c.json", ok_key="complete")
+    _write(tmp_path, "d.json", {"backend": "tpu", "complete": True})
+    assert tc._fresh_tpu_artifact("d.json", ok_key="complete")
+
+
+def test_fresh_artifact_ages_by_captured_unix_not_mtime(tmp_path,
+                                                       monkeypatch):
+    """A resumed model_bench rewrites the file (fresh mtime) while keeping
+    old measurements — freshness must follow the data's own stamp."""
+    tc = _load_tpu_capture()
+    monkeypatch.setattr(tc, "REPO", str(tmp_path))
+
+    stale_stamp = int(time.time()) - tc.FRESH_S - 60
+    _write(tmp_path, "m.json",
+           {"backend": "tpu", "captured_unix": stale_stamp})  # mtime: now
+    assert not tc._fresh_tpu_artifact("m.json")
+
+    # No captured_unix -> falls back to mtime.
+    _write(tmp_path, "n.json", {"backend": "tpu"}, age_s=tc.FRESH_S + 60)
+    assert not tc._fresh_tpu_artifact("n.json")
+
+
+def test_fresh_artifact_config_mismatch(tmp_path, monkeypatch):
+    """A quick manual run (--steps 2) must not suppress the daemon's full
+    capture: the skip validates the artifact recorded the SAME config."""
+    tc = _load_tpu_capture()
+    monkeypatch.setattr(tc, "REPO", str(tmp_path))
+
+    good = {"backend": "tpu", "complete": True, "captured_unix":
+            int(time.time())}
+    good.update(tc.MODEL_BENCH_CFG)
+    _write(tmp_path, "mb.json", good)
+    assert tc._fresh_tpu_artifact("mb.json", ok_key="complete",
+                                  config=tc.MODEL_BENCH_CFG)
+
+    quick = dict(good, steps=2)
+    _write(tmp_path, "mb2.json", quick)
+    assert not tc._fresh_tpu_artifact("mb2.json", ok_key="complete",
+                                      config=tc.MODEL_BENCH_CFG)
