@@ -8,7 +8,10 @@ here and used for every cross-round number:
   - R back-to-back runs (default 5), each in a FRESH multi-process
     Cluster (GCS + head controller + 1 worker node, 2 workers each);
   - per run: serial round-trip percentiles over N trips, then one
-    K-task batched fan-out;
+    K-task batched fan-out, then (protocol v2) a SECOND K-task batch in
+    the same cluster — the warm, steady-state row
+    (``batch_warm_tasks_per_sec``; ``batch_tasks_per_sec`` stays the
+    cold first batch, comparable with pre-v2 history);
   - report MEDIAN + min/max spread across runs, as one JSON line
     (also appended to CLUSTER_LAT.json with a timestamp).
 
