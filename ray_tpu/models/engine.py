@@ -302,6 +302,24 @@ class GenerationEngine:
         self.spec_stats = {"ticks": 0, "drafted": 0, "accepted": 0,
                            "emitted": 0}
 
+    def _zeros_kv(self, shape: tuple) -> jax.Array:
+        """Allocate one KV store array, SHARDED AT CREATION when a mesh is
+        set: the multi-chip decode layout (kv-heads on the tp axis, the
+        4th-from-last dim of both the contiguous [L, slots, seq, KH, Dh]
+        cache and the paged [L, pages, ps, KH, Dh] pool) is defined HERE,
+        once, for both engines. Allocating unsharded + device_put would
+        transiently materialise the full pool on one device — an N x
+        startup HBM spike on exactly the bigger-than-one-chip models tp
+        serves."""
+        if self.mesh is None:
+            return jnp.zeros(shape, self.cfg.dtype)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ns = NamedSharding(self.mesh,
+                           P(*([None] * (len(shape) - 2)), "tp", None))
+        return jax.jit(lambda: jnp.zeros(shape, self.cfg.dtype),
+                       out_shardings=ns)()
+
     def _alloc_cache(self) -> None:
         """Materialise the KV store on device. A hook so subclasses with a
         different storage scheme (paged) never allocate the contiguous
@@ -310,15 +328,8 @@ class GenerationEngine:
         bounding."""
         cfg = self.cfg
         L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        self.cache_k = jnp.zeros((L, self.slots, self.max_seq, KH, Dh),
-                                 cfg.dtype)
-        self.cache_v = jnp.zeros_like(self.cache_k)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            ns = NamedSharding(self.mesh, P(None, None, None, "tp", None))
-            self.cache_k = jax.device_put(self.cache_k, ns)
-            self.cache_v = jax.device_put(self.cache_v, ns)
+        self.cache_k = self._zeros_kv((L, self.slots, self.max_seq, KH, Dh))
+        self.cache_v = self._zeros_kv((L, self.slots, self.max_seq, KH, Dh))
 
     # ---- public API ----
 

@@ -271,11 +271,12 @@ class PagedGenerationEngine(GenerationEngine):
                  max_slots: int = 4, max_seq: Optional[int] = None,
                  eos_id: Optional[int] = None, page_size: int = 128,
                  num_pages: Optional[int] = None, speculative_k: int = 0,
-                 speculative_ngram: int = 2, prefill_chunk: int = 0):
+                 speculative_ngram: int = 2, prefill_chunk: int = 0,
+                 mesh=None):
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
                          eos_id=eos_id, speculative_k=speculative_k,
                          speculative_ngram=speculative_ngram,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk, mesh=mesh)
         L, KH, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         self.page_size = ps = page_size
         self.pages_per_slot = -(-self.max_seq // ps)
@@ -286,8 +287,13 @@ class PagedGenerationEngine(GenerationEngine):
                 f"num_pages={num_pages} cannot fit one max_seq sequence "
                 f"({self.pages_per_slot} pages) plus the scratch page")
         self.num_pages = num_pages
-        self.k_pages = jnp.zeros((L, num_pages, ps, KH, Dh), cfg.dtype)
-        self.v_pages = jnp.zeros_like(self.k_pages)
+        # Multi-chip (mesh set): _zeros_kv allocates the pool sharded on
+        # the kv-head axis AT CREATION (same layout as the contiguous
+        # cache); page TABLES stay replicated host state — each shard
+        # holds every page's slice for its heads, so the gather/scatter
+        # indices are shard-invariant and GSPMD inserts no KV collectives.
+        self.k_pages = self._zeros_kv((L, num_pages, ps, KH, Dh))
+        self.v_pages = self._zeros_kv((L, num_pages, ps, KH, Dh))
         self.pool = PagePool(num_pages, ps)
         self.pool.alloc(seq=-1, tokens=1)       # pin page 0 as scratch
         assert self.pool.pages_for(-1) == [0]

@@ -60,11 +60,24 @@ class LMBackend:
                  num_pages: Optional[int] = None,
                  speculative_k: int = 0, speculative_ngram: int = 2,
                  tp: int = 1, prefill_chunk: int = 0):
-        if paged:
-            if tp > 1:
+        # tp > 1: serve a model bigger than one chip — Megatron decode
+        # layout over this replica's first tp local devices. Works with
+        # BOTH engines (the paged engine shards its page pool on the
+        # kv-head axis, same layout as the contiguous cache).
+        mesh = None
+        if tp > 1:
+            import jax
+            import numpy as _np
+            from jax.sharding import Mesh
+
+            # local_devices, not devices: in multi-process jax the
+            # global list contains non-addressable remote devices.
+            devs = jax.local_devices()
+            if len(devs) < tp:
                 raise ValueError(
-                    "tp > 1 requires the contiguous engine (paged=False): "
-                    "the paged engine has no sharded cache layout yet")
+                    f"tp={tp} but only {len(devs)} local devices")
+            mesh = Mesh(_np.array(devs[:tp]).reshape(tp), ("tp",))
+        if paged:
             # Paged KV (models/paged_engine.py): cache memory bounded by
             # num_pages instead of max_slots * max_seq; admission queues
             # FIFO on page budget. Same outputs; speculation verifies
@@ -76,27 +89,12 @@ class LMBackend:
                 max_seq=max_seq, page_size=page_size, num_pages=num_pages,
                 speculative_k=speculative_k,
                 speculative_ngram=speculative_ngram,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk, mesh=mesh)
         else:
             from ..models.engine import GenerationEngine
 
             # speculative_k > 0: n-gram speculative decoding (exact for
-            # greedy requests; see models/speculative.py). tp > 1: serve
-            # a model bigger than one chip — Megatron decode layout over
-            # this replica's first tp local devices.
-            mesh = None
-            if tp > 1:
-                import jax
-                import numpy as _np
-                from jax.sharding import Mesh
-
-                # local_devices, not devices: in multi-process jax the
-                # global list contains non-addressable remote devices.
-                devs = jax.local_devices()
-                if len(devs) < tp:
-                    raise ValueError(
-                        f"tp={tp} but only {len(devs)} local devices")
-                mesh = Mesh(_np.array(devs[:tp]).reshape(tp), ("tp",))
+            # greedy requests; see models/speculative.py).
             self.engine = GenerationEngine(
                 params, cfg, max_slots=max_slots, eos_id=eos_id,
                 max_seq=max_seq, speculative_k=speculative_k,
