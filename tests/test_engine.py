@@ -531,6 +531,32 @@ def test_chunked_prefill_exact_long_prompt():
     assert eng2.run_until_done()[r2] == _ref(params, cfg, [4, 5, 6], 5)
 
 
+def test_chunked_prefill_paged_tp_compose():
+    """The full serving matrix in one engine: paged KV + tp mesh + chunked
+    prefill + speculation + prefix caching, token-exact vs generate()."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.paged_engine import PagedGenerationEngine
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
+    prompt = ([7, 8, 9, 7, 8, 9] * 30)[:150]
+    ref = _ref(params, cfg, prompt, 10)
+    eng = PagedGenerationEngine(
+        params, cfg, max_slots=2, page_size=64, prefill_chunk=64,
+        speculative_k=3, mesh=mesh)
+    rid = eng.submit(prompt, 10)
+    assert eng.run_until_done()[rid] == ref
+    # Second identical prompt: shared prefix pages skip their prefill
+    # chunks on the SHARDED pool; output must stay exact.
+    assert eng._prefix_hits(prompt) > 0
+    r2 = eng.submit(prompt, 10)
+    assert eng.run_until_done()[r2] == ref
+
+
 def test_stop_sequences():
     """stop= ends generation the moment the output ends with any stop
     sequence (stop tokens included, like EOS) — on the plain path, under
