@@ -14,9 +14,12 @@ Run:  python scripts/soak.py --workload many_tasks --duration 60
 from __future__ import annotations
 
 import argparse
+import os
 import statistics
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -344,6 +347,15 @@ def main(argv=None):
     names = sorted(WORKLOADS) if a.all else [a.workload]
     if names == [None]:
         p.error("pass --workload NAME or --all")
+
+    # Soak is a CONTROL-PLANE harness: force the CPU backend in this
+    # process before anything touches jax (cluster children already get
+    # this). Without it, the axon sitecustomize pins
+    # jax_platforms="axon,cpu" and a hung TPU tunnel wedges the whole
+    # soak at backend init (observed: 22 min at ~0 CPU).
+    from ray_tpu.cluster.launch import _force_cpu_jax
+
+    _force_cpu_jax()
 
     import ray_tpu
     results = {}
