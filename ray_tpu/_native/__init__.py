@@ -14,3 +14,14 @@ from .shm_store import (  # noqa: F401
     create_store,
     open_store,
 )
+
+
+def __getattr__(name):
+    # Spill policy types re-exported lazily (they live in _private to keep
+    # this package import-light; importing them eagerly would pull metrics
+    # into every worker that only wants the raw arena).
+    if name in ("SpillingStore", "SpillManager"):
+        from .._private import spill
+
+        return getattr(spill, name)
+    raise AttributeError(name)

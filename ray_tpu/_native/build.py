@@ -20,6 +20,25 @@ _lock = threading.Lock()
 _cache: dict = {}
 
 
+def _san_mode() -> str:
+    """Sanitizer build mode (reference: ci/asan_tests): RAY_TPU_NATIVE_SAN
+    = "asan" compiles the native libraries with ASAN+UBSAN (-O1 -g, own
+    .so names so sanitized and plain builds never share a cache slot).
+    dlopen'ing a sanitized .so requires the asan runtime preloaded — the
+    harness for that is scripts/native_san.py."""
+    return os.environ.get("RAY_TPU_NATIVE_SAN", "").lower()
+
+
+def _san_flags():
+    if _san_mode() == "asan":
+        return ["-fsanitize=address,undefined", "-g", "-O1"]
+    return ["-O2"]
+
+
+def _san_suffix() -> str:
+    return ".asan" if _san_mode() == "asan" else ""
+
+
 def _needs_build(src: str, out: str) -> bool:
     if not os.path.exists(out):
         return True
@@ -41,7 +60,7 @@ def build_c_api() -> Optional[str]:
     import sysconfig
 
     src = os.path.join(_SRC_DIR, "capi.cc")
-    out = os.path.join(_BUILD_DIR, "libray_tpu_c.so")
+    out = os.path.join(_BUILD_DIR, f"libray_tpu_c{_san_suffix()}.so")
     try:
         if _needs_build(src, out):
             os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -56,8 +75,8 @@ def build_c_api() -> Optional[str]:
                                 or "3")
             tmp = out + f".tmp.{os.getpid()}"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-Wall",
-                 f"-I{inc}", f"-I{own_inc}", "-o", tmp, src,
+                ["g++", *_san_flags(), "-shared", "-fPIC", "-std=c++17",
+                 "-Wall", f"-I{inc}", f"-I{own_inc}", "-o", tmp, src,
                  f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-l{pylib}",
                  "-lpthread"],
                 check=True, capture_output=True, timeout=180,
@@ -68,26 +87,41 @@ def build_c_api() -> Optional[str]:
         return None
 
 
+def build_native_library(name: str) -> Optional[str]:
+    """Compile src/<name>.cc -> _build/lib<name>[.asan].so (honoring the
+    RAY_TPU_NATIVE_SAN sanitizer mode) without dlopen'ing it; returns the
+    .so path or None on failure. Split out of load_native_library so the
+    sanitizer harness can verify a clean ASAN+UBSAN compile of every
+    library even though a sanitized .so cannot be dlopen'd into a plain
+    python process."""
+    src = os.path.join(_SRC_DIR, f"{name}.cc")
+    out = os.path.join(_BUILD_DIR, f"lib{name}{_san_suffix()}.so")
+    try:
+        if _needs_build(src, out):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            tmp = out + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", *_san_flags(), "-shared", "-fPIC", "-std=c++17",
+                 "-Wall", "-o", tmp, src, "-lpthread", "-lrt"],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, out)  # atomic under concurrent builders
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def load_native_library(name: str) -> Optional[ctypes.CDLL]:
     """Builds (if stale) and dlopens src/<name>.cc -> _build/lib<name>.so."""
     with _lock:
         if name in _cache:
             return _cache[name]
-        src = os.path.join(_SRC_DIR, f"{name}.cc")
-        out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        out = build_native_library(name)
         lib: Optional[ctypes.CDLL] = None
-        try:
-            if _needs_build(src, out):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = out + f".tmp.{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-Wall", "-o", tmp, src, "-lpthread", "-lrt"],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, out)  # atomic under concurrent builders
-            lib = ctypes.CDLL(out)
-        except (OSError, subprocess.SubprocessError):
-            lib = None
+        if out is not None:
+            try:
+                lib = ctypes.CDLL(out)
+            except OSError:
+                lib = None
         _cache[name] = lib
         return lib

@@ -346,12 +346,28 @@ class PyObjectStore:
         self._objects.clear()
 
 
-def create_store(name: str, capacity: int):
-    """Creates a node store, preferring the native arena."""
+def create_store(name: str, capacity: int, spill_dir: Optional[str] = None,
+                 high_watermark: float = 0.85, low_watermark: float = 0.60,
+                 owner_quota: int = 0):
+    """Creates a node store, preferring the native arena. With a
+    ``spill_dir`` the store is wrapped in the spill policy
+    (``_private/spill.SpillingStore``): memory pressure spills cold objects
+    to disk instead of surfacing StoreFullError."""
     try:
-        return ShmObjectStore(name, capacity, create=True)
+        base = ShmObjectStore(name, capacity, create=True)
     except OSError:
-        return PyObjectStore(name, capacity)
+        base = PyObjectStore(name, capacity)
+    if spill_dir:
+        from .._private.spill import SpillingStore, SpillManager
+
+        try:
+            return SpillingStore(base, SpillManager(spill_dir),
+                                 high_watermark=high_watermark,
+                                 low_watermark=low_watermark,
+                                 owner_quota=owner_quota)
+        except OSError:
+            return base  # unwritable spill dir: degrade to arena-only
+    return base
 
 
 def open_store(name: str):

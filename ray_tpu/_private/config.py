@@ -37,6 +37,19 @@ class Config:
     object_store_memory: int = 2 * 1024**3
     object_transfer_chunk_bytes: int = 1024 * 1024  # ref ray_config_def.h:242
     free_objects_batch_size: int = 100
+    # Spill-to-disk under memory pressure (reference: plasma
+    # external_store.h + quota_aware_policy.cc). Arena use above the high
+    # watermark spills cold unpinned sealed objects down to the low one;
+    # producers over the high watermark back off (bounded) before putting.
+    object_spill_enabled: bool = True
+    object_spill_dir: str = ""  # "" => <tmpdir>/ray_tpu_spill/<store name>
+    object_spill_high_watermark: float = 0.85
+    object_spill_low_watermark: float = 0.60
+    # Per-owner arena byte quota, LRU-within-owner enforced (0 = off).
+    object_store_owner_quota: int = 0
+    # Owner-side put backpressure: bounded wait (exponential backoff) while
+    # the node is over its spill high watermark. 0 disables the wait.
+    put_backpressure_max_wait_s: float = 2.0
     # Owner-side refcount GC (reference: core_worker/reference_count.h:33)
     ref_counting_enabled: bool = True
     # --- tasks / actors ---

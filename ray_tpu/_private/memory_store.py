@@ -37,25 +37,87 @@ class StoredObject:
 
 
 class MemoryStore:
-    def __init__(self, max_bytes: int = 0):
+    def __init__(self, max_bytes: int = 0, spiller=None):
+        """``spiller``: an optional ``_private.spill.SpillManager``. With
+        one attached, puts over budget spill the oldest picklable values to
+        disk (same graceful-degradation contract as the shared-memory
+        arena's SpillingStore) instead of raising ObjectStoreFullError;
+        gets transparently restore. Error objects and values that fail to
+        pickle stay resident (the budget is best-effort for them)."""
         self._objects: Dict[ObjectID, StoredObject] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._listeners: Dict[ObjectID, List[Callable[[ObjectID], None]]] = {}
         self._max_bytes = max_bytes
         self._used_bytes = 0
+        self._spiller = spiller
+        self._spilled: Dict[ObjectID, int] = {}  # oid -> spilled nbytes
+        self._unspillable: set = set()  # values that failed to pickle
+
+    # -- spill ----------------------------------------------------------------
+    def _spill_lru_locked(self, need: int) -> None:
+        """Move the oldest spillable values to disk until ``need`` more
+        bytes fit (insertion order ~= LRU for an immutable store). Lock
+        held by the caller."""
+        if self._spiller is None or not self._max_bytes:
+            return
+        import cloudpickle
+
+        for oid in list(self._objects):
+            if self._used_bytes + need <= self._max_bytes:
+                return
+            obj = self._objects[oid]
+            if obj.error is not None or oid in self._unspillable:
+                continue  # errors stay resident (tiny, must re-raise)
+            try:
+                blob = cloudpickle.dumps(obj.value)
+            except Exception:  # noqa: BLE001 - unpicklable: pin resident
+                self._unspillable.add(oid)
+                continue
+            try:
+                self._spiller.write(oid.binary(), blob)
+            except OSError:
+                return  # spill disk full/unwritable: stop trying
+            self._spilled[oid] = obj.nbytes
+            del self._objects[oid]
+            self._used_bytes -= obj.nbytes
+
+    def _restore_locked(self, object_id: ObjectID) -> Optional[StoredObject]:
+        """Disk-second half of get: unpickle a spilled value back into the
+        store (spilling others if the budget demands). Lock held."""
+        if self._spiller is None or object_id not in self._spilled:
+            return None
+        import pickle
+
+        blob = self._spiller.read(object_id.binary())
+        nbytes = self._spilled.pop(object_id)
+        if blob is None:
+            return None  # torn/corrupt copy: lost (recovery is upstream)
+        obj = StoredObject(value=pickle.loads(blob), nbytes=nbytes)
+        if self._max_bytes and self._used_bytes + nbytes > self._max_bytes:
+            self._spill_lru_locked(nbytes)
+        self._objects[object_id] = obj
+        self._used_bytes += nbytes
+        self._spiller.delete(object_id.binary())
+        return obj
 
     # -- write ----------------------------------------------------------------
     def put(self, object_id: ObjectID, obj: StoredObject) -> None:
         with self._lock:
             existing = self._objects.get(object_id)
-            if existing is not None:
+            if existing is not None or object_id in self._spilled:
                 return  # objects are immutable; double-put is a no-op
             if self._max_bytes and self._used_bytes + obj.nbytes > self._max_bytes:
+                self._spill_lru_locked(obj.nbytes)
+            if self._max_bytes and self._spiller is None \
+                    and self._used_bytes + obj.nbytes > self._max_bytes:
                 raise ObjectStoreFullError(
                     f"object store over budget: {self._used_bytes + obj.nbytes} "
                     f"> {self._max_bytes} bytes"
                 )
+            # With a spiller the budget is soft: when even spilling could
+            # not make room (everything unspillable) the put still lands —
+            # degradation, not failure.
             self._objects[object_id] = obj
             self._used_bytes += obj.nbytes
             listeners = self._listeners.pop(object_id, [])
@@ -69,15 +131,21 @@ class MemoryStore:
                 obj = self._objects.pop(oid, None)
                 if obj is not None:
                     self._used_bytes -= obj.nbytes
+                if self._spilled.pop(oid, None) is not None:
+                    self._spiller.delete(oid.binary())
+                self._unspillable.discard(oid)
 
     # -- read -----------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
-            return object_id in self._objects
+            return object_id in self._objects or object_id in self._spilled
 
     def get_if_exists(self, object_id: ObjectID) -> Optional[StoredObject]:
         with self._lock:
-            return self._objects.get(object_id)
+            obj = self._objects.get(object_id)
+            if obj is None:
+                obj = self._restore_locked(object_id)
+            return obj
 
     def get(self, object_ids: Sequence[ObjectID],
             timeout: Optional[float] = None) -> List[StoredObject]:
@@ -85,6 +153,9 @@ class MemoryStore:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
+                for oid in object_ids:
+                    if oid not in self._objects:
+                        self._restore_locked(oid)
                 missing = [oid for oid in object_ids if oid not in self._objects]
                 if not missing:
                     return [self._objects[oid] for oid in object_ids]
@@ -104,7 +175,8 @@ class MemoryStore:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
-                ready = [oid for oid in object_ids if oid in self._objects]
+                ready = [oid for oid in object_ids
+                         if oid in self._objects or oid in self._spilled]
                 if len(ready) >= num_returns:
                     ready_set = set(ready[:num_returns])
                     # preserve input order in both lists
@@ -127,7 +199,7 @@ class MemoryStore:
                      callback: Callable[[ObjectID], None]) -> None:
         """Invoke callback when object_id becomes available (maybe immediately)."""
         with self._lock:
-            if object_id in self._objects:
+            if object_id in self._objects or object_id in self._spilled:
                 fire = True
             else:
                 self._listeners.setdefault(object_id, []).append(callback)
@@ -142,4 +214,6 @@ class MemoryStore:
                 "num_objects": len(self._objects),
                 "used_bytes": self._used_bytes,
                 "max_bytes": self._max_bytes,
+                "spilled_objects": len(self._spilled),
+                "spilled_bytes": sum(self._spilled.values()),
             }

@@ -478,7 +478,21 @@ class LocalRuntime:
         self.node_id = NodeID.from_random()
         self.job_id = job_id or JobID.from_int(1)
         self.driver_task_id = TaskID.for_driver_task(self.job_id)
-        self.store = MemoryStore(max_bytes=config.object_store_memory)
+        # Same graceful-degradation contract as the cluster arena: a
+        # spiller turns budget overruns into disk spill instead of
+        # ObjectStoreFullError (reference: plasma external store).
+        self._spiller = None
+        if getattr(config, "object_spill_enabled", False):
+            from .spill import SpillManager, resolve_spill_dir
+
+            spill_dir = resolve_spill_dir(
+                config, f"local-{self.node_id.hex()[:12]}")
+            try:
+                self._spiller = SpillManager(spill_dir)
+            except OSError:
+                self._spiller = None
+        self.store = MemoryStore(max_bytes=config.object_store_memory,
+                                 spiller=self._spiller)
         self.node = NodeResources(resources)
         self.events = _EventLog(config.event_log_enabled)
         self.serialization = get_serialization_context()
@@ -983,6 +997,8 @@ class LocalRuntime:
         for actor in actors:
             actor.kill()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._spiller is not None:
+            self._spiller.close(remove=True)
 
 
 def _sizeof(value: Any) -> int:

@@ -69,8 +69,24 @@ class NodeController:
         self.server = RpcServer(host, port)
         # Shared-memory arena (the plasma equivalent, ray_tpu/_native):
         # workers on this host attach by name and read/write zero-copy.
+        # With spill enabled the arena is wrapped in the spill policy
+        # (_private/spill.SpillingStore): memory pressure moves cold
+        # unpinned objects to the node's spill directory instead of
+        # surfacing StoreFullError; get() restores arena-first/disk-second.
         self.store_name = f"rtps-{self.node_id[:12]}"
-        self.store = create_store(self.store_name, config.object_store_memory)
+        from .._private.spill import resolve_spill_dir
+
+        self.store = create_store(
+            self.store_name, config.object_store_memory,
+            spill_dir=resolve_spill_dir(config, self.store_name),
+            high_watermark=getattr(config, "object_spill_high_watermark",
+                                   0.85),
+            low_watermark=getattr(config, "object_spill_low_watermark", 0.60),
+            owner_quota=getattr(config, "object_store_owner_quota", 0))
+        self._spilling = hasattr(self.store, "set_spill_callbacks")
+        if self._spilling:
+            self.store.set_spill_callbacks(on_spill=self._on_object_spilled,
+                                           on_restore=self._on_object_restored)
         self._overflow: Dict[bytes, bytes] = {}  # blobs too big for the arena
         # Native data plane (reference: ObjectManager's dedicated transfer
         # service): a C++ thread streaming arena bytes peer-to-peer. Absent
@@ -246,6 +262,17 @@ class NodeController:
                 self._gcs.send_oneway({
                     "type": "heartbeat", "node_id": self.node_id,
                 })
+                if self._spilling:
+                    # Watermark maintenance: keep arena headroom for the
+                    # zero-copy writers that bypass the wrapper (same-host
+                    # workers), so pressure lands on the spiller, not the
+                    # native evictor (which drops bytes). Off-loop: spill
+                    # writes fsync.
+                    st = self.store.base.stats()
+                    cap = st.get("capacity") or st.get("arena_bytes") or 0
+                    if cap and st.get("used_bytes", 0) > \
+                            cap * self.store.high_watermark:
+                        await asyncio.to_thread(self.store.maybe_spill)
                 now = time.monotonic()
                 if now - last_refresh > 2.0 and self._ref_held_calls:
                     last_refresh = now
@@ -377,9 +404,36 @@ class NodeController:
         except ConnectionError:
             pass
 
-    async def _store_put(self, oid: bytes, blob: bytes):
+    def _on_object_spilled(self, oid: bytes, size: int) -> None:
+        """SpillingStore moved an object arena->disk: flip this node's
+        directory entry to the SPILLED location state (the object stays
+        fetchable here — the fetch path restores it transparently).
+        Thread-safe: only touches the (locked) GCS client."""
         try:
-            self.store.put(oid, blob)  # immutable; double-put is a no-op
+            self._gcs.send_oneway({
+                "type": "object_spilled", "object_id": oid,
+                "node_id": self.node_id, "size": size,
+            })
+        except ConnectionError:
+            pass
+
+    def _on_object_restored(self, oid: bytes, size: int) -> None:
+        """SpillingStore migrated a spilled object back into the arena:
+        re-register the in-memory location (runs on the event loop — every
+        restore-triggering get happens there)."""
+        self._register_object(oid, size)
+
+    async def _store_put(self, oid: bytes, blob: bytes,
+                         owner: Optional[str] = None):
+        try:
+            if self._spilling:
+                # Off-loop: a put under pressure spills cold objects to
+                # disk first (fsync'd writes must not stall the RPC loop).
+                # The wrapper is internally locked; per-connection FIFO
+                # keeps the register-before-finish invariant.
+                await asyncio.to_thread(self.store.put, oid, blob, owner)
+            else:
+                self.store.put(oid, blob)  # immutable; double-put is a no-op
         except Exception:  # noqa: BLE001 - blob exceeds the arena: overflow
             # Plasma's external-store spill path (plasma/external_store.h):
             # objects that can't fit in shared memory still must be storable.
@@ -657,6 +711,8 @@ class NodeController:
             coro = self._cancel_task(msg["task_id"], msg.get("force", False))
         elif mtype == "delete_objects":
             coro = self._delete_objects(msg["object_ids"])
+        elif mtype == "restore_object":
+            coro = self._restore_object(msg["object_id"])
         elif mtype == "pubsub":
             return
         else:
@@ -722,6 +778,18 @@ class NodeController:
             self.local_avail[k] = min(
                 self.local_avail.get(k, 0.0) + v, self.resources.get(k, v))
         self._admit_event.set()
+
+    async def _restore_object(self, oid: bytes) -> None:
+        """Restore a spilled object into the arena and re-register it
+        (reference: ObjectRecovery's restore-from-external-store path). A
+        no-op when the object is gone — recovery then falls back to
+        lineage on the GCS side."""
+        # Inline on the loop: the restore path touches asyncio waiter
+        # events via the on_restore callback, which must not fire from a
+        # foreign thread. Restores are rare and read-mostly.
+        blob = self._local_blob(oid)
+        if blob is not None:
+            self._register_object(oid, len(blob))
 
     async def _delete_objects(self, oids) -> None:
         for oid in oids:
@@ -889,7 +957,16 @@ class NodeController:
 
         @s.handler("store_object")
         async def store_object(msg, conn):
-            await self._store_put(msg["object_id"], msg["blob"])
+            await self._store_put(msg["object_id"], msg["blob"],
+                                  owner=msg.get("owner"))
+            return {"ok": True}
+
+        @s.handler("restore_object")
+        async def restore_object(msg, conn):
+            """Explicit restore request (GCS recovery preferring a spilled
+            copy over lineage re-execution). The get is the restore; the
+            registration re-adds the in-arena location."""
+            await self._restore_object(msg["object_id"])
             return {"ok": True}
 
         @s.handler("object_added")

@@ -53,7 +53,13 @@ class ClusterCoreWorker:
         self.role = role
         self.gcs = ResilientClient(*gcs_addr)
         self.gcs_addr = gcs_addr
-        self.job_id = JobID.from_int(int(time.time()) & 0x7FFFFFFF)
+        # Random, NOT time-derived: two drivers initialized within the
+        # same second would otherwise share a job id — and therefore the
+        # whole deterministic task/object id sequence — so the GCS's
+        # idempotent submit_task dedupe would silently serve one driver
+        # the other's stale results (observed as cross-test contamination
+        # against a shared cluster).
+        self.job_id = JobID.from_random()
         self.driver_task_id = TaskID.for_driver_task(self.job_id)
         self.events = _EventLog(self.config.event_log_enabled)
         self._thread_scope_counter = itertools.count(1 << 31)
@@ -776,12 +782,49 @@ class ClusterCoreWorker:
             self.put_blob(oid, blob)
 
     # ---------------------------------------------------------------- objects
+    def _put_backpressure(self, nbytes: int) -> None:
+        """Owner-side bounded wait while this node's arena is over its
+        spill high watermark (reference: plasma client create retries under
+        quota pressure). Gives the controller's spiller time to make room;
+        never blocks past the configured bound — the store-side spill path
+        absorbs what still doesn't fit."""
+        if self.local_store is None:
+            return
+        cfg = self.config
+        max_wait = getattr(cfg, "put_backpressure_max_wait_s", 0.0)
+        if not getattr(cfg, "object_spill_enabled", False) or max_wait <= 0:
+            return
+        from .._private.spill import put_backpressure
+
+        put_backpressure(
+            self.local_store.stats, nbytes,
+            high_watermark=getattr(cfg, "object_spill_high_watermark", 0.85),
+            max_wait_s=max_wait)
+
+    def arena_admits(self, nbytes: int) -> bool:
+        """Whether a direct (zero-copy) arena write of ``nbytes`` stays
+        under the spill high watermark. Over it, writers must route through
+        the controller so pressure lands on the spiller (which preserves
+        bytes on disk) instead of the native evictor (which drops them)."""
+        if self.local_store is None:
+            return False
+        if not getattr(self.config, "object_spill_enabled", False):
+            return True
+        try:
+            st = self.local_store.stats()
+        except Exception:  # noqa: BLE001 - stats must never fail a put
+            return True
+        cap = st.get("capacity") or st.get("arena_bytes") or 0
+        high = getattr(self.config, "object_spill_high_watermark", 0.85)
+        return cap <= 0 or st.get("used_bytes", 0) + nbytes <= cap * high
+
     def put_blob(self, oid: bytes, blob: bytes) -> None:
         """Store one serialized blob: straight into the same-host shm arena
         (notifying the controller) when attached, else over RPC. The single
         write path for puts, task results, and error blobs."""
         controller = self._home_controller()
-        if self.local_store is not None:
+        self._put_backpressure(len(blob))
+        if self.local_store is not None and self.arena_admits(len(blob)):
             try:
                 self.local_store.put(oid, blob)
                 # One-way: the blob is already durable in the arena; the
@@ -795,7 +838,7 @@ class ClusterCoreWorker:
             except Exception:  # noqa: BLE001 - arena full: RPC/overflow path
                 pass
         controller.call({"type": "store_object", "object_id": oid,
-                         "blob": blob})
+                         "blob": blob, "owner": self.worker_uid})
 
     def put(self, value: Any) -> ObjectRef:
         ctx = ensure_context(self)
@@ -813,7 +856,12 @@ class ClusterCoreWorker:
             # create/seal), skipping the intermediate flat bytes copy.
             size = 1 + sobj.framed_size()
             try:
-                view = self.local_store.create(oid.binary(), size)
+                # Over the high watermark the direct write is skipped and
+                # put_blob below takes over (backpressure wait + the
+                # controller spill-to-make-room route) instead of the
+                # native evictor dropping cold objects.
+                view = (self.local_store.create(oid.binary(), size)
+                        if self.arena_admits(size) else None)
             except Exception:  # noqa: BLE001 - arena full etc.
                 view = None
             if view is not None:

@@ -89,6 +89,9 @@ class GcsServer:
         # free_objects call) must not resurrect the object in the directory.
         self._freed: Set[bytes] = set()
         self._freed_order: Any = _deque()
+        # Restore-from-spill debounce: oid -> last restore_object push time
+        # (recovery probes run per poll tick; one push per window suffices).
+        self._restore_requested: Dict[bytes, float] = {}
         # ---- Distributed reference counting (reference:
         # reference_count.h:33 owner/borrower; WaitForRefRemoved of
         # core_worker.proto:322 becomes holder registration against this
@@ -332,11 +335,26 @@ class GcsServer:
         self._spawn(self._drive_task(rec))
         return rec
 
+    @staticmethod
+    def _spilled_set(entry: Dict[str, Any]) -> Set[str]:
+        """Nodes holding only a SPILLED (on-disk) copy. Accessor tolerant
+        of entries restored from pre-spill snapshots."""
+        spilled = entry.get("spilled")
+        if spilled is None:
+            spilled = entry["spilled"] = set()
+        return spilled
+
+    def _alive_nodes(self, node_ids) -> List[str]:
+        return [n for n in sorted(node_ids)
+                if n in self.nodes and self.nodes[n].alive]
+
     def _dep_alive(self, oid: bytes) -> bool:
+        # A SPILLED copy counts: the holding node restores it from disk on
+        # fetch, which the consuming node's pull path does transparently.
         entry = self.objects.get(oid)
         return bool(entry) and any(
             n in self.nodes and self.nodes[n].alive
-            for n in entry["locations"]
+            for n in (*entry["locations"], *self._spilled_set(entry))
         )
 
     async def _wait_deps(self, rec: Dict[str, Any]) -> bool:
@@ -607,8 +625,11 @@ class GcsServer:
         pins (re-arming the GC clock for cascade-orphaned children). Shared
         by free() and the ref GC. Returns the node ids that held a copy."""
         self._ref_zero_since.pop(oid, None)
+        self._restore_requested.pop(oid, None)
         entry = self.objects.pop(oid, None)
-        holders = list(entry["locations"]) if entry else []
+        # SPILLED holders must delete their disk copies too.
+        holders = (sorted({*entry["locations"], *self._spilled_set(entry)})
+                   if entry else [])
         tid = self.lineage.pop(oid, None)
         rec = self.task_table.get(tid) if tid else None
         if rec is not None and rec["state"] == "FINISHED" and all(
@@ -654,8 +675,27 @@ class GcsServer:
                     pass
 
     def _maybe_recover_object(self, oid: bytes) -> bool:
-        """A wanted object has no live copy: re-execute its producing task
-        from lineage (reference: ReconstructionPolicy + ObjectRecovery)."""
+        """A wanted object has no live in-arena copy: prefer restoring a
+        SPILLED on-disk copy (cheap, exact bytes) over re-executing the
+        producing task from lineage (reference: ReconstructionPolicy +
+        ObjectRecovery, which likewise consults the external store first)."""
+        entry = self.objects.get(oid)
+        if entry is not None:
+            for nid in self._alive_nodes(self._spilled_set(entry)):
+                conn = self._node_conns.get(nid)
+                if conn is None:
+                    continue
+                # Debounce: one restore push per object per window — this
+                # probe runs per poll tick while consumers wait.
+                now = time.monotonic()
+                last = self._restore_requested.get(oid, 0.0)
+                if now - last > 2.0:
+                    self._restore_requested[oid] = now
+                    while len(self._restore_requested) > 100_000:
+                        self._restore_requested.pop(
+                            next(iter(self._restore_requested)))
+                    self._spawn(self._push_restore(conn, oid))
+                return True
         task_id = self.lineage.get(oid)
         rec = self.task_table.get(task_id) if task_id else None
         if rec is None or rec["cancelled"]:
@@ -668,6 +708,12 @@ class GcsServer:
             return True
         # PENDING/DISPATCHED: already in flight; FAILED: error served.
         return rec["state"] in ("PENDING", "DISPATCHED")
+
+    async def _push_restore(self, conn: Connection, oid: bytes) -> None:
+        try:
+            await conn.send({"type": "restore_object", "object_id": oid})
+        except Exception:  # noqa: BLE001 - controller re-dials; next probe
+            pass
 
     async def _actor_died(self, actor_id, info: Dict[str, Any],
                           no_restart: bool) -> None:
@@ -739,7 +785,8 @@ class GcsServer:
         self.node_stats.pop(node.node_id, None)  # reporter data dies with it
         for oid, entry in list(self.objects.items()):
             entry["locations"].discard(node.node_id)
-            if not entry["locations"]:
+            self._spilled_set(entry).discard(node.node_id)
+            if not entry["locations"] and not entry["spilled"]:
                 del self.objects[oid]
         # Tasks still sitting in this node's UNSENT dispatch buffer — or in
         # a pending batch whose send was never even attempted (conn-rebind
@@ -1289,9 +1336,23 @@ class GcsServer:
                     if probe_recovery:
                         self._maybe_recover_object(oid)
                     continue
-                alive = [n for n in sorted(entry["locations"])
-                         if n in self.nodes and self.nodes[n].alive]
+                alive = self._alive_nodes(entry["locations"])
                 if not alive:
+                    # SPILLED copies are fetchable too: the holder restores
+                    # from disk on fetch. No native-plane endpoint (the
+                    # bytes are not in its arena) — port 0 forces the RPC
+                    # path, which is the restore path.
+                    spilled = self._alive_nodes(self._spilled_set(entry))
+                    if spilled:
+                        out[oid] = {
+                            "addresses": [list(self.nodes[n].address)
+                                          for n in spilled],
+                            "transfer_addresses": [
+                                [self.nodes[n].address[0], 0]
+                                for n in spilled],
+                            "spilled": True,
+                        }
+                        continue
                     if probe_recovery:
                         self._maybe_recover_object(oid)
                     continue
@@ -1515,6 +1576,35 @@ class GcsServer:
                 oid, {"locations": set(), "size": msg.get("size", 0)}
             )
             entry["locations"].add(msg["node_id"])
+            # Back in an arena: the node's SPILLED marker (if any) is stale.
+            self._spilled_set(entry).discard(msg["node_id"])
+            self._restore_requested.pop(oid, None)
+            for ev in self._object_waiters.pop(oid, []):
+                ev.set()
+            return None
+
+        @s.handler("object_spilled")
+        async def object_spilled(msg, conn):
+            """A node moved its arena copy to its spill directory: flip the
+            location to the SPILLED state. The object remains available
+            (the node restores on fetch), so no waiters fire and no
+            recovery triggers."""
+            oid = msg["object_id"]
+            if oid in self._freed:
+                node_conn = self._node_conns.get(msg["node_id"])
+                if node_conn is not None:
+                    try:
+                        await node_conn.send({"type": "delete_objects",
+                                              "object_ids": [oid]})
+                    except Exception:  # noqa: BLE001
+                        pass
+                return None
+            entry = self.objects.setdefault(
+                oid, {"locations": set(), "size": msg.get("size", 0)}
+            )
+            entry["locations"].discard(msg["node_id"])
+            self._spilled_set(entry).add(msg["node_id"])
+            # A spilled copy still satisfies waiters (fetchable via RPC).
             for ev in self._object_waiters.pop(oid, []):
                 ev.set()
             return None
@@ -1548,8 +1638,6 @@ class GcsServer:
                 locations = sorted(entry["locations"]) if entry else []
                 alive = [n for n in locations
                          if n in self.nodes and self.nodes[n].alive]
-                if not alive and locations:
-                    self._maybe_recover_object(oid)
                 addrs = [list(self.nodes[n].address) for n in alive]
                 # Parallel list: the native data-plane endpoint per location
                 # ([host, transfer_port]; port 0 = no native plane there).
@@ -1557,6 +1645,18 @@ class GcsServer:
                     [self.nodes[n].address[0], self.nodes[n].transfer_port]
                     for n in alive
                 ]
+                if not alive and entry is not None:
+                    # Disk-second: SPILLED holders serve (and restore) the
+                    # object over the RPC fetch path.
+                    spilled = self._alive_nodes(self._spilled_set(entry))
+                    if spilled:
+                        locations = spilled
+                        addrs = [list(self.nodes[n].address)
+                                 for n in spilled]
+                        transfer = [[self.nodes[n].address[0], 0]
+                                    for n in spilled]
+                if not addrs and locations:
+                    self._maybe_recover_object(oid)
                 return {"ok": True, "locations": locations,
                         "addresses": addrs, "transfer_addresses": transfer}
 
@@ -1678,11 +1778,12 @@ class GcsServer:
         @s.handler("remove_object_location")
         async def remove_object_location(msg, conn):
             """One node retracts its copy (LRU eviction / local delete);
-            other replicas stay valid."""
+            other replicas — including SPILLED ones — stay valid."""
             entry = self.objects.get(msg["object_id"])
             if entry is not None:
                 entry["locations"].discard(msg["node_id"])
-                if not entry["locations"]:
+                self._spilled_set(entry).discard(msg["node_id"])
+                if not entry["locations"] and not entry["spilled"]:
                     self.objects.pop(msg["object_id"], None)
             return None
 
@@ -1795,6 +1896,7 @@ class GcsServer:
             for oid, info in list(self.objects.items())[:msg.get("limit", 1000)]:
                 out[oid.hex() if isinstance(oid, bytes) else str(oid)] = {
                     "locations": list(info.get("locations", [])),
+                    "spilled": list(info.get("spilled", [])),
                     "size": info.get("size", 0),
                 }
             return {"ok": True, "objects": out}

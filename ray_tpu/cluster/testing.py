@@ -50,12 +50,19 @@ class ClusterNode:
         self._unlink_store()
 
     def _unlink_store(self):
-        """SIGKILL skips the controller's atexit unlink; reap the arena."""
+        """SIGKILL skips the controller's atexit unlink; reap the arena
+        and the node's spill directory (the crash-scan recovery files
+        matter for a RESTARTED controller, not a test-killed one)."""
         if self.node_id:
             try:
                 os.unlink(f"/dev/shm/rtps-{self.node_id[:12]}")
             except OSError:
                 pass
+            import shutil
+
+            spill_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_spill",
+                                     f"rtps-{self.node_id[:12]}")
+            shutil.rmtree(spill_dir, ignore_errors=True)
 
 
 class Cluster:

@@ -158,8 +158,10 @@ def main():
 
     def _store_blob(oid: bytes, blob: bytes) -> None:
         """Arena write with DEFERRED registration (falls back to the
-        immediate path when the arena is unavailable/full)."""
-        if core.local_store is not None:
+        immediate path when the arena is unavailable/full — or over the
+        spill high watermark, where the controller route spills cold
+        objects to disk instead of the native evictor dropping them)."""
+        if core.local_store is not None and core.arena_admits(len(blob)):
             try:
                 core.local_store.put(oid, blob)
                 _pending_adds.setdefault(

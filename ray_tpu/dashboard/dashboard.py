@@ -83,15 +83,21 @@ async function refresh() {
        "memory","serve","timeline"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
-          "<th>mem</th><th>load</th><th>store objs</th><th>workers (pid: cpu%, MB)</th></tr>";
+          "<th>mem</th><th>load</th><th>store objs</th>" +
+          "<th>spilled</th><th>workers (pid: cpu%, MB)</th></tr>";
   for (const [nid, s] of Object.entries(nstats)) {
     const ws = (s.workers || []).map(
       w => `${w.pid}: ${w.cpu_percent}%, ${(w.rss_bytes/1048576).toFixed(0)}MB`
     ).join("<br>");
+    const st = s.store || {};
+    const spilled = st.spilled_bytes != null
+      ? `${(st.spilled_bytes/1048576).toFixed(1)}MB (${st.spilled_objects})`
+      : "-";
     h += `<tr><td>${nid.slice(0,12)}</td><td>${bar(s.cpu_percent)}</td>` +
          `<td>${bar(s.mem_percent)}</td>` +
          `<td>${(s.load_avg||[0])[0].toFixed(2)}</td>` +
-         `<td class=num>${(s.store||{}).num_objects ?? "-"}</td><td>${ws}</td></tr>`;
+         `<td class=num>${st.num_objects ?? "-"}</td>` +
+         `<td class=num>${spilled}</td><td>${ws}</td></tr>`;
   }
   h += "</table><h2>resources</h2><table><tr><th>kind</th><th>total</th><th>available</th></tr>";
   for (const k of Object.keys(resources.total))

@@ -125,6 +125,19 @@ class Histogram(Metric):
 Sum = Count
 
 
+def get_or_create(cls, name: str, **kwargs) -> "Metric":
+    """Idempotent registration: returns the already-registered metric when
+    one of the same type exists (re-instantiating would silently reset its
+    accumulated values), else registers a fresh one. The shared pattern for
+    library-internal metrics (e.g. the object-store spill counters) that
+    may be touched from several modules."""
+    with _LOCK:
+        existing = _REGISTRY.get(name)
+        if existing is not None and type(existing) is cls:
+            return existing
+    return cls(name, **kwargs)
+
+
 def collect_all() -> Dict[str, Dict]:
     """Snapshot every registered metric (the dashboard's /api/metrics)."""
     with _LOCK:

@@ -305,7 +305,7 @@ class GenerationEngine:
     def _zeros_kv(self, shape: tuple) -> jax.Array:
         """Allocate one KV store array, SHARDED AT CREATION when a mesh is
         set: the multi-chip decode layout (kv-heads on the tp axis, the
-        4th-from-last dim of both the contiguous [L, slots, seq, KH, Dh]
+        2nd-from-last dim of both the contiguous [L, slots, seq, KH, Dh]
         cache and the paged [L, pages, ps, KH, Dh] pool) is defined HERE,
         once, for both engines. Allocating unsharded + device_put would
         transiently materialise the full pool on one device — an N x

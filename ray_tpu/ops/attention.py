@@ -26,6 +26,26 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def unsharded_operands(*arrays) -> bool:
+    """True when every operand is addressable on a single device (or its
+    placement can't be inspected — tracers inside jit keep today's
+    behavior). The decode pallas kernels are verified on single-device
+    operands only; a committed multi-device sharding must take the XLA
+    path, which partitions correctly under SPMD, until the kernels are
+    validated under a real sharded mesh."""
+    for a in arrays:
+        try:
+            sharding = a.sharding  # raises/absent on tracers & non-arrays
+        except Exception:  # noqa: BLE001 - tracer or non-jax input
+            continue
+        try:
+            if len(sharding.device_set) > 1:
+                return False
+        except Exception:  # noqa: BLE001 - exotic sharding: assume fine
+            continue
+    return True
+
+
 def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
     """[B, S, KH, D] -> [B, S, H, D] by repeating each kv head."""
     kh = k.shape[2]
@@ -602,7 +622,7 @@ def decode_attention(q, k, v, lengths, *, block_k: int = 512,
     # the XLA path rather than risk an untileable (1, G, D) block.
     tiles = (S % bk == 0 and D % 128 == 0 and bk % 128 == 0
              and H % KH == 0 and G % 8 == 0)
-    if on_tpu and tiles:
+    if on_tpu and tiles and unsharded_operands(q, k, v):
         return _flash_decode(q, k, v, lengths, bk,
                              truncate_dma=truncate_dma)
     mask = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, :]

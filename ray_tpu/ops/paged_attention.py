@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .attention import _decode_kernel, masked_gqa_attention
+from .attention import _decode_kernel, masked_gqa_attention, \
+    unsharded_operands
 from . import attention as _att
 
 
@@ -58,7 +59,10 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     G = H // max(KH, 1)
     on_tpu = jax.default_backend() not in ("cpu", "gpu")
     tiles = (D % 128 == 0 and ps % 128 == 0 and H % KH == 0 and G % 8 == 0)
-    if on_tpu and tiles:
+    # Sharded operands (kv heads on a tp mesh axis) take the XLA path: the
+    # paged kernel's scalar-prefetched page routing is only verified on
+    # single-device operands so far.
+    if on_tpu and tiles and unsharded_operands(q, k_pages, v_pages):
         return _paged_flash_decode(q, k_pages, v_pages, page_table, lengths)
     buf_k = paged_gather(k_pages, page_table)
     buf_v = paged_gather(v_pages, page_table)

@@ -41,7 +41,8 @@ def objects() -> Dict[str, Dict[str, Any]]:
         resp = gcs.call({"type": "list_objects", "limit": 1_000_000})
         return {
             hex_id: {"size_bytes": info.get("size", 0), "has_error": False,
-                     "locations": info.get("locations", [])}
+                     "locations": info.get("locations", []),
+                     "spilled": info.get("spilled", [])}
             for hex_id, info in resp.get("objects", {}).items()
         }
     out = {}

@@ -284,6 +284,17 @@ def main():
     try:
         with open(path) as f:
             prev = json.load(f)
+        if prev.get("complete"):
+            # A re-measure is about to start overwriting a COMPLETE
+            # artifact section by section: keep a .prev copy so an aborted
+            # re-measure (tunnel drop after the first persist) can't
+            # destroy the last complete capture.
+            import shutil
+
+            try:
+                shutil.copyfile(path, path + ".prev")
+            except OSError:
+                pass
         # Resume ONLY an INCOMPLETE same-config capture (a tunnel drop
         # mid-run): a complete artifact that the daemon decided is stale
         # must be fully re-measured — resuming it would be a no-op that

@@ -32,6 +32,38 @@ def _build_and_run(tmp_path, src_name: str):
     assert "runtime error" not in run.stderr  # UBSAN
 
 
+def test_working_set_exceeds_arena_via_spill(tmp_path):
+    """Working set >> arena completes with zero StoreFullError: the spill
+    wrapper moves cold objects to disk BEFORE the native evictor (which
+    would drop their bytes) and restores them arena-first/disk-second."""
+    import uuid
+
+    from ray_tpu._native.build import load_native_library
+    from ray_tpu._native.shm_store import ShmObjectStore
+    from ray_tpu._private.spill import SpillManager, SpillingStore
+
+    if load_native_library("shm_store") is None:
+        pytest.skip("native shm_store failed to build")
+
+    def oid(i: int) -> bytes:
+        return i.to_bytes(4, "big") * 6
+
+    base = ShmObjectStore(f"tpsspill-{uuid.uuid4().hex[:12]}",
+                          capacity=8 * 1024 * 1024, create=True)
+    store = SpillingStore(base, SpillManager(str(tmp_path / "spill")))
+    try:
+        blob = os.urandom(1024 * 1024)
+        for i in range(32):  # 32MB through an 8MB arena
+            assert store.put(oid(i), blob)  # never StoreFullError
+        for i in range(32):
+            assert store.get_bytes(oid(i)) == blob, i
+        st = store.stats()
+        assert st["num_spills"] > 0
+        assert st["num_evictions"] == 0  # nothing was lossily evicted
+    finally:
+        store.close()
+
+
 @pytest.mark.slow
 def test_shm_store_asan_stress(tmp_path):
     _build_and_run(tmp_path, "stress_shm.cc")

@@ -1,0 +1,413 @@
+"""Spill subsystem tests (reference surface: plasma external_store +
+quota_aware_policy + ObjectRecovery's restore-from-external-store).
+
+Covers: spill/restore round-trip with checksum verification, the
+crash-restart spill-dir scan, the pinned-never-spilled invariant, per-owner
+quota enforcement, put-backpressure bounded wait, the GCS SPILLED location
+state with restore-preferred-over-lineage recovery, the MemoryStore
+fallback's spill interface, and an end-to-end cluster workload whose
+working set is 4x the arena with zero StoreFullError at the driver.
+"""
+
+import asyncio
+import os
+import time
+import uuid
+
+import pytest
+
+from ray_tpu._native.shm_store import PyObjectStore, StoreFullError
+from ray_tpu._private.spill import (
+    SpillManager,
+    SpillingStore,
+    put_backpressure,
+)
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(4, "big") * 6  # 24 bytes == ObjectID.SIZE
+
+
+def make_store(tmp_path, capacity=1024 * 1024, **kw):
+    base = PyObjectStore(f"spilltest-{uuid.uuid4().hex[:8]}",
+                         capacity=capacity)
+    return SpillingStore(
+        base, SpillManager(str(tmp_path / uuid.uuid4().hex[:8])), **kw)
+
+
+# --------------------------------------------------------------- SpillManager
+def test_spill_roundtrip_and_checksum(tmp_path):
+    mgr = SpillManager(str(tmp_path / "s"))
+    data = os.urandom(100_000)
+    assert mgr.write(oid(1), data) == len(data)
+    assert mgr.contains(oid(1))
+    assert mgr.read(oid(1)) == data
+    assert mgr.spilled_bytes == len(data)
+
+    # A corrupted file must be dropped, never served.
+    path = mgr._path(oid(1))
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-4] + b"XXXX")
+    assert mgr.read(oid(1)) is None
+    assert not os.path.exists(path)
+
+
+def test_spill_write_idempotent(tmp_path):
+    mgr = SpillManager(str(tmp_path / "s"))
+    mgr.write(oid(1), b"first")
+    mgr.write(oid(1), b"second")  # immutable: first copy wins
+    assert mgr.read(oid(1)) == b"first"
+
+
+def test_crash_restart_scan(tmp_path):
+    d = str(tmp_path / "s")
+    mgr = SpillManager(d)
+    blobs = {oid(i): os.urandom(10_000) for i in range(3)}
+    for k, v in blobs.items():
+        mgr.write(k, v)
+    # Crash leftovers: a torn tmp file and a truncated entry.
+    with open(os.path.join(d, "deadbeef.tmp"), "wb") as f:
+        f.write(b"torn")
+    trunc = mgr._path(oid(2))
+    raw = open(trunc, "rb").read()
+    with open(trunc, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    # New manager over the same dir (controller restart): valid entries
+    # are indexed, garbage is swept.
+    mgr2 = SpillManager(d)
+    assert mgr2.read(oid(0)) == blobs[oid(0)]
+    assert mgr2.read(oid(1)) == blobs[oid(1)]
+    assert not mgr2.contains(oid(2))
+    assert not os.path.exists(os.path.join(d, "deadbeef.tmp"))
+
+
+# -------------------------------------------------------------- SpillingStore
+def test_working_set_exceeds_capacity_no_storefull(tmp_path):
+    store = make_store(tmp_path, capacity=1024 * 1024)
+    blob = os.urandom(128 * 1024)
+    for i in range(32):  # 4MB into a 1MB store
+        assert store.put(oid(i), blob)
+    for i in range(32):
+        assert store.get_bytes(oid(i)) == blob, i
+    st = store.stats()
+    assert st["num_spills"] > 0
+    assert st["num_evictions"] == 0  # spill preempts lossy eviction
+    assert st["spilled_bytes"] > 0
+
+
+def test_pinned_never_spilled(tmp_path):
+    store = make_store(tmp_path, capacity=1024 * 1024)
+    store.put(oid(0), b"k" * 100_000)
+    pin = store.get(oid(0))
+    blob = os.urandom(200 * 1024)
+    for i in range(1, 20):
+        store.put(oid(i), blob)
+    assert store.in_arena(oid(0))
+    assert not store.is_spilled(oid(0))
+    pin.release()
+    assert store.get_bytes(oid(0)) == b"k" * 100_000
+
+
+def test_spilled_object_restores_into_arena(tmp_path):
+    store = make_store(tmp_path, capacity=1024 * 1024)
+    first = os.urandom(400 * 1024)
+    store.put(oid(0), first)
+    for i in range(1, 8):
+        store.put(oid(i), os.urandom(400 * 1024))
+    assert store.is_spilled(oid(0))  # cold: pushed to disk
+    assert store.get_bytes(oid(0)) == first
+    # arena-first on the next get: the restore migrated it back
+    assert store.in_arena(oid(0))
+    assert not store.is_spilled(oid(0))
+    assert store.stats()["num_restores"] >= 1
+
+
+def test_oversized_object_spills_directly(tmp_path):
+    store = make_store(tmp_path, capacity=256 * 1024)
+    huge = os.urandom(1024 * 1024)  # 4x the whole arena
+    assert store.put(oid(0), huge)  # no StoreFullError
+    assert store.is_spilled(oid(0))
+    assert store.get_bytes(oid(0)) == huge
+
+
+def test_owner_quota_lru_within_owner(tmp_path):
+    store = make_store(tmp_path, capacity=16 * 1024 * 1024,
+                       owner_quota=512 * 1024)
+    blob = os.urandom(200 * 1024)
+    for i in range(5):
+        store.put(oid(i), blob, owner="A")
+        time.sleep(0.001)
+    # A is over quota: its OLDEST objects went to disk, newest stayed.
+    assert store.is_spilled(oid(0))
+    assert store.in_arena(oid(4))
+    assert store._owner_bytes.get("A", 0) <= 512 * 1024
+    assert store.stats()["quota_evictions"] >= 2
+    # An unrelated owner is untouched.
+    store.put(oid(100), blob, owner="B")
+    assert store.in_arena(oid(100))
+    # Spilled-by-quota objects still read back fine.
+    assert store.get_bytes(oid(0)) == blob
+
+
+def test_delete_covers_spilled_copies(tmp_path):
+    store = make_store(tmp_path, capacity=256 * 1024)
+    store.put(oid(0), os.urandom(1024 * 1024))  # lands on disk
+    assert store.is_spilled(oid(0))
+    store.delete(oid(0))
+    assert not store.contains(oid(0))
+    assert store.get_bytes(oid(0)) is None
+
+
+# --------------------------------------------------------------- backpressure
+def test_put_backpressure_bounded_wait():
+    # Over the watermark forever: the wait is bounded by max_wait_s.
+    t0 = time.monotonic()
+    waited = put_backpressure(lambda: {"used_bytes": 100, "capacity": 100},
+                              10, high_watermark=0.85, max_wait_s=0.3)
+    wall = time.monotonic() - t0
+    assert 0.25 <= waited <= 0.4
+    assert wall < 2.0
+
+    # Under the watermark: no wait at all.
+    assert put_backpressure(lambda: {"used_bytes": 0, "capacity": 100},
+                            10, max_wait_s=5.0) == 0.0
+
+    # Pressure releasing mid-wait unblocks early.
+    state = {"used": 100}
+    calls = []
+
+    def stats():
+        calls.append(1)
+        if len(calls) > 3:
+            state["used"] = 0
+        return {"used_bytes": state["used"], "capacity": 100}
+
+    waited = put_backpressure(stats, 10, max_wait_s=10.0)
+    assert waited < 1.0
+
+
+# ----------------------------------------------------- GCS SPILLED state
+def _gcs_fixture():
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster.gcs import GcsServer, NodeEntry
+
+    gcs = GcsServer(Config())
+    nid = "node-1"
+    gcs.nodes[nid] = NodeEntry(nid, ("127.0.0.1", 9999), {"CPU": 4}, index=0)
+    gcs._node_order.append(nid)
+
+    class FakeConn:
+        def __init__(self):
+            self.sent = []
+
+        async def send(self, msg):
+            self.sent.append(msg)
+
+    conn = FakeConn()
+    gcs._node_conns[nid] = conn
+    return gcs, nid, conn
+
+
+def test_gcs_spilled_location_state():
+    async def run():
+        gcs, nid, conn = _gcs_fixture()
+        handlers = gcs.server._handlers
+        await handlers["add_object_location"](
+            {"object_id": oid(1), "node_id": nid, "size": 64}, conn)
+        assert nid in gcs.objects[oid(1)]["locations"]
+
+        await handlers["object_spilled"](
+            {"object_id": oid(1), "node_id": nid, "size": 64}, conn)
+        entry = gcs.objects[oid(1)]
+        assert nid not in entry["locations"]
+        assert nid in entry["spilled"]
+        # A spilled copy still satisfies dependency liveness.
+        assert gcs._dep_alive(oid(1))
+
+        # Location lookups serve the spilled holder over the RPC path
+        # (transfer port 0 keeps the native plane off it).
+        resp_box = []
+        gcs._detach = lambda msg, c, coro: resp_box.append(coro)
+        await handlers["get_object_locations"](
+            {"object_id": oid(1), "wait": False}, conn)
+        resp = await resp_box[0]
+        assert resp["addresses"] == [["127.0.0.1", 9999]]
+        assert resp["transfer_addresses"] == [["127.0.0.1", 0]]
+
+        # Restoring (the node re-adds the location) clears SPILLED.
+        await handlers["add_object_location"](
+            {"object_id": oid(1), "node_id": nid, "size": 64}, conn)
+        entry = gcs.objects[oid(1)]
+        assert nid in entry["locations"]
+        assert nid not in entry["spilled"]
+
+    asyncio.run(run())
+
+
+def test_gcs_prefers_restore_over_lineage():
+    async def run():
+        gcs, nid, conn = _gcs_fixture()
+        # A FINISHED producer in lineage AND a spilled copy on a live node.
+        tid = b"t" * 24
+        rec = {"task_id": tid, "payload": {"deps": []}, "kind": "task",
+               "resources": {}, "retries_left": 1, "state": "FINISHED",
+               "node_id": nid, "cancelled": False, "return_ids": [oid(7)]}
+        gcs.task_table[tid] = rec
+        gcs.lineage[oid(7)] = tid
+        gcs.objects[oid(7)] = {"locations": set(), "size": 10,
+                               "spilled": {nid}}
+
+        assert gcs._maybe_recover_object(oid(7)) is True
+        for _ in range(5):
+            await asyncio.sleep(0)
+        # Restore was pushed; the task was NOT re-driven.
+        assert [m for m in conn.sent if m["type"] == "restore_object"
+                and m["object_id"] == oid(7)]
+        assert rec["state"] == "FINISHED"
+
+        # Debounce: an immediate second probe doesn't re-push.
+        n = len(conn.sent)
+        assert gcs._maybe_recover_object(oid(7)) is True
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert len(conn.sent) == n
+
+        # Without a spilled copy, lineage re-execution is the fallback.
+        gcs.objects.pop(oid(7))
+        assert gcs._maybe_recover_object(oid(7)) is True
+        assert rec["state"] == "PENDING"
+        for t in list(gcs._bg):
+            t.cancel()
+
+    asyncio.run(run())
+
+
+def test_gcs_node_death_drops_spilled_copies():
+    async def run():
+        gcs, nid, conn = _gcs_fixture()
+        gcs.objects[oid(3)] = {"locations": set(), "size": 1,
+                               "spilled": {nid}}
+        gcs.objects[oid(4)] = {"locations": {"other"}, "size": 1,
+                               "spilled": {nid}}
+        gcs.nodes["other"] = type(gcs.nodes[nid])(
+            "other", ("127.0.0.1", 9998), {"CPU": 1}, index=1)
+        await gcs._on_node_death(gcs.nodes[nid])
+        assert oid(3) not in gcs.objects           # only copy died with it
+        assert oid(4) in gcs.objects               # other replica survives
+        assert nid not in gcs.objects[oid(4)]["spilled"]
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------- MemoryStore spill
+def test_memory_store_spills_over_budget(tmp_path):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.memory_store import MemoryStore, StoredObject
+
+    mgr = SpillManager(str(tmp_path / "ms"))
+    store = MemoryStore(max_bytes=300_000, spiller=mgr)
+    oids = [ObjectID(os.urandom(24)) for _ in range(8)]
+    payloads = [os.urandom(100_000) for _ in range(8)]
+    for o, p in zip(oids, payloads):
+        store.put(o, StoredObject(value=p, nbytes=len(p)))  # no raise
+    st = store.stats()
+    assert st["spilled_objects"] > 0
+    assert st["used_bytes"] <= 300_000
+    # Every value — resident or spilled — reads back.
+    for o, p in zip(oids, payloads):
+        assert store.contains(o)
+        got = store.get([o], timeout=1.0)[0]
+        assert got.value == p
+
+
+def test_memory_store_without_spiller_still_raises():
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.memory_store import MemoryStore, StoredObject
+    from ray_tpu.exceptions import ObjectStoreFullError
+
+    store = MemoryStore(max_bytes=1000, spiller=None)
+    with pytest.raises(ObjectStoreFullError):
+        store.put(ObjectID(os.urandom(24)),
+                  StoredObject(value=b"x" * 2000, nbytes=2000))
+
+
+def test_spill_metrics_registered(tmp_path):
+    from ray_tpu.metrics import collect_all
+
+    store = make_store(tmp_path, capacity=128 * 1024)
+    store.put(oid(0), os.urandom(512 * 1024))  # forces a spill
+    assert store.get_bytes(oid(0)) is not None
+    snap = collect_all()
+    assert "object_store_spilled_bytes" in snap
+    assert "object_store_restored_bytes" in snap
+    assert "object_store_spill_latency_ms" in snap
+    assert "object_store_quota_evictions" in snap
+    spilled = snap["object_store_spilled_bytes"]["values"]
+    assert sum(spilled.values()) > 0
+
+
+# ------------------------------------------------------- cluster end-to-end
+@pytest.mark.cluster
+def test_cluster_working_set_4x_arena(monkeypatch):
+    """Acceptance: a cluster workload with a working set >= 4x the arena
+    completes with zero StoreFullError surfaced to the driver, and the
+    spill counters are visible through the node-stats path the dashboard
+    JSON API serves."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.protocol import RpcClient
+    from ray_tpu.cluster.testing import Cluster
+
+    arena = 8 * 1024 * 1024
+    monkeypatch.setenv("RAY_TPU_OBJECT_STORE_MEMORY", str(arena))
+    cluster = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    try:
+        ray_tpu.init(address=cluster.address)
+        blob = np.arange(1024 * 1024, dtype=np.uint8)
+        # 4x arena of driver puts ...
+        refs = [ray_tpu.put(blob + (i % 5)) for i in range(32)]
+        out = ray_tpu.get(refs)
+        for i, o in enumerate(out):
+            assert (o == blob + (i % 5)).all()
+
+        # ... and 2x arena of task results on top.
+        @ray_tpu.remote
+        def produce(i):
+            return np.full(1024 * 1024, i, dtype=np.uint8)
+
+        vals = ray_tpu.get([produce.remote(i) for i in range(16)])
+        for i, v in enumerate(vals):
+            assert v[0] == i and v.nbytes == 1024 * 1024
+
+        # Spill counters reach the GCS node-stats table (what the
+        # dashboard's /api/node_stats serves).
+        client = RpcClient("127.0.0.1", cluster.gcs_port)
+        try:
+            deadline = time.monotonic() + 15
+            spilled = 0
+            while time.monotonic() < deadline:
+                stats = client.call({"type": "get_node_stats"})["stats"]
+                spilled = sum(
+                    s.get("store", {}).get("spilled_bytes", 0)
+                    for s in stats.values())
+                if spilled > 0:
+                    break
+                time.sleep(0.25)
+            assert spilled > 0
+        finally:
+            client.close()
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+
+
+def test_store_full_error_still_importable():
+    """The exception class stays part of the public surface (spill makes
+    it rare, not gone — a full spill DISK still raises)."""
+    from ray_tpu._native import StoreFullError as E
+
+    assert E is StoreFullError
