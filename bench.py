@@ -20,6 +20,7 @@ Also writes BENCH_DETAIL.json with every BASELINE.json config:
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -48,6 +49,43 @@ def _reexec_on_cpu():
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+_PROBE_CACHE = os.path.join(
+    tempfile.gettempdir(), "ray_tpu_tpu_probe_verdict.json")
+_PROBE_TTL_S = float(os.environ.get("RAY_TPU_PROBE_TTL_S", "3600"))
+
+
+def _probe_cache_read():
+    """A recent negative probe verdict, or None. The 2x120 s probe burn on
+    every run while the tunnel is down (BENCH_r05 tail) is paid at most
+    once per TTL window; RAY_TPU_FORCE_PROBE=1 ignores the cache."""
+    if os.environ.get("RAY_TPU_FORCE_PROBE"):
+        return None
+    try:
+        with open(_PROBE_CACHE) as f:
+            cached = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if time.time() - cached.get("unix", 0) > _PROBE_TTL_S:
+        return None
+    return cached if cached.get("verdict") == "cpu" else None
+
+
+def _probe_cache_write(why: str) -> None:
+    try:
+        with open(_PROBE_CACHE, "w") as f:
+            json.dump({"verdict": "cpu", "unix": int(time.time()),
+                       "why": str(why)[:500]}, f)
+    except OSError:
+        pass
+
+
+def _probe_cache_clear() -> None:
+    try:
+        os.unlink(_PROBE_CACHE)
+    except OSError:
+        pass
+
+
 def _init_backend() -> str:
     """Prove the default backend can actually run a transfer; return its name.
 
@@ -55,9 +93,19 @@ def _init_backend() -> str:
     first ``jax.device_put`` raised, killing the bench with rc=1 and zero
     captured numbers. A north-star artifact must degrade: probe, retry once
     (tunnel flakes are transient), then fall back to a CPU re-exec with the
-    backend recorded in the output JSON.
+    backend recorded in the output JSON. Negative verdicts are cached for
+    RAY_TPU_PROBE_TTL_S (default 1 h) so a CPU-degraded run starts in
+    seconds instead of burning the 2x120 s probe again.
     """
     import threading
+
+    cached = _probe_cache_read()
+    if cached is not None and not os.environ.get(_CPU_CHILD_ENV):
+        print(f"TPU probe verdict cached at {_PROBE_CACHE} "
+              f"({cached.get('why', '')!r}); re-execing on CPU "
+              f"(RAY_TPU_FORCE_PROBE=1 to re-probe)",
+              file=sys.stderr, flush=True)
+        _reexec_on_cpu()
 
     def probe(result):
         try:
@@ -76,6 +124,7 @@ def _init_backend() -> str:
         t.start()
         t.join(timeout=120.0)
         if result and not isinstance(result[0], Exception):
+            _probe_cache_clear()  # healthy chip: stale negatives must go
             return result[0]
         why = result[0] if result else "timed out after 120s"
         print(f"backend probe attempt {attempt} failed: {why}",
@@ -90,8 +139,9 @@ def _init_backend() -> str:
             # was healthy). Settle and retry once before giving up.
             time.sleep(30.0)
     if not os.environ.get(_CPU_CHILD_ENV):
-        print("TPU backend unusable; re-execing on CPU", file=sys.stderr,
-              flush=True)
+        _probe_cache_write(repr(why))
+        print("TPU backend unusable; re-execing on CPU (verdict cached "
+              f"for {_PROBE_TTL_S:.0f}s)", file=sys.stderr, flush=True)
         _reexec_on_cpu()
     raise RuntimeError("no usable jax backend, even on CPU")
 

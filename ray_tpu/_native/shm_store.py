@@ -196,6 +196,29 @@ class ShmObjectStore:
         finally:
             buf.release()
 
+    def get_bytes_many(self, object_ids) -> Dict[bytes, bytes]:
+        """Batched probe: {id: bytes} for every sealed id found. One pair
+        of reused ctypes out-params across the whole loop — the per-call
+        marshalling allocations dominated large miss-heavy scans (a 5k-ref
+        driver harvest probes every pending id per wake)."""
+        out: Dict[bytes, bytes] = {}
+        lib, handle, mv = self._lib, self._handle, self._mv
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        boff, bsize = ctypes.byref(off), ctypes.byref(size)
+        for oid in object_ids:
+            pid = _pad_id(oid)
+            rc = lib.tps_get(handle, pid, boff, bsize)
+            if rc in (_NOT_FOUND, _NOT_SEALED):
+                continue
+            if rc != _OK:
+                raise OSError(f"get failed: rc={rc}")
+            try:
+                out[oid] = bytes(mv[off.value:off.value + size.value])
+            finally:
+                lib.tps_release(handle, pid)
+        return out
+
     def contains(self, object_id: bytes) -> bool:
         return self._lib.tps_contains(self._handle, _pad_id(object_id)) == 1
 
@@ -309,6 +332,16 @@ class PyObjectStore:
             return buf.tobytes()
         finally:
             buf.release()
+
+    def get_bytes_many(self, object_ids) -> Dict[bytes, bytes]:
+        """Batched probe (interface parity with ShmObjectStore)."""
+        out: Dict[bytes, bytes] = {}
+        with self._lock:
+            for oid in object_ids:
+                data = self._objects.get(_pad_id(oid))
+                if data is not None:
+                    out[oid] = data
+        return out
 
     def contains(self, object_id: bytes) -> bool:
         with self._lock:

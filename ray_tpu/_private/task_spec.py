@@ -96,6 +96,7 @@ class TaskSpec:
         self.scheduling_class = scheduling_class_of(
             self.resources, self.function.repr_name
         )
+        self._return_ids: Optional[List[ObjectID]] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -106,9 +107,15 @@ class TaskSpec:
         return self.task_type == TaskType.ACTOR_CREATION_TASK
 
     def return_ids(self) -> List[ObjectID]:
-        return [
-            ObjectID.for_task_return(self.task_id, i + 1) for i in range(self.num_returns)
-        ]
+        # Memoized: the submit hot path asks several times per task and the
+        # ids are pure functions of (task_id, num_returns).
+        rids = self._return_ids
+        if rids is None:
+            rids = self._return_ids = [
+                ObjectID.for_task_return(self.task_id, i + 1)
+                for i in range(self.num_returns)
+            ]
+        return rids
 
     def dependencies(self) -> List[ObjectID]:
         """ObjectIDs this task needs materialized before it can run.

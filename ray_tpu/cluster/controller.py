@@ -36,6 +36,9 @@ def _payload(msg):
     return {k: v for k, v in msg.items() if k not in ("type", "rpc_id")}
 
 
+QUEUE_PIPELINE_DEPTH = 2  # queued-task executes in flight per worker
+
+
 class WorkerHandle:
     def __init__(self, proc: subprocess.Popen):
         self.proc = proc
@@ -43,13 +46,19 @@ class WorkerHandle:
         self.idle = True
         self.actor_id: Optional[bytes] = None
         self.lease_id: Optional[bytes] = None  # owner-leased (direct push)
-        self.current_task: Optional[Dict] = None
+        self.current_task: Optional[Dict] = None  # actor creation in flight
+        # Queued-task executes outstanding on this worker (<= DEPTH): depth
+        # 2 lets the next admitted task sit in the worker's inbox while the
+        # current one runs, so a completion starts its successor without a
+        # controller round trip (on a contended host the execute/done
+        # ping-pong's process switches were a top per-task cost).
+        self.qdepth = 0
+        self.last_done = time.monotonic()  # stall detector for the rescue
         self.ready = asyncio.Event()
         self.killed_deliberately = False  # ray.kill: suppress restart
-        # Actor method calls AND leased direct tasks in flight on this
-        # worker, keyed by first return id: on worker death every one of
-        # them must be failed (plain queued tasks use current_task — at
-        # most one at a time).
+        # Actor method calls, leased direct tasks AND queued tasks in
+        # flight on this worker, keyed by first return id: on worker death
+        # every one of them must be failed.
         self.inflight: Dict[bytes, Dict] = {}
 
 
@@ -129,6 +138,10 @@ class NodeController:
         # lease_id -> {"worker": WorkerHandle, "task": admission record}.
         self._leases: Dict[bytes, Dict] = {}
         self._done_buf: List[Dict] = []  # coalesced task_done reports
+        # Coalesced oneway GCS messages (registrations, done batches): one
+        # scatter-write per event-loop pass instead of one syscall each —
+        # a completion wave is one sendmsg, not N.
+        self._gcs_out: List[Dict] = []
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
@@ -143,6 +156,10 @@ class NodeController:
         self._ref_held_calls: Dict[bytes, List[bytes]] = {}
         self._ref_uid = f"node-{self.node_id[:12]}"
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Inline-dispatch fast path (see _try_run_task_fast); env kill
+        # switch for A/B and emergency rollback.
+        self._dispatch_fast = os.environ.get(
+            "RAY_TPU_DISPATCH_FAST", "1") not in ("", "0")
         self._register_handlers()
 
     def _spawn_bg(self, coro) -> None:
@@ -167,12 +184,15 @@ class NodeController:
         # event loop (reference: raylet receiving leases over its GCS link).
         self._gcs = ResilientClient(*self.gcs_addr,
                                     push_handler=self._on_gcs_push)
+        from . import wire
+
         self._gcs.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
             "store_name": self.store_name,
             "transfer_port": self.transfer_port,
             "label": self.label,
+            "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION,
         })
         for _ in range(self.num_workers):
             self._spawn_worker()
@@ -319,10 +339,31 @@ class NodeController:
             except ConnectionError:
                 pass
 
+    def _rescue_stalled_pipelines(self) -> None:
+        """A pipelined execute queued behind a long-running (possibly
+        BLOCKED, e.g. nested-get) task must not starve: revoke it from the
+        worker's inbox and re-dispatch once the worker acks. Without this,
+        depth-2 pipelining can deadlock nested task graphs."""
+        now = time.monotonic()
+        for w in self.workers.values():
+            if w.qdepth < 2 or w.conn is None or now - w.last_done < 0.5:
+                continue
+            queued = [t for t in w.inflight.values()
+                      if not t.get("direct") and "method" not in t]
+            for t in queued[1:]:
+                if not t.get("_revoke_sent"):
+                    t["_revoke_sent"] = True
+                    try:
+                        w.conn.send_nowait({"type": "revoke_execute",
+                                            "task_id": t.get("task_id")})
+                    except Exception:  # noqa: BLE001 - reaper handles death
+                        pass
+
     async def _reap_loop(self):
         """Detect dead worker processes; fail their tasks; respawn."""
         while True:
             await asyncio.sleep(0.2)
+            self._rescue_stalled_pipelines()
             for pid, w in list(self.workers.items()):
                 if w.proc.poll() is not None:
                     del self.workers[pid]
@@ -341,8 +382,16 @@ class NodeController:
                                 dict(call, resources={}),
                                 f"leased worker died (exit "
                                 f"{w.proc.returncode})", crashed=True)
-                        else:
+                        elif "method" in call:
                             await self._fail_actor_call(call)
+                        else:
+                            # Pipelined queued task: full failure path (the
+                            # GCS decides retry; local+cluster shares are
+                            # released there).
+                            await self._fail_task(
+                                call,
+                                f"worker died executing task (exit "
+                                f"{w.proc.returncode})", crashed=True)
                     w.inflight.clear()
                     if w.lease_id is not None:
                         # The lease dies with its worker: give back the
@@ -381,28 +430,57 @@ class NodeController:
                         self._spawn_worker()
 
     # ------------------------------------------------------------ object store
+    def _gcs_send(self, msg: Dict) -> None:
+        """Oneway to the GCS, coalesced per event-loop pass: frames buffer
+        here and leave in ONE scatter-write (send_oneway_many). FIFO order
+        is preserved, so a wave's location registrations still precede its
+        task_done batch on the wire. Off-loop callers (spill threads) fall
+        back to an immediate locked send."""
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if not on_loop:
+            try:
+                self._gcs.send_oneway(msg)
+            except (ConnectionError, OSError):
+                pass
+            return
+        self._gcs_out.append(msg)
+        if len(self._gcs_out) == 1:
+            self._spawn_bg(self._flush_gcs_out())
+        elif len(self._gcs_out) >= 1024:
+            buf, self._gcs_out = self._gcs_out, []
+            self._gcs_send_many(buf)
+
+    async def _flush_gcs_out(self) -> None:
+        await asyncio.sleep(0)   # drain the current event-loop pass first
+        buf, self._gcs_out = self._gcs_out, []
+        if buf:
+            self._gcs_send_many(buf)
+
+    def _gcs_send_many(self, buf: List[Dict]) -> None:
+        try:
+            self._gcs.send_oneway_many(buf)
+        except (ConnectionError, OSError):
+            pass
+
     def _register_object(self, oid: bytes, size: int):
         """Wake local waiters and report the location to the GCS directory."""
         for ev in self._store_waiters.pop(oid, []):
             ev.set()
-        try:
-            self._gcs.send_oneway({
-                "type": "add_object_location", "object_id": oid,
-                "node_id": self.node_id, "size": size,
-            })
-        except ConnectionError:
-            pass
+        self._gcs_send({
+            "type": "add_object_location", "object_id": oid,
+            "node_id": self.node_id, "size": size,
+        })
 
     def _drop_location(self, oid: bytes):
         """Retract this node from the GCS object directory (eviction or
         deletion made our advertised copy a lie)."""
-        try:
-            self._gcs.send_oneway({
-                "type": "remove_object_location", "object_id": oid,
-                "node_id": self.node_id,
-            })
-        except ConnectionError:
-            pass
+        self._gcs_send({
+            "type": "remove_object_location", "object_id": oid,
+            "node_id": self.node_id,
+        })
 
     def _on_object_spilled(self, oid: bytes, size: int) -> None:
         """SpillingStore moved an object arena->disk: flip this node's
@@ -542,14 +620,35 @@ class NodeController:
         return client
 
     # ---------------------------------------------------------------- workers
-    async def _pop_idle_worker(self, timeout: float = 60.0) -> WorkerHandle:
+    def _claim_worker(self, exclusive: bool) -> Optional[WorkerHandle]:
+        """Pick a worker for one queued execute. ``exclusive`` (actors,
+        leases) requires a fully-idle worker; queued tasks may pipeline
+        onto a busy one up to QUEUE_PIPELINE_DEPTH (idle-first)."""
+        backup = None
+        for w in self.workers.values():
+            if w.conn is None or w.actor_id is not None \
+                    or w.lease_id is not None or w.current_task is not None:
+                continue
+            if w.qdepth == 0:
+                w.idle = False
+                if not exclusive:
+                    w.qdepth = 1
+                return w
+            if (not exclusive and backup is None
+                    and w.qdepth < QUEUE_PIPELINE_DEPTH):
+                backup = w
+        if backup is not None:
+            backup.qdepth += 1
+            return backup
+        return None
+
+    async def _pop_idle_worker(self, timeout: float = 60.0,
+                               exclusive: bool = True) -> WorkerHandle:
         deadline = time.monotonic() + timeout
         while True:
-            for w in self.workers.values():
-                if w.idle and w.conn is not None and w.actor_id is None \
-                        and w.lease_id is None:
-                    w.idle = False
-                    return w
+            w = self._claim_worker(exclusive)
+            if w is not None:
+                return w
             if all(w.conn is not None for w in self.workers.values()) and \
                     len(self.workers) < self.num_workers + 8:
                 self._spawn_worker()  # grow under load (bounded)
@@ -654,17 +753,26 @@ class NodeController:
         await self._fail_task(dict(task, resources={}),
                               "lease lost before dispatch", crashed=True)
 
-    async def _release(self, task: Dict):
+    async def _release(self, task: Dict, exec_s: float = 0.0,
+                       reg_s: float = 0.0, added: Optional[list] = None):
         if task.get("released"):
             return
         task["released"] = True
-        self._report_done(task.get("task_id"), task.get("resources", {}))
+        self._report_done(task.get("task_id"), task.get("resources", {}),
+                          exec_s, reg_s, added)
 
-    def _report_done(self, task_id, resources) -> None:
+    def _report_done(self, task_id, resources, exec_s: float = 0.0,
+                     reg_s: float = 0.0,
+                     added: Optional[list] = None) -> None:
         """Coalesce task_done reports into one task_done_batch oneway per
         event-loop pass (mirror of the GCS's assign_batch: at fan-out
-        rates the per-task socket write dominated both ends' CPU)."""
-        self._done_buf.append({"task_id": task_id, "resources": resources})
+        rates the per-task socket write dominated both ends' CPU). The
+        worker-measured exec/store wall times AND the task's result
+        registrations ride in the item — one GCS message per wave carries
+        completion + directory updates, not one per object."""
+        self._done_buf.append({"task_id": task_id, "resources": resources,
+                               "exec_s": exec_s, "reg_s": reg_s,
+                               "added": added or []})
         if len(self._done_buf) == 1:
             self._spawn_bg(self._flush_done())
         elif len(self._done_buf) >= 512:
@@ -678,16 +786,15 @@ class NodeController:
             self._send_done_batch(buf)
 
     def _send_done_batch(self, buf) -> None:
-        try:
-            if len(buf) == 1:
-                self._gcs.send_oneway(dict(
-                    buf[0], type="task_done", node_id=self.node_id))
-            else:
-                self._gcs.send_oneway({"type": "task_done_batch",
-                                       "node_id": self.node_id,
-                                       "items": buf})
-        except ConnectionError:
-            pass
+        # Always the batch form (n=1 included): one shape on the wire, and
+        # the batch has the binary fast-path codec. Flush the oneway
+        # buffer SYNCHRONOUSLY here — this already runs one deferred pass
+        # after the completion wave, and chaining a second deferral
+        # (_flush_gcs_out) measurably taxed serial round-trip latency.
+        self._gcs_out.append({"type": "task_done_batch",
+                              "node_id": self.node_id, "items": buf})
+        out, self._gcs_out = self._gcs_out, []
+        self._gcs_send_many(out)
 
     def _on_gcs_push(self, msg: Dict) -> None:
         """Runs on the GCS client's reader thread: hop to the loop."""
@@ -701,7 +808,12 @@ class NodeController:
 
             def fan_out(ts=tasks):
                 for t in ts:
-                    self._spawn_bg(self._run_task(dict(t)))
+                    # Inline dispatch when nothing would block: no deps,
+                    # headroom free, idle worker in hand. Skips the
+                    # per-task coroutine + two awaits of the general path
+                    # (which at fan-out rates dominated controller CPU).
+                    if not self._try_run_task_fast(t):
+                        self._spawn_bg(self._run_task(t))
 
             self._loop.call_soon_threadsafe(fan_out)
             return
@@ -809,9 +921,10 @@ class NodeController:
                 w.proc.kill()
             elif w.proc.poll() is None and any(
                     t.get("task_id") == task_id
-                    for t in w.inflight.values() if t.get("direct")):
-                # Direct-pushed task on a leased worker: same process-level
-                # interrupt; the reaper fails/retries its inflight set.
+                    for t in w.inflight.values()):
+                # Direct-pushed or pipelined queued task on this worker:
+                # same process-level interrupt; the reaper fails/retries
+                # its inflight set.
                 w.proc.kill()
 
     # -------------------------------------------------------------- handlers
@@ -826,6 +939,10 @@ class NodeController:
                 return {"ok": False, "error": "unknown worker pid"}
             handle.conn = conn
             conn.meta["worker_pid"] = msg["pid"]
+            # Wire-capable workers get binary execute_task frames (the
+            # relay's terminal hop forwards the raw spec blob).
+            if msg.get("wire"):
+                conn.meta["wire"] = int(msg["wire"])
             handle.ready.set()
             self._idle_event.set()
             return {"ok": True, "node_id": self.node_id}
@@ -841,27 +958,77 @@ class NodeController:
                 self._spawn_bg(self._run_task(dict(t)))
             return {"ok": True}
 
+        @s.handler("revoke_ack")
+        async def revoke_ack(msg, conn):
+            """Worker confirmed a queued execute never started: reclaim it
+            and re-drive through the normal dispatch path (the ack is the
+            at-most-once guarantee — a started task acks revoked=False and
+            completes normally)."""
+            if not msg.get("revoked"):
+                return None
+            pid = msg.get("pid") or conn.meta.get("worker_pid")
+            w = self.workers.get(pid)
+            if w is None:
+                return None
+            tid = msg.get("task_id")
+            for rid, t in list(w.inflight.items()):
+                if t.get("task_id") == tid and not t.get("direct") \
+                        and "method" not in t:
+                    del w.inflight[rid]
+                    self._unclaim_queued(w)
+                    self._release_local(t)
+                    t.pop("_revoke_sent", None)
+                    # Once revoked, never pipeline this task again: it must
+                    # claim a FULLY idle worker (growing the pool if none),
+                    # or it would re-queue behind the same blocked worker
+                    # in a revoke loop that never makes progress.
+                    t["_no_pipeline"] = True
+                    self._spawn_bg(self._run_task(t))
+                    break
+            return None
+
         @s.handler("task_done")
         async def task_done(msg, conn):
             """Worker finished: blobs already stored via store_object."""
             # Result blobs the worker wrote straight into the arena,
-            # registered here instead of one object_added oneway each —
-            # carried IN the finish message, so registration still
-            # strictly precedes the finish processing below.
-            for oid, size in msg.get("added", []):
-                self._register_object(oid, size)
+            # carried IN the finish message. Local waiters wake here; the
+            # GCS directory registration rides inside this completion's
+            # task_done_batch item (one wave message carries both), so
+            # registration still strictly precedes the finish processing.
+            added = msg.get("added", [])
+            for oid, _size in added:
+                for ev in self._store_waiters.pop(oid, []):
+                    ev.set()
             pid = msg.get("pid") or conn.meta.get("worker_pid")
             w = self.workers.get(pid)
+            exec_s = float(msg.get("exec_s") or 0.0)
+            reg_s = float(msg.get("reg_s") or 0.0)
+            reported = False
             for rid in msg.get("return_ids", []):
                 self._unborrow_call_refs(rid)
             if w is not None:
+                w.last_done = time.monotonic()
                 for rid in msg.get("return_ids", []):
                     done = w.inflight.pop(rid, None)
-                    if done is not None and done.get("direct"):
+                    if done is None:
+                        continue
+                    if done.get("direct"):
                         # Finish the direct task's lineage record; resources
                         # are empty — the lease keeps holding the share.
                         # Coalesced with queued-task completions.
-                        self._report_done(done.get("task_id"), {})
+                        self._report_done(done.get("task_id"), {},
+                                          exec_s, reg_s,
+                                          None if reported else added)
+                        reported = True
+                    elif "method" not in done:
+                        # Queued task: return the pipeline claim + local
+                        # share, report done (registrations ride along).
+                        self._unclaim_queued(w)
+                        self._release_local(done)
+                        if not done.get("released"):
+                            await self._release(done, exec_s, reg_s,
+                                                None if reported else added)
+                            reported = True
                 task = w.current_task
                 w.current_task = None
                 # not w.inflight: a lease released mid-run leaves later
@@ -869,12 +1036,24 @@ class NodeController:
                 # queued task be dispatched behind them and prematurely
                 # "finished" by their task_done.
                 if w.actor_id is None and w.lease_id is None \
-                        and not w.inflight:
+                        and not w.inflight and w.qdepth == 0:
                     w.idle = True
                     self._idle_event.set()
                 if task is not None:
+                    # Actor creation finish (the only current_task user).
                     self._release_local(task)
-                    await self._release(task)
+                    if not task.get("released"):
+                        await self._release(task, exec_s, reg_s,
+                                            None if reported else added)
+                        reported = True
+            if not reported:
+                # Actor-method completion (or an unknown worker): no done
+                # item will carry these registrations — report directly.
+                for oid, size in added:
+                    self._gcs_send({
+                        "type": "add_object_location", "object_id": oid,
+                        "node_id": self.node_id, "size": size,
+                    })
             return None
 
         @s.handler("lease_worker")
@@ -1072,6 +1251,9 @@ class NodeController:
                     # cProfile-free view of where this controller's event
                     # loop goes (GCS exposes the same via debug_stats).
                     "handler_stats": dict(self.server.handler_stats),
+                    # Oneway coalescing evidence: frames vs actual socket
+                    # writes on the GCS link (regression guard reads this).
+                    "gcs_io": dict(self._gcs.io_stats),
                     "num_workers": len(self.workers),
                     "workers": [
                         {"pid": pid, "registered": w.conn is not None,
@@ -1164,23 +1346,66 @@ class NodeController:
             self._unborrow_call_refs(msg["return_ids"][0])
 
     # -------------------------------------------------------------- task run
+    def _start_queued_exec(self, worker: WorkerHandle, task: Dict) -> None:
+        """Register a CLAIMED worker's queued execute and push it (sync,
+        no drain: the worker demonstrably consumes its inbox)."""
+        rids = task.get("return_ids") or []
+        if rids:
+            worker.inflight[rids[0]] = task
+        try:
+            worker.conn.send_nowait(dict(task, type="execute_task"))
+        except Exception:  # noqa: BLE001 - worker died under the send:
+            pass  # the reaper fails/retries its inflight set exactly as
+            #       if the send had been delivered to a dying worker.
+
+    def _try_run_task_fast(self, task: Dict) -> bool:
+        """Inline dispatch on the event loop: only when no staging, no
+        admission wait, and no worker wait could occur — anything else
+        returns False and the coroutine path handles it. FIFO fairness is
+        preserved by refusing the fast path while the admission queue is
+        non-empty (fast-pathing past queued tasks would starve them)."""
+        if not self._dispatch_fast:
+            return False
+        if task.get("deps") or self._admit_queues:
+            return False
+        res = task.get("resources", {})
+        if not self._fits_local(res):
+            return False
+        if task.get("task_id") in self._cancelled:
+            return False
+        worker = self._claim_worker(exclusive=False)
+        if worker is None:
+            return False
+        self._acquire_now(task)
+        self._start_queued_exec(worker, task)
+        return True
+
+    def _unclaim_queued(self, worker: WorkerHandle) -> None:
+        """Return one queued-execute claim on a worker."""
+        if worker.qdepth > 0:
+            worker.qdepth -= 1
+        if worker.qdepth == 0 and worker.conn is not None \
+                and worker.actor_id is None and worker.lease_id is None \
+                and worker.current_task is None and not worker.inflight:
+            worker.idle = True
+            self._idle_event.set()
+
     async def _run_task(self, task: Dict):
         try:
             for oid in task.get("deps", []):
                 await self._store_get(oid)
             await self._acquire_local(task)
-            worker = await self._pop_idle_worker()
+            worker = await self._pop_idle_worker(
+                exclusive=task.get("_no_pipeline", False))
         except Exception as e:  # noqa: BLE001
             await self._fail_task(task, f"dispatch failed: {e}")
             return
         if task.get("task_id") in self._cancelled:
             self._cancelled.discard(task["task_id"])
             await self._fail_task(task, "task cancelled before dispatch")
-            worker.idle = True
-            self._idle_event.set()
+            self._unclaim_queued(worker)
             return
-        worker.current_task = task
-        await worker.conn.send(dict(task, type="execute_task"))
+        self._start_queued_exec(worker, task)
 
     async def _create_actor(self, msg: Dict):
         try:

@@ -31,6 +31,7 @@ from .._private.serialization import SerializedObject, get_context
 from .._private.task_spec import TaskSpec
 from ..exceptions import ActorDiedError, GetTimeoutError
 from ..object_ref import ObjectRef
+from . import wire
 from .protocol import ResilientClient, RpcClient
 
 ERR_PREFIX = b"E"
@@ -94,6 +95,11 @@ class ClusterCoreWorker:
         self._submit_buf: List[Dict] = []
         self._submit_lock = threading.Lock()
         self._submit_timer: Any = None
+        # Driver-side phase profiler cells: name -> [count, seconds]. The
+        # three phases measured here (driver_serialize, submit_rpc,
+        # driver_fetch) join the four server-side ones (GCS debug_stats)
+        # for the 7-phase per-task breakdown scripts/cluster_lat.py prints.
+        self.phase_stats: Dict[str, list] = {}
         # Distributed reference counting (reference: reference_count.h:33;
         # the owner<->borrower WaitForRefRemoved protocol of
         # core_worker.proto:322 collapses into holder registration with the
@@ -354,6 +360,15 @@ class ClusterCoreWorker:
                 kwargs[key] = self._pack_value(val, pins)
         return args, kwargs, deps, pins
 
+    def _phase_add(self, name: str, seconds: float, n: int = 1) -> None:
+        """Accumulate one phase-profiler cell (GIL-tolerant; a lost sample
+        under a rare race is acceptable for a profiler)."""
+        cell = self.phase_stats.get(name)
+        if cell is None:
+            cell = self.phase_stats[name] = [0, 0.0]
+        cell[0] += n
+        cell[1] += seconds
+
     # ---------------------------------------------------------- submit pipe
     def _queue_submit(self, msg: Dict) -> None:
         with self._submit_lock:
@@ -367,6 +382,12 @@ class ClusterCoreWorker:
                 self._submit_timer.daemon = True
                 self._submit_timer.start()
         if n >= 128:
+            # Inline (blocking) flush ON PURPOSE: the round trip paces the
+            # submitter to what the GCS can absorb. A/B'd against a
+            # background pump thread (callers never block, buffer caps of
+            # 256 and 2048): both measured WORSE warm 5k throughput
+            # (1,083-1,145 vs 1,270 tasks/s) — an unpaced submitter floods
+            # the placement/dispatch queues and the whole pipeline pays.
             self._flush_submits()
 
     def _flush_submits(self) -> None:
@@ -377,8 +398,20 @@ class ClusterCoreWorker:
             timer.cancel()
         if not buf:
             return
+        if not wire.pickle_only():
+            # Serialize each spec ONCE into its wire blob: the submit frame
+            # carries these bytes, the GCS keeps them opaque, and the
+            # executing worker is the only decoder (zero re-serialization
+            # along the relay).
+            t0 = time.perf_counter()
+            for t in buf:
+                if "_spec" not in t:
+                    t["_spec"] = wire.encode_task_spec(t)
+            self._phase_add("driver_serialize", time.perf_counter() - t0, 0)
         try:
+            t0 = time.perf_counter()
             self.gcs.call({"type": "submit_batch", "tasks": buf})
+            self._phase_add("submit_rpc", time.perf_counter() - t0, len(buf))
         except (ConnectionError, OSError):
             # Put them back and re-arm the retry timer; submit_batch is
             # idempotent per task_id so a re-send is safe. Without the
@@ -435,6 +468,7 @@ class ClusterCoreWorker:
         * **queued** — everything else goes to the GCS task table, which
           owns placement (batch kernel), dispatch, and retry.
         """
+        t0 = time.perf_counter()
         fn_id = self._export_fn(fn)
         args, kwargs, deps, pins = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
@@ -446,6 +480,7 @@ class ClusterCoreWorker:
             "deps": deps, "pin_refs": pins, "return_ids": return_ids,
             "resources": resources, "max_retries": spec.max_retries,
         }
+        self._phase_add("driver_serialize", time.perf_counter() - t0)
         if not deps and self.config.direct_call_enabled \
                 and self._direct_submit(payload):
             return [ObjectRef(oid) for oid in spec.return_ids()]
@@ -600,10 +635,13 @@ class ClusterCoreWorker:
         distinguish an unfetched completed task from a long-running one,
         and treating a running task as stale would let the janitor release
         its lease (and the node shares it occupies) mid-execution."""
+        if now - self._direct_expire_last < 5.0:
+            return  # throttle BEFORE the scan: this runs per submit when
+            #         the outstanding window is full
         with self._direct_lock:
             stale = [rid for rid, t in self._direct_outstanding.items()
                      if now - t > DIRECT_STALE_S]
-        if not stale or now - self._direct_expire_last < 5.0:
+        if not stale:
             return
         self._direct_expire_last = now
         try:
@@ -1036,17 +1074,36 @@ class ClusterCoreWorker:
             # Full local scan every wake is INTENTIONAL: same-host workers
             # deposit results into the shared arena ahead of the (batched)
             # directory registration, so each long-poll wake harvests the
-            # whole arena backlog, not just the registered slice. An A/B
-            # that restricted later scans to direct-push oids measured 14%
-            # WORSE warm batched throughput (CLUSTER_LAT.json 1785482430
-            # vs 1785482520) — the scan is cheap relative to waiting a
-            # directory round for deposited results.
-            for oid in list(pending):
-                blob = self._local_blob(oid)
-                if blob is not None:
+            # whole arena backlog, not just the registered slice. Two A/Bs
+            # confirmed: restricting to direct-push oids measured 14%
+            # WORSE warm throughput (CLUSTER_LAT.json 1785482430 vs
+            # 1785482520), and a frontier window with a 512-miss cutoff
+            # measured 11% worse (1,131 vs 1,270 tasks/s) — a starved
+            # scan just shifts the load onto extra directory long-polls.
+            t0 = time.perf_counter()
+            n0 = len(pending)
+            store = self.local_store
+            if store is not None and hasattr(store, "get_bytes_many"):
+                for oid, blob in store.get_bytes_many(list(pending)).items():
                     blobs[oid] = blob
                     pending.discard(oid)
                     self._direct_observed(oid)
+                if self._blob_cache and pending:
+                    for oid in list(pending):
+                        blob = self._blob_cache.get(oid)
+                        if blob is not None:
+                            blobs[oid] = blob
+                            pending.discard(oid)
+                            self._direct_observed(oid)
+            else:
+                for oid in list(pending):
+                    blob = self._local_blob(oid)
+                    if blob is not None:
+                        blobs[oid] = blob
+                        pending.discard(oid)
+                        self._direct_observed(oid)
+            self._phase_add("driver_fetch", time.perf_counter() - t0,
+                            n0 - len(pending))
             if not pending:
                 break
             # LONG-POLL: the GCS parks until one of the requested objects
@@ -1055,6 +1112,28 @@ class ClusterCoreWorker:
             # pending oid dominated GCS CPU. First cycle asks with no wait
             # so an all-ready get never blocks.
             wait_s = 0.0 if first else 1.0
+            if len(pending) <= 4 and store is not None and (
+                    not first or all(o in self._direct_outstanding
+                                     for o in pending)):
+                # Small-get fast path: the result hits the same-host arena
+                # a full worker->controller->GCS->driver chain BEFORE the
+                # directory can wake our long-poll — a ~2 ms arena spin
+                # shaves that tail off every serial round trip (A/B'd:
+                # removing it measured p50 1.02 ms vs 0.85 ms with it).
+                # On the FIRST cycle it only runs when every ref was
+                # direct-pushed (the result is expected imminently; the
+                # wait_s=0 directory poll would be a wasted round trip).
+                spin_end = time.monotonic() + 0.002
+                while pending and time.monotonic() < spin_end:
+                    for oid, blob in store.get_bytes_many(
+                            list(pending)).items():
+                        blobs[oid] = blob
+                        pending.discard(oid)
+                        self._direct_observed(oid)
+                    if pending:
+                        time.sleep(0.0001)
+                if not pending:
+                    break
             first = False
             if deadline is not None:
                 wait_s = max(0.0, min(wait_s,
@@ -1066,9 +1145,25 @@ class ClusterCoreWorker:
             probe = now - last_probe >= 2.0
             if probe:
                 last_probe = now
+            # Poll the completion FRONTIER, not the whole pending set: the
+            # oldest 1024 unfinished refs in submission order. get() needs
+            # every object anyway, so a window only shapes discovery order
+            # while capping both the request encode and the GCS park cost
+            # at O(window) instead of O(pending) (measured: 5k-oid polls
+            # dominated GCS cycles at fan-out).
+            ask, seen = [], set()
+            for oid in oids:
+                if oid in pending and oid not in seen:
+                    seen.add(oid)
+                    ask.append(oid)
+                    if len(ask) >= 1024:
+                        break
             resp = self.gcs.call(
-                {"type": "locations_batch", "object_ids": list(pending),
-                 "wait_s": wait_s, "probe": probe},
+                {"type": "locations_batch", "object_ids": ask,
+                 "wait_s": wait_s, "probe": probe,
+                 # Wave coalescing only pays off for fan-outs; a small
+                 # get() keeps the first-landing wake (serial latency).
+                 "wave_s": 0.004 if len(pending) > 64 else 0.0},
                 timeout=wait_s + 30.0)
             n_before = len(pending)
             to_fetch = {}
@@ -1078,10 +1173,15 @@ class ClusterCoreWorker:
                     pending.discard(oid)
                     continue
                 to_fetch[oid] = info
-            for oid, blob in self._fetch_many(to_fetch).items():
+            t0 = time.perf_counter()
+            fetched = self._fetch_many(to_fetch)
+            for oid, blob in fetched.items():
                 blobs[oid] = blob
                 pending.discard(oid)
                 self._direct_observed(oid)
+            if to_fetch:
+                self._phase_add("driver_fetch", time.perf_counter() - t0,
+                                len(fetched))
             if not pending:
                 break
             progressed = len(pending) < n_before
@@ -1097,12 +1197,14 @@ class ClusterCoreWorker:
                 # this loop hot-spins connection attempts until the
                 # heartbeat reaper updates the directory.
                 time.sleep(0.05)
+        t0 = time.perf_counter()
         values: Dict[bytes, Any] = {}
         out = []
         for oid in oids:
             if oid not in values:
                 values[oid] = self._blob_value(blobs[oid])
             out.append(values[oid])
+        self._phase_add("driver_fetch", time.perf_counter() - t0, 0)
         return out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,

@@ -1,14 +1,24 @@
-"""Cluster wire protocol: length-prefixed pickled messages over TCP.
+"""Cluster wire protocol: length-prefixed messages over TCP.
 
 Plays the role of the reference's gRPC plumbing (``src/ray/rpc/``): typed
 request/response with correlation ids, plus server-push messages (pubsub).
-A message is ``[8-byte LE length][pickle bytes]``; payloads are plain dicts
-with a ``type`` field. Object payloads are raw bytes inside the pickle — the
-pickle module handles them zero-copy-ish via protocol 5 out-of-band buffers
-when large.
+A frame is ``[8-byte LE length][body]``. Two body encodings share every
+socket:
+
+  * **pickle** (default, any message type): a plain dict with a ``type``
+    field, protocol-5 out-of-band buffers for large payloads;
+  * **binary fast path** (``wire.py``): struct-packed bodies for the
+    highest-frequency control-plane types, detected by a magic first byte
+    (pickle bodies start with 0x80, binary with 0xBF).
+
+Receivers always understand both, so old pickle-only peers interoperate on
+the same socket; binary is only *sent* to peers that advertised/showed
+capability, and ``RAY_TPU_WIRE_PICKLE_ONLY=1`` pins a process to pickle.
 
 Server side: asyncio. Client side: a blocking, thread-safe RpcClient (the
-runtime's callers are threads, not coroutines).
+runtime's callers are threads, not coroutines). Oneway messages can be
+coalesced into a single scatter-write (``send_oneway_many``) so a
+completion wave is one sendmsg, not N.
 """
 
 from __future__ import annotations
@@ -20,7 +30,9 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from . import wire
 
 _LEN = struct.Struct("<Q")
 MAX_MESSAGE = 1 << 34
@@ -31,11 +43,60 @@ def _dumps(msg: Dict[str, Any]) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+def _loads_body(body: bytes) -> Dict[str, Any]:
+    if wire.is_binary(body):
+        return wire.decode(body)
+    return pickle.loads(body)
+
+
+def _compact(bufs: List[bytes], small: int = 1 << 14) -> List[bytes]:
+    """Merge runs of small buffers into one; keep large blobs standalone
+    (they pass through unjoined — the zero-copy part of the scatter
+    write). Also keeps iovec counts far under IOV_MAX."""
+    out: List[bytes] = []
+    acc: Optional[bytearray] = None
+    for b in bufs:
+        if len(b) < small:
+            if acc is None:
+                acc = bytearray(b)
+            else:
+                acc += b
+        else:
+            if acc is not None:
+                out.append(bytes(acc))
+                acc = None
+            out.append(b)
+    if acc is not None:
+        out.append(bytes(acc))
+    return out
+
+
+def encode_frames(msg: Dict[str, Any], binary_ok: bool,
+                  req_type: Optional[str] = None) -> List[bytes]:
+    """Encode one message into a list of buffers (length header first).
+
+    ``binary_ok`` gates the fast path; ``req_type`` selects a response
+    codec (responses carry no ``type`` field of their own). Falls back to
+    one pickled buffer for types without a binary codec."""
+    if binary_ok and not wire.pickle_only():
+        try:
+            bufs = (wire.encode_response(req_type, msg) if req_type
+                    else wire.encode(msg))
+        except wire.WireError:
+            bufs = None
+        if bufs is not None:
+            total = sum(len(b) for b in bufs)
+            return _compact([_LEN.pack(total), *bufs])
+    return [_dumps(msg)]
+
+
 # ---------------------------------------------------------------------------
 # asyncio server side
 # ---------------------------------------------------------------------------
 
-async def read_message(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
+async def read_frame(reader: asyncio.StreamReader
+                     ) -> Optional[tuple]:
+    """One frame off the stream: (msg, was_binary), or None at EOF."""
     try:
         header = await reader.readexactly(8)
     except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -44,7 +105,12 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]
     if length > MAX_MESSAGE:
         raise ValueError(f"message too large: {length}")
     body = await reader.readexactly(length)
-    return pickle.loads(body)
+    return _loads_body(body), wire.is_binary(body)
+
+
+async def read_message(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
+    frame = await read_frame(reader)
+    return None if frame is None else frame[0]
 
 
 async def write_message(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
@@ -96,9 +162,14 @@ class RpcServer:
         self._conns.add(conn)
         try:
             while True:
-                msg = await read_message(reader)
-                if msg is None:
+                frame = await read_frame(reader)
+                if frame is None:
                     break
+                msg, was_binary = frame
+                if was_binary:
+                    # Observed capability: this peer talks binary, so
+                    # responses/pushes to it may too.
+                    conn.meta["wire"] = wire.WIRE_VERSION
                 mtype = msg.get("type")
                 handler = self._handlers.get(mtype)
                 if handler is None:
@@ -120,7 +191,7 @@ class RpcServer:
                         cell[1] += time.monotonic() - t0
                 if "rpc_id" in msg and resp is not None:
                     resp["rpc_id"] = msg["rpc_id"]
-                    await conn.send(resp)
+                    await conn.send(resp, req_type=mtype)
         finally:
             self._conns.discard(conn)
             if self._on_disconnect is not None:
@@ -154,9 +225,26 @@ class Connection:
         self.meta: Dict[str, Any] = {}  # handler-attached identity (node id...)
         self._wlock = asyncio.Lock()
 
-    async def send(self, msg: Dict[str, Any]):
+    async def send(self, msg: Dict[str, Any],
+                   req_type: Optional[str] = None):
+        """Push/respond on this connection. Binary fast-path encoding is
+        used when the peer has advertised or shown wire capability
+        (``meta["wire"]``); ``req_type`` selects a response codec."""
+        bufs = encode_frames(msg, binary_ok=bool(self.meta.get("wire")),
+                             req_type=req_type)
         async with self._wlock:
-            await write_message(self.writer, msg)
+            self.writer.writelines(bufs)
+            await self.writer.drain()
+
+    def send_nowait(self, msg: Dict[str, Any]) -> None:
+        """Synchronous push from the event-loop thread: buffers into the
+        transport without awaiting drain. For small high-rate pushes whose
+        peer demonstrably consumes (e.g. execute_task to a local worker) —
+        the await-per-send of the locked path was pure overhead there.
+        writelines() is atomic into the transport buffer, so interleaving
+        with concurrent send() calls is safe."""
+        bufs = encode_frames(msg, binary_ok=bool(self.meta.get("wire")))
+        self.writer.writelines(bufs)
 
 
 # ---------------------------------------------------------------------------
@@ -173,9 +261,19 @@ class RpcClient:
     def __init__(self, host: str, port: int,
                  push_handler: Optional[Callable[[Dict], None]] = None,
                  timeout: float = 30.0,
-                 on_close: Optional[Callable[[], None]] = None):
+                 on_close: Optional[Callable[[], None]] = None,
+                 binary: Optional[bool] = None,
+                 io_stats: Optional[Dict[str, int]] = None):
         self._on_close = on_close
         self.addr = (host, port)
+        # Send-side wire choice: binary fast path by default (the codec is
+        # part of this release; receivers always decode both), pinnable to
+        # pickle per client or process-wide via RAY_TPU_WIRE_PICKLE_ONLY.
+        self._binary = (not wire.pickle_only()) if binary is None else binary
+        # frames/writes counters: the coalescing regression guard reads
+        # these (one write per completion wave, not one per frame).
+        self.io_stats = io_stats if io_stats is not None else {
+            "frames_sent": 0, "writes": 0}
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(None)
         # Small control messages back-to-back must not wait out Nagle +
@@ -198,10 +296,12 @@ class RpcClient:
                 if header is None:
                     break
                 (length,) = _LEN.unpack(header)
+                if length > MAX_MESSAGE:
+                    break  # corrupt/hostile peer: drop the connection
                 body = self._recv_exact(length)
                 if body is None:
                     break
-                msg = pickle.loads(body)
+                msg = _loads_body(body)
                 rpc_id = msg.get("rpc_id")
                 if rpc_id is not None and rpc_id in self._pending:
                     self._responses[rpc_id] = msg
@@ -235,6 +335,26 @@ class RpcClient:
             buf.extend(chunk)
         return bytes(buf)
 
+    def _send_buffers(self, bufs: List[bytes], frames: int) -> None:
+        """One scatter-gather write for any number of frames. Caller holds
+        ``_wlock``. Partial sendmsg results are continued manually."""
+        self.io_stats["frames_sent"] += frames
+        self.io_stats["writes"] += 1
+        try:
+            sendmsg = self._sock.sendmsg
+        except AttributeError:  # platform without sendmsg
+            self._sock.sendall(b"".join(bufs))
+            return
+        views = [memoryview(b) for b in bufs]
+        while views:
+            # Stay well under IOV_MAX per syscall (EMSGSIZE otherwise).
+            sent = sendmsg(views[:512])
+            while views and sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            if sent:
+                views[0] = views[0][sent:]
+
     def call(self, msg: Dict[str, Any], timeout: Optional[float] = 60.0) -> Dict:
         if self._closed:
             raise ConnectionError(f"connection to {self.addr} closed")
@@ -242,8 +362,9 @@ class RpcClient:
         msg = dict(msg, rpc_id=rpc_id)
         ev = threading.Event()
         self._pending[rpc_id] = ev
+        bufs = encode_frames(msg, binary_ok=self._binary)
         with self._wlock:
-            self._sock.sendall(_dumps(msg))
+            self._send_buffers(bufs, 1)
         if not ev.wait(timeout):
             self._pending.pop(rpc_id, None)
             raise TimeoutError(f"rpc {msg['type']} to {self.addr} timed out")
@@ -261,8 +382,23 @@ class RpcClient:
     def send_oneway(self, msg: Dict[str, Any]) -> None:
         if self._closed:
             raise ConnectionError(f"connection to {self.addr} closed")
+        bufs = encode_frames(msg, binary_ok=self._binary)
         with self._wlock:
-            self._sock.sendall(_dumps(msg))
+            self._send_buffers(bufs, 1)
+
+    def send_oneway_many(self, msgs: List[Dict[str, Any]]) -> None:
+        """Coalesced oneways: N frames, ONE locked scatter-write. FIFO
+        order within the list is preserved on the wire, so e.g. a wave's
+        object registrations still precede its task_done batch."""
+        if not msgs:
+            return
+        if self._closed:
+            raise ConnectionError(f"connection to {self.addr} closed")
+        bufs: List[bytes] = []
+        for msg in msgs:
+            bufs.extend(encode_frames(msg, binary_ok=self._binary))
+        with self._wlock:
+            self._send_buffers(bufs, len(msgs))
 
     def close(self):
         self._closed = True
@@ -290,6 +426,8 @@ class ResilientClient:
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
+        # Shared across reconnects so coalescing counters survive re-dials.
+        self.io_stats: Dict[str, int] = {"frames_sent": 0, "writes": 0}
         self._ensure()
 
     def _ensure(self) -> RpcClient:
@@ -298,7 +436,8 @@ class ResilientClient:
                 raise ConnectionError(f"client to {self.addr} closed")
             if self._client is None or self._client._closed:
                 self._client = RpcClient(
-                    *self.addr, push_handler=self._push_handler)
+                    *self.addr, push_handler=self._push_handler,
+                    io_stats=self.io_stats)
             return self._client
 
     def _drop(self) -> None:
@@ -327,6 +466,16 @@ class ResilientClient:
             # so a miss is recovered by the next tick anyway
             try:
                 self._ensure().send_oneway(msg)
+            except (ConnectionError, OSError):
+                pass
+
+    def send_oneway_many(self, msgs: List[Dict[str, Any]]) -> None:
+        try:
+            self._ensure().send_oneway_many(msgs)
+        except (ConnectionError, OSError):
+            self._drop()
+            try:
+                self._ensure().send_oneway_many(msgs)
             except (ConnectionError, OSError):
                 pass
 

@@ -1,0 +1,636 @@
+"""Binary hot-path wire codec for the cluster control plane.
+
+The default wire format (``protocol.py``) is a length-prefixed pickle —
+general, but on the per-task hot path the pickle of nested dicts is the
+single largest control-plane CPU line on both ends of every edge. This
+module gives the highest-frequency message types a compact struct-packed
+encoding:
+
+  * ``submit_batch``      driver -> GCS      (task specs, the submit wave)
+  * ``task_done_batch``   controller -> GCS  (completion wave)
+  * ``locations_batch``   driver -> GCS      (+ its response; the get() loop)
+  * ``fetch_batch``       driver -> node     (+ its response; result blobs)
+  * ``object_added``      worker/driver -> controller (arena registrations)
+
+plus the two relay messages that carry task specs onward:
+
+  * ``assign_batch``      GCS -> controller  (raw spec blobs, forwarded)
+  * ``execute_task``      controller -> worker (one raw spec blob)
+  * ``task_done``         worker -> controller (singular completion)
+
+**Frame layout.** The transport frame stays ``[8-byte LE length][body]``.
+A binary body begins with ``MAGIC`` (0xBF) + a message-code byte; anything
+else (pickle bodies start with 0x80) is decoded as pickle. Receivers always
+understand both, so a pickle-only peer can share a socket with a
+binary-capable one; senders only emit binary for the types above, and only
+once the peer is known-capable (advertised ``wire`` version on
+register_node/register_worker, or observed binary traffic on the
+connection). ``RAY_TPU_WIRE_PICKLE_ONLY=1`` pins a process to pickle on the
+send side (rolling-upgrade escape hatch); decode support is unconditional.
+
+**Opaque task-spec relay.** ``encode_task_spec`` packs a task payload once
+on the driver. The GCS decodes only the fixed header (ids, deps, resources —
+what placement and lineage need) and keeps the original bytes in
+``payload["_spec"]``; the dispatch path forwards those bytes verbatim inside
+``assign_batch``/``execute_task`` frames, so the args/kwargs blobs are
+deserialized exactly once, at the executing worker. Zero task-spec
+re-serializations happen on the GCS (pinned by ``relay:opaque`` /
+``relay:pickled`` counters in its handler stats).
+
+Encoders return a *list of buffers* so callers can scatter-write
+(``sendmsg`` / ``writelines``) without copying large blobs (protocol-5
+out-of-band spirit: result blobs and spec bytes are passed through, not
+re-joined).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+MAGIC = 0xBF
+WIRE_VERSION = 1
+
+# Message codes (one byte each). Codes are part of the wire contract:
+# never renumber, only append.
+SUBMIT_BATCH = 0x01
+SUBMIT_BATCH_RESP = 0x02
+TASK_DONE_BATCH = 0x03
+LOCATIONS_BATCH = 0x04
+LOCATIONS_BATCH_RESP = 0x05
+FETCH_BATCH = 0x06
+FETCH_BATCH_RESP = 0x07
+OBJECT_ADDED = 0x08
+ASSIGN_BATCH = 0x09
+EXECUTE_TASK = 0x0A
+TASK_DONE = 0x0B
+
+SPEC_VERSION = 1
+
+# Hard caps, enforced on decode: a corrupt count/length field must fail the
+# frame instead of driving a multi-GB allocation.
+MAX_ITEMS = 1 << 22
+MAX_BLOB = 1 << 34
+
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I32 = struct.Struct("<i")
+_F32 = struct.Struct("<f")
+_F64 = struct.Struct("<d")
+
+
+def pickle_only() -> bool:
+    """Send-side kill switch (decode support is unconditional)."""
+    return os.environ.get("RAY_TPU_WIRE_PICKLE_ONLY", "") not in ("", "0")
+
+
+class WireError(ValueError):
+    """Malformed binary frame (truncated, garbage, or over a cap)."""
+
+
+# --------------------------------------------------------------------------
+# primitive readers (all raise WireError on truncation)
+# --------------------------------------------------------------------------
+
+class _Reader:
+    __slots__ = ("buf", "off")
+
+    def __init__(self, buf: bytes, off: int = 0):
+        self.buf = buf
+        self.off = off
+
+    def _take(self, st: struct.Struct):
+        try:
+            (v,) = st.unpack_from(self.buf, self.off)
+        except struct.error as e:
+            raise WireError(f"truncated frame: {e}") from None
+        self.off += st.size
+        return v
+
+    def u8(self) -> int:
+        return self._take(_U8)
+
+    def u16(self) -> int:
+        return self._take(_U16)
+
+    def u32(self) -> int:
+        return self._take(_U32)
+
+    def u64(self) -> int:
+        return self._take(_U64)
+
+    def i32(self) -> int:
+        return self._take(_I32)
+
+    def f32(self) -> float:
+        return self._take(_F32)
+
+    def f64(self) -> float:
+        return self._take(_F64)
+
+    def raw(self, n: int) -> bytes:
+        if n < 0 or n > MAX_BLOB:
+            raise WireError(f"blob length {n} out of range")
+        end = self.off + n
+        if end > len(self.buf):
+            raise WireError("truncated frame: blob overruns body")
+        out = self.buf[self.off:end]
+        self.off = end
+        return bytes(out) if not isinstance(out, bytes) else out
+
+    def b8(self) -> bytes:          # small id: u8 length prefix
+        return self.raw(self.u8())
+
+    def b32(self) -> bytes:         # payload blob: u32 length prefix
+        return self.raw(self.u32())
+
+    def b64(self) -> bytes:         # large blob: u64 length prefix
+        return self.raw(self.u64())
+
+    def s(self) -> str:             # short utf-8 string
+        try:
+            return self.raw(self.u16()).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireError(f"bad utf-8 in frame: {e}") from None
+
+    def count(self, n: int) -> int:
+        if n > MAX_ITEMS:
+            raise WireError(f"item count {n} over cap")
+        return n
+
+    def done(self) -> None:
+        if self.off != len(self.buf):
+            raise WireError(
+                f"{len(self.buf) - self.off} trailing bytes after frame")
+
+
+def _b8(b: bytes) -> bytes:
+    if len(b) > 255:
+        raise WireError(f"id too long for u8 prefix: {len(b)}")
+    return _U8.pack(len(b)) + b
+
+
+def _s(v: str) -> bytes:
+    raw = v.encode("utf-8")
+    return _U16.pack(len(raw)) + raw
+
+
+def _resources(res: Dict[str, float]) -> bytes:
+    parts = [_U8.pack(len(res))]
+    for k in res:
+        parts.append(_s(k))
+        parts.append(_F64.pack(float(res[k])))
+    return b"".join(parts)
+
+
+def _read_resources(r: _Reader) -> Dict[str, float]:
+    n = r.u8()
+    return {r.s(): r.f64() for _ in range(n)}
+
+
+def _read_id_list(r: _Reader, n: int) -> List[bytes]:
+    """Fast parse of n u8-length-prefixed ids: direct offset arithmetic
+    (the per-id _Reader method chain dominated decode of 1k-oid polls)."""
+    buf, off = r.buf, r.off
+    end = len(buf)
+    out = []
+    for _ in range(n):
+        if off >= end:
+            raise WireError("truncated frame: id list overruns body")
+        ln = buf[off]
+        off += 1
+        nxt = off + ln
+        if nxt > end:
+            raise WireError("truncated frame: id overruns body")
+        out.append(bytes(buf[off:nxt]))
+        off = nxt
+    r.off = off
+    return out
+
+
+def _read_oids(r: _Reader) -> List[bytes]:
+    return _read_id_list(r, r.count(r.u16()))
+
+
+def _oids(ids) -> bytes:
+    parts = [_U16.pack(len(ids))]
+    for oid in ids:
+        parts.append(_b8(oid))
+    return b"".join(parts)
+
+
+# --------------------------------------------------------------------------
+# task spec codec
+# --------------------------------------------------------------------------
+
+def encode_task_spec(p: Dict[str, Any]) -> bytes:
+    """Pack a task payload once, on the owner. Header fields (what the GCS
+    and controllers need) come first so relays parse them without touching
+    the args; args/kwargs blobs are appended verbatim."""
+    parts = [
+        _U8.pack(SPEC_VERSION),
+        _b8(p["task_id"]),
+        _b8(p.get("fn_id", b"")),
+        _s(p.get("name", "") or ""),
+        _I32.pack(int(p.get("max_retries", 0))),
+        _oids(p.get("return_ids", ())),
+        _oids(p.get("deps", ())),
+        _oids(p.get("pin_refs", ())),
+        _resources(p.get("resources", {})),
+    ]
+    args = p.get("args", ())
+    parts.append(_U16.pack(len(args)))
+    for kind, payload in args:
+        parts.append(_U8.pack(1 if kind == "ref" else 0))
+        parts.append(_U32.pack(len(payload)))
+        parts.append(payload)
+    kwargs = p.get("kwargs", {}) or {}
+    parts.append(_U16.pack(len(kwargs)))
+    for key, (kind, payload) in kwargs.items():
+        parts.append(_s(key))
+        parts.append(_U8.pack(1 if kind == "ref" else 0))
+        parts.append(_U32.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _decode_spec_header(r: _Reader) -> Dict[str, Any]:
+    ver = r.u8()
+    if ver != SPEC_VERSION:
+        raise WireError(f"unknown task-spec version {ver}")
+    return {
+        "task_id": r.b8(),
+        "fn_id": r.b8(),
+        "name": r.s(),
+        "max_retries": r.i32(),
+        "return_ids": _read_oids(r),
+        "deps": _read_oids(r),
+        "pin_refs": _read_oids(r),
+        "resources": _read_resources(r),
+    }
+
+
+def decode_task_spec_header(blob: bytes) -> Dict[str, Any]:
+    """Relay-side parse: ids/deps/resources only; the original bytes ride
+    along as ``_spec`` so dispatch can forward them without re-encoding."""
+    out = _decode_spec_header(_Reader(blob))
+    out["_spec"] = blob
+    return out
+
+
+def decode_task_spec(blob: bytes) -> Dict[str, Any]:
+    """Executing-worker parse: the full spec, args included."""
+    r = _Reader(blob)
+    out = _decode_spec_header(r)
+    n_args = r.count(r.u16())
+    out["args"] = [("ref" if r.u8() else "value", r.b32())
+                   for _ in range(n_args)]
+    n_kw = r.count(r.u16())
+    kwargs = {}
+    for _ in range(n_kw):
+        key = r.s()
+        kwargs[key] = ("ref" if r.u8() else "value", r.b32())
+    out["kwargs"] = kwargs
+    r.done()
+    return out
+
+
+# --------------------------------------------------------------------------
+# message encoders — each returns a list of buffers (no length header)
+# --------------------------------------------------------------------------
+
+def _head(code: int, rpc_id) -> bytes:
+    return struct.pack("<BBQ", MAGIC, code, int(rpc_id or 0))
+
+
+def _enc_submit_batch(msg) -> List[bytes]:
+    tasks = msg["tasks"]
+    out = [_head(SUBMIT_BATCH, msg.get("rpc_id")), _U32.pack(len(tasks))]
+    for t in tasks:
+        blob = t.get("_spec") if isinstance(t, dict) else t
+        if blob is None:
+            blob = encode_task_spec(t)
+        out.append(_U32.pack(len(blob)))
+        out.append(blob)
+    return out
+
+
+def _dec_submit_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    tasks = [decode_task_spec_header(r.b32()) for _ in range(n)]
+    r.done()
+    return {"type": "submit_batch", "tasks": tasks, "rpc_id": rpc_id}
+
+
+def _enc_submit_batch_resp(msg) -> List[bytes]:
+    return [_head(SUBMIT_BATCH_RESP, msg.get("rpc_id")),
+            _U32.pack(int(msg.get("count", 0)))]
+
+
+def _dec_submit_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    count = r.u32()
+    r.done()
+    return {"ok": True, "count": count, "rpc_id": rpc_id}
+
+
+def _enc_task_done_batch(msg) -> List[bytes]:
+    items = msg["items"]
+    out = [_head(TASK_DONE_BATCH, msg.get("rpc_id")), _s(msg["node_id"]),
+           _U32.pack(len(items))]
+    for it in items:
+        out.append(_b8(it.get("task_id") or b""))
+        out.append(_resources(it.get("resources") or {}))
+        out.append(_F32.pack(float(it.get("exec_s", 0.0))))
+        out.append(_F32.pack(float(it.get("reg_s", 0.0))))
+        added = it.get("added") or ()
+        out.append(_U16.pack(len(added)))
+        for oid, size in added:
+            out.append(_b8(oid))
+            out.append(_U64.pack(int(size)))
+    return out
+
+
+def _dec_task_done_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    node_id = r.s()
+    n = r.count(r.u32())
+    items = []
+    for _ in range(n):
+        tid = r.b8()
+        item = {"task_id": tid or None,
+                "resources": _read_resources(r),
+                "exec_s": r.f32(), "reg_s": r.f32()}
+        n_added = r.count(r.u16())
+        item["added"] = [[r.b8(), r.u64()] for _ in range(n_added)]
+        items.append(item)
+    r.done()
+    return {"type": "task_done_batch", "node_id": node_id, "items": items,
+            "rpc_id": rpc_id}
+
+
+def _enc_locations_batch(msg) -> List[bytes]:
+    oids = msg["object_ids"]
+    out = [_head(LOCATIONS_BATCH, msg.get("rpc_id")),
+           _F64.pack(float(msg.get("wait_s") or 0.0)),
+           _F32.pack(float(msg.get("wave_s") or 0.0)),
+           _U8.pack(1 if msg.get("probe", True) else 0),
+           _U32.pack(len(oids))]
+    for oid in oids:
+        out.append(_b8(oid))
+    return out
+
+
+def _dec_locations_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    wait_s = r.f64()
+    wave_s = r.f32()
+    probe = bool(r.u8())
+    oids = _read_id_list(r, r.count(r.u32()))
+    r.done()
+    return {"type": "locations_batch", "object_ids": oids,
+            "wait_s": wait_s, "wave_s": wave_s, "probe": probe,
+            "rpc_id": rpc_id}
+
+
+_LOC_ERROR = 1
+_LOC_SPILLED = 2
+
+
+def _enc_locations_batch_resp(msg) -> List[bytes]:
+    objects = msg.get("objects", {})
+    out = [_head(LOCATIONS_BATCH_RESP, msg.get("rpc_id")),
+           _U32.pack(len(objects))]
+    for oid, info in objects.items():
+        out.append(_b8(oid))
+        blob = info.get("error_blob")
+        if blob is not None:
+            out.append(_U8.pack(_LOC_ERROR))
+            out.append(_U64.pack(len(blob)))
+            out.append(blob)
+            continue
+        out.append(_U8.pack(_LOC_SPILLED if info.get("spilled") else 0))
+        addrs = info.get("addresses", [])
+        transfer = info.get("transfer_addresses", [])
+        out.append(_U8.pack(len(addrs)))
+        for i, addr in enumerate(addrs):
+            t = transfer[i] if i < len(transfer) else [addr[0], 0]
+            out.append(_s(addr[0]))
+            out.append(_U32.pack(int(addr[1])))
+            out.append(_s(t[0]))
+            out.append(_U32.pack(int(t[1])))
+    return out
+
+
+def _dec_locations_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    objects = {}
+    for _ in range(n):
+        oid = r.b8()
+        flags = r.u8()
+        if flags & _LOC_ERROR:
+            objects[oid] = {"error_blob": r.b64()}
+            continue
+        n_addr = r.u8()
+        addrs, transfer = [], []
+        for _ in range(n_addr):
+            addrs.append([r.s(), r.u32()])
+            transfer.append([r.s(), r.u32()])
+        info = {"addresses": addrs, "transfer_addresses": transfer}
+        if flags & _LOC_SPILLED:
+            info["spilled"] = True
+        objects[oid] = info
+    r.done()
+    return {"ok": True, "objects": objects, "rpc_id": rpc_id}
+
+
+def _enc_fetch_batch(msg) -> List[bytes]:
+    oids = msg["object_ids"]
+    out = [_head(FETCH_BATCH, msg.get("rpc_id")), _U32.pack(len(oids))]
+    for oid in oids:
+        out.append(_b8(oid))
+    return out
+
+
+def _dec_fetch_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    oids = _read_id_list(r, r.count(r.u32()))
+    r.done()
+    return {"type": "fetch_batch", "object_ids": oids, "rpc_id": rpc_id}
+
+
+def _enc_fetch_batch_resp(msg) -> List[bytes]:
+    blobs = msg.get("blobs", {})
+    out = [_head(FETCH_BATCH_RESP, msg.get("rpc_id")), _U32.pack(len(blobs))]
+    for oid, blob in blobs.items():
+        out.append(_b8(oid))
+        out.append(_U64.pack(len(blob)))
+        out.append(blob)    # pass-through buffer: no copy on encode
+    return out
+
+
+def _dec_fetch_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    blobs = {}
+    for _ in range(n):
+        oid = r.b8()
+        blobs[oid] = r.b64()
+    r.done()
+    return {"ok": True, "blobs": blobs, "rpc_id": rpc_id}
+
+
+def _enc_object_added(msg) -> List[bytes]:
+    return [_head(OBJECT_ADDED, msg.get("rpc_id")),
+            _b8(msg["object_id"]), _U64.pack(int(msg.get("size", 0)))]
+
+
+def _dec_object_added(r: _Reader, rpc_id) -> Dict[str, Any]:
+    oid = r.b8()
+    size = r.u64()
+    r.done()
+    return {"type": "object_added", "object_id": oid, "size": size,
+            "rpc_id": rpc_id}
+
+
+def _enc_assign_batch(msg) -> List[bytes]:
+    tasks = msg["tasks"]
+    blobs = []
+    for t in tasks:
+        blob = t.get("_spec")
+        if blob is None:
+            return None  # mixed batch: pickle carries it
+        blobs.append(blob)
+    out = [_head(ASSIGN_BATCH, msg.get("rpc_id")), _U32.pack(len(blobs))]
+    for blob in blobs:
+        out.append(_U32.pack(len(blob)))
+        out.append(blob)    # raw relay: spec bytes forwarded verbatim
+    return out
+
+
+def _dec_assign_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    tasks = [decode_task_spec_header(r.b32()) for _ in range(n)]
+    r.done()
+    return {"type": "assign_batch", "tasks": tasks, "rpc_id": rpc_id}
+
+
+def _enc_execute_task(msg) -> Optional[List[bytes]]:
+    blob = msg.get("_spec")
+    if blob is None:
+        return None
+    return [_head(EXECUTE_TASK, msg.get("rpc_id")),
+            _U64.pack(len(blob)), blob]
+
+
+def _dec_execute_task(r: _Reader, rpc_id) -> Dict[str, Any]:
+    blob = r.b64()
+    r.done()
+    # Terminal hop: the executing worker is the only receiver, so the full
+    # spec (args included) is decoded here — the one decode in the relay.
+    out = decode_task_spec(blob)
+    out["type"] = "execute_task"
+    out["rpc_id"] = rpc_id
+    return out
+
+
+def _enc_task_done(msg) -> List[bytes]:
+    added = msg.get("added", ())
+    out = [_head(TASK_DONE, msg.get("rpc_id")),
+           _U32.pack(int(msg.get("pid", 0))),
+           _oids(msg.get("return_ids", ())),
+           _U16.pack(len(added))]
+    for oid, size in added:
+        out.append(_b8(oid))
+        out.append(_U64.pack(int(size)))
+    out.append(_F32.pack(float(msg.get("exec_s", 0.0))))
+    out.append(_F32.pack(float(msg.get("reg_s", 0.0))))
+    return out
+
+
+def _dec_task_done(r: _Reader, rpc_id) -> Dict[str, Any]:
+    pid = r.u32()
+    return_ids = _read_oids(r)
+    n = r.count(r.u16())
+    added = [[r.b8(), r.u64()] for _ in range(n)]
+    exec_s = r.f32()
+    reg_s = r.f32()
+    r.done()
+    return {"type": "task_done", "pid": pid, "return_ids": return_ids,
+            "added": added, "exec_s": exec_s, "reg_s": reg_s,
+            "rpc_id": rpc_id}
+
+
+# Request/push encoders keyed by message "type".
+_ENCODERS = {
+    "submit_batch": _enc_submit_batch,
+    "task_done_batch": _enc_task_done_batch,
+    "locations_batch": _enc_locations_batch,
+    "fetch_batch": _enc_fetch_batch,
+    "object_added": _enc_object_added,
+    "assign_batch": _enc_assign_batch,
+    "execute_task": _enc_execute_task,
+    "task_done": _enc_task_done,
+}
+
+# Response encoders keyed by the *request* type they answer.
+_RESP_ENCODERS = {
+    "submit_batch": _enc_submit_batch_resp,
+    "locations_batch": _enc_locations_batch_resp,
+    "fetch_batch": _enc_fetch_batch_resp,
+}
+
+_DECODERS = {
+    SUBMIT_BATCH: _dec_submit_batch,
+    SUBMIT_BATCH_RESP: _dec_submit_batch_resp,
+    TASK_DONE_BATCH: _dec_task_done_batch,
+    LOCATIONS_BATCH: _dec_locations_batch,
+    LOCATIONS_BATCH_RESP: _dec_locations_batch_resp,
+    FETCH_BATCH: _dec_fetch_batch,
+    FETCH_BATCH_RESP: _dec_fetch_batch_resp,
+    OBJECT_ADDED: _dec_object_added,
+    ASSIGN_BATCH: _dec_assign_batch,
+    EXECUTE_TASK: _dec_execute_task,
+    TASK_DONE: _dec_task_done,
+}
+
+
+def encode(msg: Dict[str, Any]) -> Optional[List[bytes]]:
+    """Binary-encode a request/push message; None when the type has no
+    fast-path codec (caller falls back to pickle)."""
+    enc = _ENCODERS.get(msg.get("type"))
+    if enc is None:
+        return None
+    return enc(msg)
+
+
+def encode_response(req_type: str, msg: Dict[str, Any]
+                    ) -> Optional[List[bytes]]:
+    """Binary-encode a response to ``req_type``; only ok-responses have a
+    binary form (error dicts carry tracebacks and stay pickled)."""
+    if msg.get("ok") is False:
+        return None
+    enc = _RESP_ENCODERS.get(req_type)
+    if enc is None:
+        return None
+    return enc(msg)
+
+
+def is_binary(body) -> bool:
+    return len(body) > 0 and body[0] == MAGIC
+
+
+def decode(body: bytes) -> Dict[str, Any]:
+    """Decode one binary frame body into the dict the pickle path would
+    have produced. Raises WireError on truncated/garbage frames."""
+    if len(body) < 10:
+        raise WireError(f"binary frame too short: {len(body)} bytes")
+    if body[0] != MAGIC:
+        raise WireError(f"bad magic byte 0x{body[0]:02x}")
+    code = body[1]
+    dec = _DECODERS.get(code)
+    if dec is None:
+        raise WireError(f"unknown message code 0x{code:02x}")
+    (rpc_id,) = _U64.unpack_from(body, 2)
+    msg = dec(_Reader(body, 10), rpc_id or None)
+    if msg.get("rpc_id") is None:
+        msg.pop("rpc_id", None)
+    return msg

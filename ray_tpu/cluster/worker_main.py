@@ -38,15 +38,48 @@ def main():
 
     from ray_tpu._native import open_store
     from ray_tpu._private.serialization import get_context
+    from ray_tpu.cluster import wire
     from ray_tpu.cluster.core_worker import ClusterCoreWorker
     from ray_tpu.cluster.protocol import RpcClient
     from ray_tpu.exceptions import TaskError
 
     inbox: "queue.Queue[Dict]" = queue.Queue()
+    # Revocation bookkeeping for pipelined executes (the controller may
+    # pre-push a second task into this inbox; if the current task blocks,
+    # the controller revokes the queued one and re-dispatches it
+    # elsewhere). The reader thread answers revokes OUT OF BAND: it knows
+    # exactly which executes are still queued (``inbox_ids``) vs already
+    # started, so the ack is authoritative and a revoked task can never
+    # also run here (at-most-once preserved).
+    revoke_lock = threading.Lock()
+    inbox_ids: set = set()
+    revoked: set = set()
+
+    def on_push(msg: Dict) -> None:
+        mtype = msg.get("type")
+        if mtype == "revoke_execute":
+            tid = msg.get("task_id")
+            with revoke_lock:
+                ok = tid in inbox_ids
+                if ok:
+                    inbox_ids.discard(tid)
+                    revoked.add(tid)
+            try:
+                controller.send_oneway({"type": "revoke_ack",
+                                        "pid": os.getpid(),
+                                        "task_id": tid, "revoked": ok})
+            except (ConnectionError, OSError):
+                pass
+            return
+        if mtype == "execute_task" and msg.get("task_id") is not None:
+            with revoke_lock:
+                inbox_ids.add(msg["task_id"])
+        inbox.put(msg)
+
     # A dead controller connection must terminate the worker (otherwise a
     # SIGKILL'd controller leaves its workers orphaned on inbox.get forever).
     controller = RpcClient(
-        chost, int(cport), push_handler=inbox.put,
+        chost, int(cport), push_handler=on_push,
         on_close=lambda: inbox.put({"type": "shutdown"}),
     )
 
@@ -69,7 +102,8 @@ def main():
     worker.mode = "worker"
     worker.connected = True
 
-    controller.call({"type": "register_worker", "pid": os.getpid()})
+    controller.call({"type": "register_worker", "pid": os.getpid(),
+                     "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION})
 
     # Periodic profile-span flush to the GCS (reference: profiling.cc's
     # batched AddProfileData timer).
@@ -155,6 +189,9 @@ def main():
     # thread: concurrent actor methods (max_concurrency/asyncio) each
     # accumulate their own adds.
     _pending_adds: Dict[int, list] = {}
+    # Per-thread [exec_s, reg_s] for the task being finished: the phase
+    # profiler's worker-side samples, carried inside task_done.
+    _phase_times: Dict[int, list] = {}
 
     def _store_blob(oid: bytes, blob: bytes) -> None:
         """Arena write with DEFERRED registration (falls back to the
@@ -223,6 +260,8 @@ def main():
         client, so the invariant holds per task regardless of interleaving.
         """
         try:
+            phases = _phase_times.pop(threading.get_ident(), None) \
+                or (0.0, 0.0)
             core._controller((chost, int(cport))).send_oneway({
                 "type": "task_done",
                 "pid": os.getpid(),
@@ -230,19 +269,23 @@ def main():
                 # This task's result blobs: registered by the controller
                 # BEFORE it processes the finish (same message).
                 "added": _pending_adds.pop(threading.get_ident(), []),
+                # Phase profiler samples (execution / result-store wall).
+                "exec_s": phases[0], "reg_s": phases[1],
             })
             return True
         except (ConnectionError, OSError):
             inbox.put({"type": "shutdown"})  # main loop exits
             return False
 
-    def complete_actor_method(msg, result=None, error=None) -> None:
+    def complete_actor_method(msg, result=None, error=None,
+                              exec_s: float = 0.0) -> None:
         """Store returns (or the error), checkpoint, report task_done.
 
         The store->finish pair runs in ONE thread so the TCP FIFO invariant
         documented on finish() holds per task. Shared by the inline, pooled,
         and async execution paths — a fix to error storage or the ordering
         applies to all three at once."""
+        t1 = time.monotonic()
         try:
             if error is None:
                 run_returns(msg, result)
@@ -255,6 +298,8 @@ def main():
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
         finally:
+            _phase_times[threading.get_ident()] = \
+                [exec_s, time.monotonic() - t1]
             finish(msg)
 
     def record_span(kind: str, name: str, t0: float,
@@ -284,12 +329,13 @@ def main():
             if asyncio.iscoroutine(result):
                 result = asyncio.run(result)
         except BaseException as e:  # noqa: BLE001 - task errors are data
-            complete_actor_method(msg, error=e)
+            complete_actor_method(msg, error=e,
+                                  exec_s=time.monotonic() - t0)
             return
         finally:
             record_span("actor_task", msg.get("method", "method"), t0,
                         "actor_id", msg.get("actor_id"))
-        complete_actor_method(msg, result)
+        complete_actor_method(msg, result, exec_s=time.monotonic() - t0)
 
     async def run_actor_method_async(msg) -> None:
         """Coroutine twin for the persistent loop: the method's coroutine is
@@ -317,6 +363,20 @@ def main():
         mtype = msg.get("type")
         if mtype == "shutdown":
             break
+        if mtype == "execute_task" and msg.get("task_id") is not None:
+            with revoke_lock:
+                inbox_ids.discard(msg["task_id"])
+                if msg["task_id"] in revoked:
+                    # Revoked while queued: the controller re-dispatched it
+                    # elsewhere; executing here too would double-run it.
+                    revoked.discard(msg["task_id"])
+                    continue
+        if "_spec" in msg and "args" not in msg:
+            # Pickle-relayed opaque spec (mixed-wire path): the header dict
+            # carries the encoded blob but not the args — the full decode
+            # happens here, at the executing worker, exactly like the
+            # binary execute_task frame.
+            msg = dict(wire.decode_task_spec(msg["_spec"]), type=mtype)
         if mtype == "execute_actor_task" and actor_instance is not None:
             # Dispatch order == controller FIFO order for all three modes;
             # completion may interleave for async/pooled actors (that is
@@ -339,9 +399,14 @@ def main():
                 try:
                     result = fn(*pos, **kwargs)
                 finally:
+                    _phase_times[threading.get_ident()] = \
+                        [time.monotonic() - t0, 0.0]
                     record_span("task", getattr(fn, "__name__", "task"),
                                 t0, "task_id", msg.get("task_id"))
+                t1 = time.monotonic()
                 run_returns(msg, result)
+                _phase_times[threading.get_ident()][1] = \
+                    time.monotonic() - t1
             elif mtype == "create_actor_instance":
                 cls = load_function(msg["fn_id"])
                 pos, kwargs = resolve_args(msg)

@@ -12,10 +12,23 @@ here and used for every cross-round number:
     the same cluster — the warm, steady-state row
     (``batch_warm_tasks_per_sec``; ``batch_tasks_per_sec`` stays the
     cold first batch, comparable with pre-v2 history);
+  - (protocol v3) a per-phase latency breakdown for the warm batch:
+    ms per 1,000 tasks spent in each of the 7 control-plane phases
+    (driver serialize -> submit RPC -> GCS placement -> dispatch relay
+    -> worker exec -> result registration -> driver fetch), harvested
+    from the driver's phase cells + the GCS per-handler stats RPC;
   - report MEDIAN + min/max spread across runs, as one JSON line
     (also appended to CLUSTER_LAT.json with a timestamp).
 
     python scripts/cluster_lat.py [--runs 5] [--serial 300] [--batch 5000]
+
+``--sim-nodes 16,64,256`` additionally measures the control plane's
+ceiling vs node count with SIMULATED controllers: an in-process GCS, N
+fake nodes that complete every dispatched task instantly (register the
+return object + report done, zero data plane), and a driver pushing one
+batch through submit_batch -> placement -> relay -> completion ->
+directory. That isolates pure control-plane message cost from worker
+execution, at node counts a laptop can't host for real.
 """
 
 from __future__ import annotations
@@ -30,6 +43,43 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASES = ("driver_serialize", "submit_rpc", "gcs_place", "dispatch_relay",
+          "worker_exec", "result_register", "driver_fetch")
+_GCS_PHASES = ("gcs_place", "dispatch_relay", "worker_exec",
+               "result_register")
+
+
+def _phase_snapshot(core) -> dict:
+    """{phase: [count, seconds]} merged from driver cells + GCS handler
+    stats (phase:* cells ride the existing debug_stats RPC)."""
+    out = {}
+    for name, cell in core.phase_stats.items():
+        out[name] = [cell[0], cell[1]]
+    handlers = core.gcs.call({"type": "debug_stats"})["handlers"]
+    for name in _GCS_PHASES:
+        cell = handlers.get(f"phase:{name}")
+        if cell is not None:
+            out[name] = [cell["count"], cell["total_s"]]
+    for key in ("relay:opaque", "relay:pickled"):
+        cell = handlers.get(key)
+        if cell is not None:
+            out[key] = [cell["count"], cell["total_s"]]
+    return out
+
+
+def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
+    """Per-1k-task milliseconds spent in each phase over the window."""
+    out = {}
+    for name in PHASES:
+        c0, s0 = before.get(name, [0, 0.0])
+        c1, s1 = after.get(name, [0, 0.0])
+        dc, ds = c1 - c0, s1 - s0
+        out[name] = round(ds / dc * 1e6, 3) if dc > 0 else None
+    for key in ("relay:opaque", "relay:pickled"):
+        out[key.replace(":", "_")] = (after.get(key, [0, 0.0])[0]
+                                      - before.get(key, [0, 0.0])[0])
+    return out
 
 
 def one_run(serial_n: int, batch_k: int) -> dict:
@@ -61,18 +111,190 @@ def one_run(serial_n: int, batch_k: int) -> dict:
         # worker pool / leases / caches are warm — the regime a serving
         # deployment actually runs in. batch_tasks_per_sec stays the
         # cold first batch for cross-round comparability with pre-warm
-        # history entries.
+        # history entries. The phase breakdown is measured over THIS
+        # batch (deltas around it), so it describes the steady state.
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core
+        ph0 = _phase_snapshot(core)
         t0 = time.perf_counter()
         ray_tpu.get([noop.remote() for _ in range(batch_k)])
         dt_warm = time.perf_counter() - t0
+        phases = _phase_delta_ms_per_1k(ph0, _phase_snapshot(core))
         return {"p50_ms": round(pct(.5), 3), "p90_ms": round(pct(.9), 3),
                 "p99_ms": round(pct(.99), 3),
                 "min_ms": round(lats[0] * 1e3, 3),
                 "batch_tasks_per_sec": round(batch_k / dt, 1),
-                "batch_warm_tasks_per_sec": round(batch_k / dt_warm, 1)}
+                "batch_warm_tasks_per_sec": round(batch_k / dt_warm, 1),
+                "phases_ms_per_1k": phases}
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# simulated many-node scaling (control-plane ceiling vs node count)
+# ---------------------------------------------------------------------------
+
+class _SimGcs:
+    """An in-process GcsServer on its own event-loop thread."""
+
+    def __init__(self):
+        import asyncio
+        import threading
+
+        from ray_tpu._private.config import get_config
+        from ray_tpu.cluster.gcs import GcsServer
+
+        self.loop = asyncio.new_event_loop()
+        self.gcs = GcsServer(get_config())
+        started = threading.Event()
+        box = {}
+
+        def run():
+            asyncio.set_event_loop(self.loop)
+            box["port"] = self.loop.run_until_complete(self.gcs.start())
+            started.set()
+            self.loop.run_forever()
+
+        self.thread = threading.Thread(target=run, daemon=True,
+                                       name="sim-gcs")
+        self.thread.start()
+        if not started.wait(30):
+            raise TimeoutError("sim GCS did not start")
+        self.port = box["port"]
+
+    def stop(self):
+        import asyncio
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.gcs.stop(), self.loop).result(10)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+class _SimController:
+    """A controller that exists only on the wire: registers a node, then
+    completes every dispatched task instantly (one coalesced write per
+    assign wave: location registrations + the task_done batch)."""
+
+    def __init__(self, port: int, idx: int, cpus: float):
+        from ray_tpu.cluster import wire
+        from ray_tpu.cluster.protocol import RpcClient
+
+        self.node_id = f"sim{idx:04d}" + os.urandom(8).hex()
+        self.cli = RpcClient("127.0.0.1", port, push_handler=self._on_push)
+        self.cli.call({
+            "type": "register_node", "node_id": self.node_id,
+            "address": ["127.0.0.1", 0], "resources": {"CPU": cpus},
+            "wire": wire.WIRE_VERSION,
+        })
+
+    def _on_push(self, msg):
+        mtype = msg.get("type")
+        if mtype == "assign_batch":
+            tasks = msg.get("tasks", [])
+        elif mtype == "assign_task":
+            tasks = [msg]
+        else:
+            return
+        out = []
+        for t in tasks:
+            for oid in t.get("return_ids", []):
+                out.append({"type": "add_object_location", "object_id": oid,
+                            "node_id": self.node_id, "size": 0})
+        out.append({"type": "task_done_batch", "node_id": self.node_id,
+                    "items": [{"task_id": t.get("task_id"),
+                               "resources": t.get("resources", {}),
+                               "exec_s": 0.0, "reg_s": 0.0}
+                              for t in tasks]})
+        try:
+            self.cli.send_oneway_many(out)
+        except (ConnectionError, OSError):
+            pass
+
+    def heartbeat(self):
+        try:
+            self.cli.send_oneway({"type": "heartbeat",
+                                  "node_id": self.node_id})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        self.cli.close()
+
+
+def sim_scaling_row(num_nodes: int, num_tasks: int) -> dict:
+    """One E2E control-plane run against ``num_nodes`` simulated
+    controllers: submit -> place -> relay -> complete -> directory."""
+    import threading
+
+    from ray_tpu.cluster import wire
+    from ray_tpu.cluster.protocol import RpcClient
+
+    sim = _SimGcs()
+    nodes = []
+    stop_hb = threading.Event()
+    try:
+        cpus = max(4.0, 2.0 * num_tasks / num_nodes)
+        nodes = [_SimController(sim.port, i, cpus) for i in range(num_nodes)]
+
+        def hb_loop():
+            while not stop_hb.wait(0.4):
+                for n in nodes:
+                    n.heartbeat()
+
+        threading.Thread(target=hb_loop, daemon=True,
+                         name="sim-heartbeats").start()
+
+        driver = RpcClient("127.0.0.1", sim.port)
+        specs = []
+        oids = []
+        for _ in range(num_tasks):
+            tid = os.urandom(16)
+            oid = tid + (1).to_bytes(4, "little", signed=True) + b"\0" * 4
+            oids.append(oid)
+            specs.append({
+                "task_id": tid, "fn_id": b"\0" * 16, "name": "sim",
+                "args": [], "kwargs": {}, "deps": [], "pin_refs": [],
+                "return_ids": [oid], "resources": {"CPU": 1.0},
+                "max_retries": 0,
+            })
+        t0 = time.perf_counter()
+        for i in range(0, num_tasks, 256):
+            chunk = specs[i:i + 256]
+            for t in chunk:
+                t["_spec"] = wire.encode_task_spec(t)
+            driver.call({"type": "submit_batch", "tasks": chunk})
+        pending = set(oids)
+        deadline = time.monotonic() + 120.0
+        while pending and time.monotonic() < deadline:
+            ask = list(pending)[:4096]
+            resp = driver.call({"type": "locations_batch",
+                                "object_ids": ask, "wait_s": 1.0,
+                                "probe": False}, timeout=35.0)
+            for oid in resp.get("objects", {}):
+                pending.discard(oid)
+        dt = time.perf_counter() - t0
+        handlers = driver.call({"type": "debug_stats"})["handlers"]
+        row = {
+            "nodes": num_nodes, "tasks": num_tasks,
+            "completed": num_tasks - len(pending),
+            "tasks_per_sec": round((num_tasks - len(pending)) / dt, 1),
+            "relay_opaque": handlers.get("relay:opaque", {}).get("count", 0),
+            "relay_pickled": handlers.get(
+                "relay:pickled", {}).get("count", 0),
+        }
+        driver.close()
+        return row
+    finally:
+        stop_hb.set()
+        for n in nodes:
+            n.close()
+        sim.stop()
 
 
 def main():
@@ -80,6 +302,12 @@ def main():
     ap.add_argument("--runs", type=int, default=5)
     ap.add_argument("--serial", type=int, default=300)
     ap.add_argument("--batch", type=int, default=5000)
+    ap.add_argument("--sim-nodes", type=str, default=None,
+                    help="comma list of simulated-controller counts "
+                         "(e.g. 16,64,256) for the scaling rows")
+    ap.add_argument("--sim-tasks", type=int, default=5000)
+    ap.add_argument("--note", type=str, default=None,
+                    help="annotation recorded with the history entry")
     ap.add_argument("--no-record", action="store_true",
                     help="don't append to CLUSTER_LAT.json")
     args = ap.parse_args()
@@ -102,13 +330,33 @@ def main():
                      # v2: a warm second batch per run (same cluster);
                      # batch_tasks_per_sec remains the cold first batch,
                      # comparable with pre-v2 history entries.
-                     "warm_batch": True},
-        "p50_ms": agg("p50_ms"),
-        "p99_ms": agg("p99_ms"),
-        "batch_tasks_per_sec": agg("batch_tasks_per_sec"),
-        "batch_warm_tasks_per_sec": agg("batch_warm_tasks_per_sec"),
+                     "warm_batch": True,
+                     # v3: per-phase ms/1k-task breakdown of the warm batch
+                     "phase_breakdown": True},
         "unix": int(time.time()),
     }
+    if runs:
+        out["p50_ms"] = agg("p50_ms")
+        out["p99_ms"] = agg("p99_ms")
+        out["batch_tasks_per_sec"] = agg("batch_tasks_per_sec")
+        out["batch_warm_tasks_per_sec"] = agg("batch_warm_tasks_per_sec")
+        phases = {}
+        for name in PHASES:
+            vals = sorted(r["phases_ms_per_1k"].get(name) or 0.0
+                          for r in runs)
+            phases[name] = statistics.median(vals)
+        phases["relay_pickled"] = max(
+            r["phases_ms_per_1k"].get("relay_pickled", 0) for r in runs)
+        out["phases_ms_per_1k"] = phases
+    if args.sim_nodes:
+        rows = []
+        for n in (int(x) for x in args.sim_nodes.split(",") if x):
+            row = sim_scaling_row(n, args.sim_tasks)
+            rows.append(row)
+            print(f"# sim {n} nodes: {row}", file=sys.stderr)
+        out["sim_scaling"] = rows
+    if args.note:
+        out["note"] = args.note
     print(json.dumps(out))
     if not args.no_record:
         path = os.path.join(REPO, "CLUSTER_LAT.json")
