@@ -578,6 +578,14 @@ class LocalRuntime:
 
     # ------------------------------------------------------------------ tasks
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
+        from . import tracing
+
+        trace = tracing.maybe_sample()
+        if trace is not None:
+            # Local-mode parity with the cluster tracer: sampled tasks get
+            # a phase lane in timeline() (single process => the only
+            # control-plane phase with real wall time is worker_exec).
+            spec.metadata["trace"] = trace.hex()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         pending = PendingTask(spec, fn, retries_left=spec.max_retries)
         deps = spec.dependencies()
@@ -688,10 +696,16 @@ class LocalRuntime:
             self._store_error(spec, err)
             self._unpin_args(spec.dependencies())
         finally:
+            now = time.monotonic()
             self.events.record(
-                "task", spec.function.repr_name, t0, time.monotonic(),
+                "task", spec.function.repr_name, t0, now,
                 task_id=spec.task_id.hex(),
             )
+            trace = spec.metadata.get("trace")
+            if trace:
+                self.events.record(
+                    "phase", "worker_exec", t0, now,
+                    trace=trace, task_id=spec.task_id.hex())
 
     # -------------------------------------------------------------- arguments
     def _resolve_args_from_spec(self, spec: TaskSpec) -> Tuple[list, dict]:

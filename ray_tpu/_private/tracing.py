@@ -1,0 +1,176 @@
+"""Per-task distributed tracing (reference: Ray's per-task state tracking +
+timeline primitives, arXiv:1712.05889 §4; the critical-path observation that
+stragglers are located by per-task span data, not aggregates,
+arXiv:1711.01912).
+
+A *trace* is one sampled task followed across every control-plane hop. The
+trace context is 8 random bytes carried inside the task spec (binary wire
+frames encode it as a versioned spec-header extension — see
+``cluster/wire.py`` SPEC_VERSION 2; pickle frames just carry the dict key).
+Each hop records wall-clock *spans* for the phases it owns — the same 7
+phases the aggregate profiler (PR 2) defines:
+
+    driver_serialize -> submit_rpc -> gcs_place -> dispatch_relay
+    -> worker_exec -> result_register -> driver_fetch
+
+Spans flush in batches to the GCS trace table (a ring buffer beside
+``profile_events``) where three consumers read them: ``ray_tpu.timeline()``
+(chrome-trace lanes, one lane per trace), the straggler report
+(``cli trace`` / ``scripts/cluster_lat.py --traces``), and the dashboard.
+
+Sampling (default 1/64, ``RAY_TPU_TRACE_SAMPLE``; 0 disables, 1 traces
+everything) keeps the submit hot path at one counter increment per task.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Phase order IS the trace's causal order; reports and monotonicity checks
+# key off this tuple.
+PHASES = ("driver_serialize", "submit_rpc", "gcs_place", "dispatch_relay",
+          "worker_exec", "result_register", "driver_fetch")
+
+_DEFAULT_RATE = 64
+
+_counter = itertools.count()
+_lock = threading.Lock()
+_metrics_box: Dict[str, Any] = {}
+
+
+_rate_cache = ("\0unset", _DEFAULT_RATE)
+
+
+def sample_rate() -> int:
+    """1-in-N sampling rate from ``RAY_TPU_TRACE_SAMPLE`` (0 = off). The
+    env var is re-read per call (tests monkeypatch it) but parsed once
+    per distinct value — this runs on the per-task submit hot path."""
+    global _rate_cache
+    raw = os.environ.get("RAY_TPU_TRACE_SAMPLE", "")
+    cached = _rate_cache
+    if cached[0] == raw:
+        return cached[1]
+    if not raw:
+        rate = _DEFAULT_RATE
+    else:
+        try:
+            rate = max(0, int(raw))
+        except ValueError:
+            rate = _DEFAULT_RATE
+    _rate_cache = (raw, rate)
+    return rate
+
+
+def maybe_sample() -> Optional[bytes]:
+    """Per-task sampling decision: every Nth submission gets a fresh 8-byte
+    trace id; everything else pays one counter increment."""
+    rate = sample_rate()
+    if rate <= 0:
+        return None
+    if next(_counter) % rate:
+        return None
+    _trace_metrics()["sampled"].record(1.0)
+    return os.urandom(8)
+
+
+def _trace_metrics() -> Dict[str, Any]:
+    """Lazily-registered tracing counters (driver/worker side; rides the
+    same registry the Prometheus endpoint renders)."""
+    with _lock:
+        if not _metrics_box:
+            from ..metrics import Count, Histogram, get_or_create
+
+            _metrics_box["sampled"] = get_or_create(
+                Count, "trace_tasks_sampled",
+                description="tasks selected for per-task tracing")
+            _metrics_box["spans"] = get_or_create(
+                Count, "trace_spans_recorded", tag_keys=("phase",),
+                description="trace spans recorded in this process")
+            _metrics_box["phase_ms"] = get_or_create(
+                Histogram, "trace_phase_ms", tag_keys=("phase",),
+                description="per-phase wall time of sampled tasks",
+                boundaries=[0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000])
+        return _metrics_box
+
+
+def make_span(trace: bytes, task_id: Optional[bytes], phase: str,
+              start_mono: float, end_mono: float,
+              src: str = "") -> Dict[str, Any]:
+    """One phase span. Takes time.monotonic() endpoints (exact durations)
+    and anchors them to wall clock here — the offset is constant per
+    process, so durations stay exact while epochs become comparable
+    across machines (same convention as profile-event flush)."""
+    off = time.time() - time.monotonic()
+    m = _trace_metrics()
+    tags = {"phase": phase}
+    m["spans"].record(1.0, tags=tags)
+    m["phase_ms"].record((end_mono - start_mono) * 1e3, tags=tags)
+    return {
+        "trace": trace.hex() if isinstance(trace, bytes) else str(trace),
+        "task_id": (task_id.hex() if isinstance(task_id, bytes)
+                    else str(task_id or "")),
+        "phase": phase,
+        "start": start_mono + off,
+        "end": end_mono + off,
+        "src": src,
+    }
+
+
+# --------------------------------------------------------------------------
+# consumers: trace grouping + the straggler report
+# --------------------------------------------------------------------------
+
+def group_traces(spans: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Group raw spans by trace id:
+    {trace: {"task_id", "phases": {phase: [start, end]}, "total_ms"}}.
+    A phase reported twice (e.g. a re-dispatched retry) keeps the widest
+    window. total_ms spans first start -> last end across phases."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for sp in spans:
+        tr = sp.get("trace")
+        if not tr:
+            continue
+        rec = out.setdefault(tr, {"task_id": sp.get("task_id", ""),
+                                  "phases": {}})
+        if sp.get("task_id"):
+            rec["task_id"] = sp["task_id"]
+        cur = rec["phases"].get(sp["phase"])
+        if cur is None:
+            rec["phases"][sp["phase"]] = [sp["start"], sp["end"]]
+        else:
+            cur[0] = min(cur[0], sp["start"])
+            cur[1] = max(cur[1], sp["end"])
+    for rec in out.values():
+        ph = rec["phases"]
+        rec["total_ms"] = round(
+            (max(p[1] for p in ph.values())
+             - min(p[0] for p in ph.values())) * 1e3, 3) if ph else 0.0
+    return out
+
+
+def straggler_report(spans: List[Dict[str, Any]], top_k: int = 10) -> str:
+    """Top-k slowest sampled tasks with their latency attributed by phase —
+    the per-task answer to "why was this task's p99 37x its p50" that the
+    aggregate phase table cannot give."""
+    traces = group_traces(spans)
+    if not traces:
+        return "no sampled traces (is RAY_TPU_TRACE_SAMPLE > 0?)"
+    complete = sorted(traces.items(), key=lambda kv: -kv[1]["total_ms"])
+    head = (f"{'TRACE':<18} {'TASK':<18} {'TOTAL':>9} "
+            + " ".join(f"{p.replace('driver_', 'drv_').replace('result_', 'res_'):>11}"
+                       for p in PHASES))
+    lines = [f"{len(traces)} sampled traces; top {min(top_k, len(complete))}"
+             f" by end-to-end latency (ms per phase; . = no span)", head]
+    for tr, rec in complete[:top_k]:
+        cells = []
+        for p in PHASES:
+            win = rec["phases"].get(p)
+            cells.append(f"{(win[1] - win[0]) * 1e3:>11.3f}" if win
+                         else f"{'.':>11}")
+        lines.append(f"{tr:<18} {rec['task_id'][:16]:<18} "
+                     f"{rec['total_ms']:>9.3f} " + " ".join(cells))
+    return "\n".join(lines)

@@ -199,6 +199,28 @@ def timeline(filename: Optional[str] = None,
     worker = global_worker()
     worker.check_connected()
     events = []
+    if hasattr(worker.core, "cluster_trace_spans"):
+        # Per-task control-plane traces (sampled tasks): each trace becomes
+        # one lane whose 7 phase spans show where that task's latency went
+        # — merged into the same chrome-trace stream as the execution
+        # lanes below.
+        try:
+            spans = worker.core.cluster_trace_spans(limit=limit)
+        except Exception:  # noqa: BLE001 - GCS restart window
+            spans = []
+        for sp in spans:
+            events.append({
+                "cat": "phase",
+                "name": sp["phase"],
+                "ph": "X",
+                "ts": sp["start"] * 1e6,
+                "dur": (sp["end"] - sp["start"]) * 1e6,
+                "pid": f"trace:{sp['trace'][:12]}",
+                "tid": sp.get("src", "0"),
+                "args": {"trace": sp["trace"],
+                         "task_id": sp.get("task_id", ""),
+                         "src": sp.get("src", "")},
+            })
     if hasattr(worker.core, "cluster_profile_events"):
         # Cluster mode: all spans (driver's included — flushed here) live in
         # the GCS profile table (reference: state.py chrome_tracing_dump
@@ -229,7 +251,8 @@ def timeline(filename: Optional[str] = None,
                 "ph": "X",
                 "ts": start * 1e6,
                 "dur": (end - start) * 1e6,
-                "pid": extra.get("actor_id", "driver"),
+                "pid": (f"trace:{extra['trace'][:12]}" if "trace" in extra
+                        else extra.get("actor_id", "driver")),
                 "tid": extra.get("task_id", "0"),
                 "args": extra,
             })

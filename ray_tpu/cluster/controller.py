@@ -353,6 +353,10 @@ class NodeController:
             for t in queued[1:]:
                 if not t.get("_revoke_sent"):
                     t["_revoke_sent"] = True
+                    self._gcs_send({
+                        "type": "log_event", "kind": "revoke_rescue",
+                        "node_id": self.node_id,
+                        "task_id": (t.get("task_id") or b"").hex()[:16]})
                     try:
                         w.conn.send_nowait({"type": "revoke_execute",
                                             "task_id": t.get("task_id")})
@@ -367,6 +371,12 @@ class NodeController:
             for pid, w in list(self.workers.items()):
                 if w.proc.poll() is not None:
                     del self.workers[pid]
+                    self._gcs_send({
+                        "type": "log_event", "kind": "worker_died",
+                        "node_id": self.node_id, "pid": pid,
+                        "exit_code": w.proc.returncode,
+                        "was_actor": w.actor_id is not None,
+                        "inflight": len(w.inflight)})
                     if w.current_task is not None:
                         await self._fail_task(
                             w.current_task,
@@ -499,6 +509,9 @@ class NodeController:
         """SpillingStore migrated a spilled object back into the arena:
         re-register the in-memory location (runs on the event loop — every
         restore-triggering get happens there)."""
+        self._gcs_send({"type": "log_event", "kind": "object_restored",
+                        "node_id": self.node_id,
+                        "object_id": oid.hex()[:16], "size": size})
         self._register_object(oid, size)
 
     async def _store_put(self, oid: bytes, blob: bytes,

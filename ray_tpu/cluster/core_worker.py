@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from .._private import tracing
 from .._private.ids import ActorID, JobID, ObjectID, TaskID
 from .._private.runtime import _EventLog, ensure_context
 from .._private.serialization import SerializedObject, get_context
@@ -100,6 +101,17 @@ class ClusterCoreWorker:
         # driver_fetch) join the four server-side ones (GCS debug_stats)
         # for the 7-phase per-task breakdown scripts/cluster_lat.py prints.
         self.phase_stats: Dict[str, list] = {}
+        # Per-task tracing (ISSUE 3): spans recorded in THIS process —
+        # driver phases (serialize/submit/fetch) or, inside workers, the
+        # exec/register phases — buffered here and flushed to the GCS
+        # trace table with the profile events. _trace_by_oid maps a
+        # sampled task's return oids to its trace so get() can close the
+        # driver_fetch span on arrival.
+        self.trace_spans: List[Dict] = []
+        self._trace_span_lock = threading.Lock()
+        self._trace_by_oid: Dict[bytes, Tuple[bytes, bytes]] = {}
+        self._trace_by_oid_order: deque = deque()
+        self._bp_event_last = 0.0  # log_event throttle for backpressure
         # Distributed reference counting (reference: reference_count.h:33;
         # the owner<->borrower WaitForRefRemoved protocol of
         # core_worker.proto:322 collapses into holder registration with the
@@ -360,6 +372,16 @@ class ClusterCoreWorker:
                 kwargs[key] = self._pack_value(val, pins)
         return args, kwargs, deps, pins
 
+    def record_trace_span(self, trace: bytes, task_id, phase: str,
+                          start_mono: float, end_mono: float) -> None:
+        """Buffer one phase span of a sampled task (flushed in batches)."""
+        sp = tracing.make_span(trace, task_id, phase, start_mono, end_mono,
+                               src=self.role)
+        with self._trace_span_lock:
+            self.trace_spans.append(sp)
+            if len(self.trace_spans) > 50_000:
+                del self.trace_spans[:10_000]
+
     def _phase_add(self, name: str, seconds: float, n: int = 1) -> None:
         """Accumulate one phase-profiler cell (GIL-tolerant; a lost sample
         under a rare race is acceptable for a profiler)."""
@@ -410,8 +432,17 @@ class ClusterCoreWorker:
             self._phase_add("driver_serialize", time.perf_counter() - t0, 0)
         try:
             t0 = time.perf_counter()
+            t0m = time.monotonic()
             self.gcs.call({"type": "submit_batch", "tasks": buf})
             self._phase_add("submit_rpc", time.perf_counter() - t0, len(buf))
+            t1m = time.monotonic()
+            for t in buf:
+                tr = t.get("trace")
+                if tr is not None:
+                    # The batch RPC carried this sampled task: its
+                    # submit_rpc span is the batch's wire window.
+                    self.record_trace_span(tr, t["task_id"], "submit_rpc",
+                                           t0m, t1m)
         except (ConnectionError, OSError):
             # Put them back and re-arm the retry timer; submit_batch is
             # idempotent per task_id so a re-send is safe. Without the
@@ -468,7 +499,9 @@ class ClusterCoreWorker:
         * **queued** — everything else goes to the GCS task table, which
           owns placement (batch kernel), dispatch, and retry.
         """
+        trace = tracing.maybe_sample()
         t0 = time.perf_counter()
+        t0m = time.monotonic() if trace is not None else 0.0
         fn_id = self._export_fn(fn)
         args, kwargs, deps, pins = self._pack_args(spec)
         return_ids = [oid.binary() for oid in spec.return_ids()]
@@ -481,6 +514,19 @@ class ClusterCoreWorker:
             "resources": resources, "max_retries": spec.max_retries,
         }
         self._phase_add("driver_serialize", time.perf_counter() - t0)
+        if trace is not None:
+            # Trace context rides inside the spec (wire: v2 header
+            # extension) so every hop can stamp its phase span.
+            payload["trace"] = trace
+            self.record_trace_span(trace, payload["task_id"],
+                                   "driver_serialize", t0m, time.monotonic())
+            with self._trace_span_lock:
+                for rid in return_ids:
+                    self._trace_by_oid[rid] = (trace, payload["task_id"])
+                    self._trace_by_oid_order.append(rid)
+                while len(self._trace_by_oid_order) > 8192:
+                    self._trace_by_oid.pop(
+                        self._trace_by_oid_order.popleft(), None)
         if not deps and self.config.direct_call_enabled \
                 and self._direct_submit(payload):
             return [ObjectRef(oid) for oid in spec.return_ids()]
@@ -834,10 +880,24 @@ class ClusterCoreWorker:
             return
         from .._private.spill import put_backpressure
 
-        put_backpressure(
+        waited = put_backpressure(
             self.local_store.stats, nbytes,
             high_watermark=getattr(cfg, "object_spill_high_watermark", 0.85),
             max_wait_s=max_wait)
+        if waited > 0.05:
+            # Lifecycle event (throttled): this owner is being held back by
+            # arena pressure — the forensic breadcrumb for "why did puts
+            # slow down at 14:03".
+            now = time.monotonic()
+            if now - self._bp_event_last > 5.0:
+                self._bp_event_last = now
+                try:
+                    self.gcs.send_oneway({
+                        "type": "log_event", "kind": "backpressure_engaged",
+                        "role": self.role, "waited_s": round(waited, 3),
+                        "nbytes": nbytes})
+                except (ConnectionError, OSError):
+                    pass
 
     def arena_admits(self, nbytes: int) -> bool:
         """Whether a direct (zero-copy) arena write of ``nbytes`` stays
@@ -1070,6 +1130,19 @@ class ClusterCoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         first = True
         last_probe = 0.0
+        # Sampled-task fetch spans: arrival of a traced oid closes its
+        # driver_fetch span (wait start = this get()'s entry). `traced`
+        # empty => one falsy check per arrival, nothing else.
+        traced = ({o for o in pending if o in self._trace_by_oid}
+                  if self._trace_by_oid else None)
+        t_get = time.monotonic() if traced else 0.0
+
+        def _trace_note(oid):
+            traced.discard(oid)
+            ent = self._trace_by_oid.pop(oid, None)
+            if ent is not None:
+                self.record_trace_span(ent[0], ent[1], "driver_fetch",
+                                       t_get, time.monotonic())
         while pending:
             # Full local scan every wake is INTENTIONAL: same-host workers
             # deposit results into the shared arena ahead of the (batched)
@@ -1088,6 +1161,8 @@ class ClusterCoreWorker:
                     blobs[oid] = blob
                     pending.discard(oid)
                     self._direct_observed(oid)
+                    if traced:
+                        _trace_note(oid)
                 if self._blob_cache and pending:
                     for oid in list(pending):
                         blob = self._blob_cache.get(oid)
@@ -1095,6 +1170,8 @@ class ClusterCoreWorker:
                             blobs[oid] = blob
                             pending.discard(oid)
                             self._direct_observed(oid)
+                            if traced:
+                                _trace_note(oid)
             else:
                 for oid in list(pending):
                     blob = self._local_blob(oid)
@@ -1102,6 +1179,8 @@ class ClusterCoreWorker:
                         blobs[oid] = blob
                         pending.discard(oid)
                         self._direct_observed(oid)
+                        if traced:
+                            _trace_note(oid)
             self._phase_add("driver_fetch", time.perf_counter() - t0,
                             n0 - len(pending))
             if not pending:
@@ -1130,6 +1209,8 @@ class ClusterCoreWorker:
                         blobs[oid] = blob
                         pending.discard(oid)
                         self._direct_observed(oid)
+                        if traced:
+                            _trace_note(oid)
                     if pending:
                         time.sleep(0.0001)
                 if not pending:
@@ -1171,6 +1252,8 @@ class ClusterCoreWorker:
                 if info.get("error_blob") is not None:
                     blobs[oid] = info["error_blob"]
                     pending.discard(oid)
+                    if traced:
+                        _trace_note(oid)
                     continue
                 to_fetch[oid] = info
             t0 = time.perf_counter()
@@ -1179,6 +1262,8 @@ class ClusterCoreWorker:
                 blobs[oid] = blob
                 pending.discard(oid)
                 self._direct_observed(oid)
+                if traced:
+                    _trace_note(oid)
             if to_fetch:
                 self._phase_add("driver_fetch", time.perf_counter() - t0,
                                 len(fetched))
@@ -1439,12 +1524,46 @@ class ClusterCoreWorker:
                 self.gcs.call({"type": "add_profile_data", "events": batch})
             except (ConnectionError, OSError):
                 return 0
+        self.flush_traces()
         return len(batch)
+
+    def flush_traces(self) -> int:
+        """Push buffered per-task trace spans to the GCS trace table."""
+        with self._trace_span_lock:
+            spans, self.trace_spans = self.trace_spans, []
+        if not spans:
+            return 0
+        try:
+            for i in range(0, len(spans), 10_000):
+                self.gcs.send_oneway({"type": "add_trace_data",
+                                      "spans": spans[i:i + 10_000]})
+        except (ConnectionError, OSError):
+            return 0
+        return len(spans)
 
     def cluster_profile_events(self, limit: Optional[int] = None):
         msg = {"type": "get_profile_data"}
         if limit:
             msg["limit"] = int(limit)
+        return self.gcs.call(msg)["events"]
+
+    def cluster_trace_spans(self, limit: Optional[int] = None):
+        """Raw phase spans from the GCS trace table (this process's own
+        buffered spans are flushed first so a fresh submit is visible)."""
+        self.flush_traces()
+        msg = {"type": "get_trace_data"}
+        if limit:
+            msg["limit"] = int(limit)
+        return self.gcs.call(msg)["spans"]
+
+    def cluster_events(self, limit: Optional[int] = None,
+                       kind: Optional[str] = None):
+        """Structured lifecycle events from the GCS cluster event log."""
+        msg: Dict[str, Any] = {"type": "get_events"}
+        if limit:
+            msg["limit"] = int(limit)
+        if kind:
+            msg["kind"] = kind
         return self.gcs.call(msg)["events"]
 
     def shutdown(self):

@@ -83,6 +83,16 @@ class GcsServer:
         from collections import deque as _deque
 
         self.profile_events: Any = _deque(maxlen=200_000)  # chrome-trace spans
+        # Per-task trace table (ring buffer beside profile_events): phase
+        # spans of sampled tasks, flushed here by drivers/workers
+        # (add_trace_data) and appended directly for the GCS-owned phases
+        # (gcs_place, dispatch_relay). Consumers: timeline(), the straggler
+        # report (cli trace / cluster_lat --traces), the dashboard.
+        self.trace_events: Any = _deque(maxlen=200_000)
+        # Cluster event log: structured lifecycle events (node up/down,
+        # task retry/reconstruct, actor restart, spill/restore,
+        # backpressure) queryable via get_events / `cli events`.
+        self.cluster_events: Any = _deque(maxlen=20_000)
         # ---- GCS-owned task lifecycle (reference: owner-side TaskManager
         # task_manager.h:57 + lineage; centralized here because placement
         # already is). task_table: task_id -> record; lineage: object_id ->
@@ -148,6 +158,28 @@ class GcsServer:
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()
         self._register_handlers()
+
+    def record_event(self, kind: str, **data) -> None:
+        """Append one structured lifecycle event to the cluster event log.
+        Values must stay JSON-serializable (the dashboard serves them)."""
+        self.cluster_events.append(
+            {"ts": time.time(), "kind": kind, **data})
+
+    def _trace_span(self, trace, task_id, phase: str,
+                    start_mono: float, end_mono: float) -> None:
+        from .._private import tracing
+
+        self.trace_events.append(tracing.make_span(
+            trace, task_id, phase, start_mono, end_mono, src="gcs"))
+
+    def _trace_placed(self, rec: Dict[str, Any]) -> None:
+        """A sampled task left the placement queue for a node: close its
+        gcs_place span (enqueue -> grant+dispatch-queue)."""
+        trace = rec["payload"].get("trace")
+        t0 = rec.get("trace_t0")
+        if trace is not None and t0 is not None:
+            self._trace_span(trace, rec["task_id"], "gcs_place",
+                             t0, time.monotonic())
 
     def _stat_add(self, key: str, seconds: float, n: int = 1) -> None:
         """Accumulate a phase/counter cell into the per-handler stats table
@@ -344,6 +376,10 @@ class GcsServer:
             "return_ids": list(payload.get("return_ids", [])),
         }
         self.task_table[task_id] = rec
+        if payload.get("trace") is not None:
+            # Sampled task: remember the placement-queue entry time so the
+            # gcs_place span can close when the grant lands.
+            rec["trace_t0"] = time.monotonic()
         self._pin_deps(rec)
         for oid in rec["return_ids"]:
             self.lineage[oid] = task_id
@@ -437,6 +473,7 @@ class GcsServer:
                 rec["node_id"] = nid
                 rec["state"] = "DISPATCHED"
                 rec["direct_dispatch"] = False  # this dispatch holds a share
+                self._trace_placed(rec)
                 if await self._dispatch_to_node(nid, rec):
                     return
                 # Node vanished between grant and send: put its share back
@@ -515,6 +552,7 @@ class GcsServer:
         rec["node_id"] = nid
         rec["state"] = "DISPATCHED"
         rec["direct_dispatch"] = False
+        self._trace_placed(rec)
         self._queue_assign(nid, rec["payload"])
 
     async def _send_with_retry(self, node_id: str, msg: Dict,
@@ -572,8 +610,12 @@ class GcsServer:
             pend.remove(entry)
             if not pend:
                 self._assign_pending.pop(node_id, None)
-            self._stat_add("phase:dispatch_relay",
-                           time.monotonic() - t0, len(batch))
+            t1 = time.monotonic()
+            self._stat_add("phase:dispatch_relay", t1 - t0, len(batch))
+            for p in batch:
+                if p.get("trace") is not None:
+                    self._trace_span(p["trace"], p.get("task_id"),
+                                     "dispatch_relay", t0, t1)
         if not delivered:
             # Re-place on send failure — the same semantics the queued
             # single-send path always had. If an attempted send actually
@@ -778,6 +820,8 @@ class GcsServer:
                     while len(self._restore_requested) > 100_000:
                         self._restore_requested.pop(
                             next(iter(self._restore_requested)))
+                    self.record_event("object_restore",
+                                      object_id=oid.hex()[:16], node_id=nid)
                     self._spawn(self._push_restore(conn, oid))
                 return True
         task_id = self.lineage.get(oid)
@@ -788,6 +832,9 @@ class GcsServer:
             rec["state"] = "PENDING"
             rec["node_id"] = None
             self._pin_deps(rec)  # re-executing: args must stay alive again
+            self.record_event("task_reconstruct",
+                              task_id=rec["task_id"].hex()[:16],
+                              object_id=oid.hex()[:16])
             self._spawn(self._drive_task(rec))
             return True
         # PENDING/DISPATCHED: already in flight; FAILED: error served.
@@ -813,6 +860,8 @@ class GcsServer:
         rec = self.task_table.get(actor_id)
         restarts = rec["retries_left"] if rec else 0
         if no_restart or rec is None or restarts == 0:
+            self.record_event("actor_dead", actor_id=actor_id.hex()[:16],
+                              name=info.get("name") or "")
             info["state"] = "DEAD"
             if rec is not None:
                 if rec["state"] != "FINISHED":
@@ -831,6 +880,8 @@ class GcsServer:
             return
         if restarts > 0:             # -1 = infinite restarts
             rec["retries_left"] = restarts - 1
+        self.record_event("actor_restarting", actor_id=actor_id.hex()[:16],
+                          name=info.get("name") or "")
         info["state"] = "RESTARTING"
         info["node_id"] = None
         info["address"] = None
@@ -871,6 +922,7 @@ class GcsServer:
     async def _on_node_death(self, node: NodeEntry):
         # Drop object locations on the dead node; recover/retry what it
         # was running; restart actors homed there.
+        self.record_event("node_down", node_id=node.node_id)
         self._node_conns.pop(node.node_id, None)
         self.node_stats.pop(node.node_id, None)  # reporter data dies with it
         for oid, entry in list(self.objects.items()):
@@ -909,10 +961,18 @@ class GcsServer:
                     rec["retries_left"] -= 1
                 rec["state"] = "PENDING"
                 rec["node_id"] = None
+                self.record_event("task_retry",
+                                  task_id=rec["task_id"].hex()[:16],
+                                  reason="node_died",
+                                  node_id=node.node_id)
                 self._spawn(self._drive_task(rec))
             else:
                 from ..exceptions import WorkerCrashedError
 
+                self.record_event("task_failed",
+                                  task_id=rec["task_id"].hex()[:16],
+                                  reason="node_died_no_retries",
+                                  node_id=node.node_id)
                 self._fail_record(rec, WorkerCrashedError(
                     f"node {node.node_id[:8]} died executing task"))
         for actor_id, info in list(self.actors.items()):
@@ -1322,6 +1382,9 @@ class GcsServer:
             if msg.get("wire"):
                 conn.meta["wire"] = int(msg["wire"])
             self._node_conns[node_id] = conn
+            self.record_event("node_up", node_id=node_id,
+                              address=list(msg["address"]),
+                              resources=dict(msg["resources"]))
             await self.publish("nodes", {"node_id": node_id, "state": "ALIVE"})
             return {"ok": True, "node_index": entry.index}
 
@@ -1754,9 +1817,19 @@ class GcsServer:
                     rec["retries_left"] -= 1
                 rec["state"] = "PENDING"
                 rec["node_id"] = None
+                self.record_event("task_retry",
+                                  task_id=rec["task_id"].hex()[:16],
+                                  reason="worker_failed",
+                                  node_id=msg["node_id"],
+                                  error=str(msg.get("error", ""))[:200])
                 self._spawn(self._drive_task(rec))
                 return {"ok": True, "will_retry": True}
             rec["state"] = "FAILED"
+            self.record_event("task_failed",
+                              task_id=rec["task_id"].hex()[:16],
+                              reason="retries_exhausted",
+                              node_id=msg["node_id"],
+                              error=str(msg.get("error", ""))[:200])
             return {"ok": True, "will_retry": False}
 
         @s.handler("cancel_task")
@@ -1830,6 +1903,9 @@ class GcsServer:
             entry = self.objects.setdefault(
                 oid, {"locations": set(), "size": msg.get("size", 0)}
             )
+            self.record_event("object_spilled", object_id=oid.hex()[:16],
+                              node_id=msg["node_id"],
+                              size=msg.get("size", 0))
             entry["locations"].discard(msg["node_id"])
             self._spilled_set(entry).add(msg["node_id"])
             # A spilled copy still satisfies waiters (fetchable via RPC).
@@ -2116,6 +2192,47 @@ class GcsServer:
                 return {"ok": True, "events": list(itertools.islice(
                     reversed(self.profile_events), int(limit)))[::-1]}
             return {"ok": True, "events": list(self.profile_events)}
+
+        @s.handler("add_trace_data")
+        async def add_trace_data(msg, conn):
+            # Batched per-task trace-span flush from a driver/worker (the
+            # GCS-owned phases append directly, no RPC).
+            self.trace_events.extend(msg.get("spans", ()))
+            return None  # one-way
+
+        @s.handler("get_trace_data")
+        async def get_trace_data(msg, conn):
+            limit = msg.get("limit")
+            if limit:
+                import itertools
+
+                # Tail only, iterated from the right end (same rationale as
+                # get_profile_data: forward islice walks the whole deque).
+                return {"ok": True, "spans": list(itertools.islice(
+                    reversed(self.trace_events), int(limit)))[::-1]}
+            return {"ok": True, "spans": list(self.trace_events)}
+
+        @s.handler("log_event")
+        async def log_event(msg, conn):
+            """Remote lifecycle-event report (controllers: revoke rescue,
+            restore, worker death; drivers: put backpressure)."""
+            data = {k: v for k, v in msg.items()
+                    if k not in ("type", "rpc_id", "kind")}
+            self.record_event(str(msg.get("kind", "event")), **data)
+            return None  # one-way
+
+        @s.handler("get_events")
+        async def get_events(msg, conn):
+            limit = int(msg.get("limit") or 1000)
+            kind = msg.get("kind")
+            out = []
+            for ev in reversed(self.cluster_events):
+                if kind is not None and ev.get("kind") != kind:
+                    continue
+                out.append(ev)
+                if len(out) >= limit:
+                    break
+            return {"ok": True, "events": out[::-1]}
 
         @s.handler("list_objects")
         async def list_objects(msg, conn):

@@ -66,7 +66,11 @@ ASSIGN_BATCH = 0x09
 EXECUTE_TASK = 0x0A
 TASK_DONE = 0x0B
 
+# Task-spec versions. v1 is the base header; v2 appends a trace context
+# (sampled tasks only — unsampled specs still encode as v1, so the hot
+# path's bytes are unchanged and pre-tracing decoders keep reading them).
 SPEC_VERSION = 1
+SPEC_VERSION_TRACED = 2
 
 # Hard caps, enforced on decode: a corrupt count/length field must fail the
 # frame instead of driving a multi-GB allocation.
@@ -229,9 +233,11 @@ def _oids(ids) -> bytes:
 def encode_task_spec(p: Dict[str, Any]) -> bytes:
     """Pack a task payload once, on the owner. Header fields (what the GCS
     and controllers need) come first so relays parse them without touching
-    the args; args/kwargs blobs are appended verbatim."""
+    the args; args/kwargs blobs are appended verbatim. A sampled task's
+    trace context rides as a versioned header extension (v2)."""
+    trace = p.get("trace")
     parts = [
-        _U8.pack(SPEC_VERSION),
+        _U8.pack(SPEC_VERSION_TRACED if trace else SPEC_VERSION),
         _b8(p["task_id"]),
         _b8(p.get("fn_id", b"")),
         _s(p.get("name", "") or ""),
@@ -241,6 +247,8 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
         _oids(p.get("pin_refs", ())),
         _resources(p.get("resources", {})),
     ]
+    if trace:
+        parts.append(_b8(trace))
     args = p.get("args", ())
     parts.append(_U16.pack(len(args)))
     for kind, payload in args:
@@ -259,9 +267,9 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
 
 def _decode_spec_header(r: _Reader) -> Dict[str, Any]:
     ver = r.u8()
-    if ver != SPEC_VERSION:
+    if ver not in (SPEC_VERSION, SPEC_VERSION_TRACED):
         raise WireError(f"unknown task-spec version {ver}")
-    return {
+    out = {
         "task_id": r.b8(),
         "fn_id": r.b8(),
         "name": r.s(),
@@ -271,6 +279,9 @@ def _decode_spec_header(r: _Reader) -> Dict[str, Any]:
         "pin_refs": _read_oids(r),
         "resources": _read_resources(r),
     }
+    if ver == SPEC_VERSION_TRACED:
+        out["trace"] = r.b8()
+    return out
 
 
 def decode_task_spec_header(blob: bytes) -> Dict[str, Any]:

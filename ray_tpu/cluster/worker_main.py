@@ -395,6 +395,7 @@ def main():
             if mtype == "execute_task":
                 fn = load_function(msg["fn_id"])
                 pos, kwargs = resolve_args(msg)
+                trace = msg.get("trace")  # sampled task: stamp phase spans
                 t0 = time.monotonic()
                 try:
                     result = fn(*pos, **kwargs)
@@ -403,10 +404,18 @@ def main():
                         [time.monotonic() - t0, 0.0]
                     record_span("task", getattr(fn, "__name__", "task"),
                                 t0, "task_id", msg.get("task_id"))
+                    if trace is not None:
+                        core.record_trace_span(
+                            trace, msg.get("task_id"), "worker_exec",
+                            t0, time.monotonic())
                 t1 = time.monotonic()
                 run_returns(msg, result)
                 _phase_times[threading.get_ident()][1] = \
                     time.monotonic() - t1
+                if trace is not None:
+                    core.record_trace_span(
+                        trace, msg.get("task_id"), "result_register",
+                        t1, time.monotonic())
             elif mtype == "create_actor_instance":
                 cls = load_function(msg["fn_id"])
                 pos, kwargs = resolve_args(msg)

@@ -77,10 +77,10 @@ function laneView(events) {
 }
 async function refresh() {
   const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
-         timeline] =
+         timeline, events, traces] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
-       "memory","serve","timeline"].map(
+       "memory","serve","timeline","events","traces"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th>" +
@@ -138,6 +138,37 @@ async function refresh() {
   // task/placement timeline lanes (chrome-trace events, one lane per
   // worker/actor — placement-kernel behavior visually inspectable)
   h += "<h2>timeline</h2>" + laneView(Array.isArray(timeline) ? timeline : []);
+  // per-task trace stragglers: slowest sampled tasks, latency by phase
+  const straggs = (traces && traces.stragglers) || [];
+  h += `<h2>trace stragglers (${traces.sampled || 0} sampled)</h2>`;
+  if (straggs.length) {
+    h += "<table><tr><th>trace</th><th>task</th><th>total ms</th>" +
+         "<th>slowest phase</th><th>phases</th></tr>";
+    for (const t of straggs.slice(0, 10)) {
+      const ph = Object.entries(t.phases_ms || {});
+      ph.sort((a, b) => b[1] - a[1]);
+      h += `<tr><td>${esc(t.trace).slice(0,16)}</td>` +
+           `<td>${esc(t.task_id).slice(0,16)}</td>` +
+           `<td class=num>${t.total_ms}</td>` +
+           `<td>${ph.length ? esc(ph[0][0]) + " " + ph[0][1] + "ms" : "-"}</td>` +
+           `<td>${ph.map(([p, v]) => esc(p) + "=" + v).join(" ")}</td></tr>`;
+    }
+    h += "</table>";
+  } else h += "<i>no sampled traces yet</i>";
+  // cluster event log (lifecycle: node up/down, retries, spill, ...)
+  const evs = Array.isArray(events) ? events : [];
+  h += `<h2>cluster events (${evs.length})</h2>`;
+  if (evs.length) {
+    h += "<table><tr><th>time</th><th>kind</th><th>detail</th></tr>";
+    for (const e of evs.slice(-30).reverse()) {
+      const detail = Object.entries(e).filter(([k]) =>
+        k !== "ts" && k !== "kind").map(([k, v]) =>
+        `${k}=${esc(JSON.stringify(v))}`).join(" ");
+      h += `<tr><td>${new Date(e.ts * 1000).toISOString().slice(11,23)}</td>` +
+           `<td>${esc(e.kind)}</td><td>${detail}</td></tr>`;
+    }
+    h += "</table>";
+  } else h += "<i>no events</i>";
   // serve stats when a serve control plane is running
   if (serve && Object.keys(serve).length) {
     h += "<h2>serve</h2><table><tr><th>endpoint</th><th>routed</th>" +
@@ -200,6 +231,39 @@ def _collect(endpoint: str):
         from ..metrics import collect_all
 
         return collect_all()
+    if endpoint == "events":
+        # Cluster event log (node up/down, retries, spill/restore,
+        # backpressure) straight from the GCS; local mode has no cluster
+        # lifecycle, so [].
+        core = global_worker().core
+        if hasattr(core, "cluster_events"):
+            try:
+                return core.cluster_events(limit=200)
+            except Exception:  # noqa: BLE001 - GCS restart window
+                return []
+        return []
+    if endpoint == "traces":
+        # Straggler view over the per-task trace table: top slowest
+        # sampled tasks with per-phase attribution.
+        core = global_worker().core
+        if hasattr(core, "cluster_trace_spans"):
+            from .._private import tracing
+
+            try:
+                spans = core.cluster_trace_spans(limit=20_000)
+            except Exception:  # noqa: BLE001 - GCS restart window
+                return {"spans": 0, "stragglers": []}
+            traces = tracing.group_traces(spans)
+            top = sorted(traces.items(), key=lambda kv: -kv[1]["total_ms"])
+            return {"spans": len(spans), "sampled": len(traces),
+                    "stragglers": [
+                        {"trace": tr, "task_id": rec["task_id"],
+                         "total_ms": rec["total_ms"],
+                         "phases_ms": {
+                             p: round((w[1] - w[0]) * 1e3, 3)
+                             for p, w in rec["phases"].items()}}
+                        for tr, rec in top[:20]]}
+        return {"spans": 0, "stragglers": []}
     if endpoint == "timeline":
         # Task-lifecycle lanes (reference: the dashboard timeline +
         # state.py chrome_tracing_dump): the newest execution spans from
@@ -244,6 +308,15 @@ class Dashboard:
                 if path in ("/", "/index.html"):
                     body = _PAGE.encode()
                     ctype = "text/html"
+                elif path == "/metrics":
+                    # Prometheus text exposition of the process-local
+                    # metrics registry (scrape target).
+                    from ..metrics import (
+                        PROMETHEUS_CONTENT_TYPE, render_prometheus,
+                    )
+
+                    body = render_prometheus().encode()
+                    ctype = PROMETHEUS_CONTENT_TYPE
                 elif path.startswith("/api/"):
                     try:
                         body = json.dumps(_collect(path[5:])).encode()

@@ -1,10 +1,12 @@
 """Metrics API (reference: src/ray/stats/metric.h — Gauge/Count/Sum/Histogram
 over OpenCensus; here a dependency-free registry exported through the
-dashboard and state API)."""
+dashboard and state API, plus a Prometheus text exposition renderer served
+at the dashboard's ``/metrics``)."""
 
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -148,3 +150,97 @@ def collect_all() -> Dict[str, Dict]:
 def reset_all() -> None:
     with _LOCK:
         _REGISTRY.clear()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (version 0.0.4)
+# ---------------------------------------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_escape(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash, double
+    quote, and newline must be escaped."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(tags: Tuple, extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [(k, v) for k, v in tags]
+    if extra is not None:
+        pairs.append(extra)
+    if not pairs:
+        return ""
+    return ("{" + ",".join(
+        f'{_prom_name(k)}="{_prom_escape(v)}"' for k, v in pairs) + "}")
+
+
+def _prom_num(v: float) -> str:
+    return repr(float(v)) if v != int(v) else str(int(v))
+
+
+def render_prometheus() -> str:
+    """Render every registered metric in Prometheus text exposition format.
+
+    Counters get the conventional ``_total`` suffix; histograms expose
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``. Values
+    are point-in-time snapshots of the (monotonic for counters) registry
+    cells, so scrape-to-scrape deltas are well defined.
+    """
+    with _LOCK:
+        metrics = sorted(_REGISTRY.items())
+    lines: List[str] = []
+    for name, m in metrics:
+        pname = _prom_name(name)
+        if isinstance(m, Histogram):
+            lines.append(f"# HELP {pname} {m.description or pname}")
+            lines.append(f"# TYPE {pname} histogram")
+            with m._lock:
+                for key, counts in m._counts.items():
+                    cum = 0
+                    for bound, c in zip(m.boundaries, counts):
+                        cum += c
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_prom_labels(key, ('le', _prom_num(bound)))}"
+                            f" {cum}")
+                    cum += counts[len(m.boundaries)]
+                    lines.append(
+                        f"{pname}_bucket{_prom_labels(key, ('le', '+Inf'))}"
+                        f" {cum}")
+                    lines.append(
+                        f"{pname}_sum{_prom_labels(key)}"
+                        f" {_prom_num(m._sums[key])}")
+                    lines.append(
+                        f"{pname}_count{_prom_labels(key)}"
+                        f" {m._totals[key]}")
+            continue
+        if isinstance(m, Count):
+            cname = pname if pname.endswith("_total") else pname + "_total"
+            lines.append(f"# HELP {cname} {m.description or pname}")
+            lines.append(f"# TYPE {cname} counter")
+            with m._lock:
+                samples = list(m._values.items())
+            for key, value in samples:
+                lines.append(
+                    f"{cname}{_prom_labels(key)} {_prom_num(value)}")
+            continue
+        if isinstance(m, Gauge):
+            lines.append(f"# HELP {pname} {m.description or pname}")
+            lines.append(f"# TYPE {pname} gauge")
+            with m._lock:
+                samples = [(k, v) for k, (v, _) in m._values.items()]
+            for key, value in samples:
+                lines.append(
+                    f"{pname}{_prom_labels(key)} {_prom_num(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")

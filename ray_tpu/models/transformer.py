@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention
 from ..ops.fused import rms_norm, softmax_cross_entropy
+from ..parallel.mesh import axis_size_compat, shard_map_compat
 from ..parallel.pipeline import gpipe_sharded
 from ..parallel.ring_attention import ring_attention, ring_attention_sharded
 
@@ -235,7 +236,7 @@ def _block_manual(layer: Params, x: jax.Array, cfg: TransformerConfig,
     over tp; attention is ring attention over sp.
     """
     dt = cfg.dtype
-    tp = jax.lax.axis_size("tp")
+    tp = axis_size_compat("tp")
     H_l, KH_l, Dh = cfg.n_heads // tp, cfg.n_kv_heads // tp, cfg.head_dim
     B, T, E = x.shape
 
@@ -245,7 +246,7 @@ def _block_manual(layer: Params, x: jax.Array, cfg: TransformerConfig,
     v = (h @ layer["wv"].astype(dt)).reshape(B, T, KH_l, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if jax.lax.axis_size("sp") > 1:
+    if axis_size_compat("sp") > 1:
         attn = ring_attention_sharded(q, k, v, axis_name="sp", causal=True)
     else:
         # Sequence axis is whole on this device: use the blockwise flash
@@ -292,7 +293,7 @@ def forward_pipelined(params: Params, tokens: jax.Array,
         out = gpipe_sharded(stage_fn, layers, mb, axis_name="pp")
         return out.reshape(b, t, E)
 
-    x = jax.shard_map(
+    x = shard_map_compat(
         body, mesh=mesh,
         in_specs=(_LAYER_PSPECS, P("dp", "sp", None)),
         out_specs=P("dp", "sp", None),

@@ -7,7 +7,9 @@ parallelism via NamedSharding + shard_map, ring attention over the sequence
 axis, pipeline parallelism via collective permute microbatching.
 """
 
-from .mesh import MeshSpec, make_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    MeshSpec, make_mesh, resolve_shard_map, shard_map_compat,
+)
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
 from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from .pipeline import gpipe, gpipe_sharded  # noqa: F401

@@ -19,6 +19,8 @@ from typing import Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from .mesh import axis_size_compat
+
 AxisName = Union[str, Tuple[str, ...]]
 
 
@@ -51,7 +53,7 @@ def reduce_scatter(x: jax.Array, axis_name: AxisName, *,
 
 def ring_permute(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array:
     """Rotate shards around the axis ring (the ring-attention/pipeline hop)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
@@ -66,7 +68,7 @@ def all_to_all(x: jax.Array, axis_name: str, *, split_axis: int,
 
 def broadcast_from(x: jax.Array, axis_name: str, *, src: int = 0) -> jax.Array:
     """Every rank gets rank ``src``'s value (masked psum)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     masked = jnp.where(jax.lax.axis_index(axis_name) == src, x,
                        jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name) if n > 1 else x
@@ -77,7 +79,7 @@ def axis_index(axis_name: AxisName) -> jax.Array:
 
 
 def axis_size(axis_name: str) -> int:
-    return jax.lax.axis_size(axis_name)
+    return axis_size_compat(axis_name)
 
 
 def barrier_value(axis_name: AxisName) -> jax.Array:

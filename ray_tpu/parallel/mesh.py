@@ -26,6 +26,53 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS_ORDER = ("dp", "pp", "sp", "tp")  # outermost -> innermost
 
 
+def resolve_shard_map():
+    """The shard_map entry point for the installed jax: ``jax.shard_map``
+    (>= 0.6 top-level export) with a fallback to
+    ``jax.experimental.shard_map.shard_map`` (0.4.x). Raises ImportError
+    only when neither exists."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm  # noqa: PLC0415
+
+    return sm
+
+
+def axis_size_compat(axis_name: str) -> int:
+    """Static mesh-axis size inside a shard_map body: ``jax.lax.axis_size``
+    where it exists, else the 0.4.x ``jax.core.axis_frame`` (which returns
+    either a frame object with ``.size`` or the size itself)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    import jax.core as _core  # noqa: PLC0415
+
+    frame = _core.axis_frame(axis_name)
+    return int(frame.size) if hasattr(frame, "size") else int(frame)
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``: maps the modern ``check_vma`` kwarg
+    onto the older ``check_rep`` when the installed entry point predates
+    the rename (same semantics: per-shard replication/VMA checking)."""
+    import inspect  # noqa: PLC0415
+
+    sm = resolve_shard_map()
+    kwargs = {}
+    if check_vma is not None:
+        try:
+            params = inspect.signature(sm).parameters
+        except (TypeError, ValueError):  # C-accel / wrapped: assume modern
+            params = {"check_vma": None}
+        if "check_vma" in params:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in params:
+            kwargs["check_rep"] = check_vma
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kwargs)
+
+
 @dataclass(frozen=True)
 class MeshSpec:
     dp: int = 1

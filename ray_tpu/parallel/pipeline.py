@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import axis_size_compat, shard_map_compat
+
 
 def gpipe_sharded(
     stage_fn: Callable,   # (stage_params, x_mb) -> y_mb, same shape as x_mb
@@ -40,7 +42,7 @@ def gpipe_sharded(
 ) -> jax.Array:
     """Per-shard GPipe body; call inside shard_map with params sharded over
     ``axis_name``. Returns [M, ...] outputs, identical on every stage."""
-    n_stage = jax.lax.axis_size(axis_name)
+    n_stage = axis_size_compat(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_mb = microbatches.shape[0]
     ticks = n_mb + n_stage - 1
@@ -115,7 +117,7 @@ def gpipe(
 
     body = functools.partial(gpipe_sharded, stage_body, axis_name=axis_name)
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), params)
-    out = jax.shard_map(
+    out = shard_map_compat(
         body, mesh=mesh,
         in_specs=(param_spec, P()), out_specs=P(),
         check_vma=False,

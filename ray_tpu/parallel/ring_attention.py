@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import NEG_INF, _repeat_kv
+from .mesh import axis_size_compat, shard_map_compat
 
 
 def _block_step(q, k, v, q_off, k_off, o, m, l, *, causal: bool, scale: float):
@@ -58,7 +59,7 @@ def ring_attention_sharded(
     """Per-shard body; call inside shard_map with the sequence axis sharded."""
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size_compat(axis_name)
     my = jax.lax.axis_index(axis_name)
 
     # GQA: rotate the raw KH-head K/V around the ring and repeat to H heads
@@ -110,7 +111,7 @@ def ring_attention(
     """Global entry: shard_map over (dp, sp, tp) with KV rotating on sp."""
     spec = P("dp", "sp", "tp", None)
     fn = functools.partial(ring_attention_sharded, causal=causal)
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

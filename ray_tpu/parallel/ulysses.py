@@ -18,6 +18,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import _repeat_kv, flash_attention
+from .mesh import axis_size_compat, shard_map_compat
 
 
 def ulysses_attention_sharded(
@@ -31,7 +32,7 @@ def ulysses_attention_sharded(
 ) -> jax.Array:
     """Per-shard body; call inside shard_map with sequence sharded on
     ``axis_name``. Requires n_heads % axis_size == 0."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size_compat(axis_name)
     n_heads = q.shape[2]
     kv_heads = k.shape[2]
     if n_heads % sp != 0:
@@ -75,7 +76,7 @@ def ulysses_attention(
     around dense attention."""
     spec = P("dp", "sp", "tp", None)
     fn = functools.partial(ulysses_attention_sharded, causal=causal)
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -287,6 +287,26 @@ def cmd_status(args) -> None:
             print(f"  {n['NodeID'][:12]} {state:<6} {n['Resources']}")
         print(f"total resources:     {res['total']}")
         print(f"available resources: {res['available']}")
+        # Per-phase latency table from the GCS handler stats (the same
+        # cells scripts/cluster_lat.py harvests): avg wall per item for the
+        # server-side phases of the 7-phase profiler.
+        handlers = gcs.call({"type": "debug_stats"})["handlers"]
+        phase_cells = [(k[len("phase:"):], h) for k, h in handlers.items()
+                       if k.startswith("phase:")]
+        if phase_cells:
+            print("control-plane phases (GCS-side, cumulative):")
+            print(f"  {'PHASE':<18} {'ITEMS':>10} {'TOTAL_S':>10} "
+                  f"{'AVG_US':>9}")
+            for name, h in phase_cells:
+                avg_us = (h["total_s"] / h["count"] * 1e6
+                          if h["count"] else 0.0)
+                print(f"  {name:<18} {h['count']:>10} "
+                      f"{h['total_s']:>10.4f} {avg_us:>9.1f}")
+            relay = {k: handlers[k]["count"]
+                     for k in ("relay:opaque", "relay:pickled")
+                     if k in handlers}
+            if relay:
+                print(f"  dispatch relay: {relay}")
         if getattr(args, "verbose", False):
             # Per-RPC handler timings (bg:<type> = detached completion
             # time): the cProfile-free view of where GCS cycles go.
@@ -323,6 +343,41 @@ def cmd_memory(args) -> None:
         for oid, info in sorted(objs.items(), key=lambda kv: -kv[1]["size"]):
             locs = ",".join(str(l)[:12] for l in info["locations"])
             print(f"{oid:<44} {info['size']:>12}  {locs}")
+    finally:
+        gcs.close()
+
+
+def cmd_trace(args) -> None:
+    """Per-task straggler report: top-k slowest sampled tasks with latency
+    attributed to the 7 control-plane phases (needs tracing enabled —
+    default 1/64 sampling, RAY_TPU_TRACE_SAMPLE)."""
+    from ray_tpu._private.tracing import straggler_report
+
+    gcs = _gcs_client(args.address)
+    try:
+        spans = gcs.call({"type": "get_trace_data",
+                          "limit": args.limit})["spans"]
+        print(straggler_report(spans, top_k=args.top))
+    finally:
+        gcs.close()
+
+
+def cmd_events(args) -> None:
+    """Cluster event log: structured lifecycle events (node up/down, task
+    retries, actor restarts, spill/restore, backpressure)."""
+    gcs = _gcs_client(args.address)
+    try:
+        msg = {"type": "get_events", "limit": args.limit}
+        if args.kind:
+            msg["kind"] = args.kind
+        events = gcs.call(msg)["events"]
+        print(f"{len(events)} events"
+              + (f" (kind={args.kind})" if args.kind else ""))
+        for ev in events:
+            stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+            detail = " ".join(f"{k}={v}" for k, v in ev.items()
+                              if k not in ("ts", "kind"))
+            print(f"  {stamp} {ev['kind']:<22} {detail}")
     finally:
         gcs.close()
 
@@ -551,6 +606,21 @@ def main(argv: Optional[List[str]] = None) -> None:
             sp.add_argument("-v", "--verbose", action="store_true",
                             help="include per-RPC GCS handler timings")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("trace", help="per-task straggler report "
+                                      "(sampled trace table)")
+    sp.add_argument("--address")
+    sp.add_argument("--top", type=int, default=10)
+    sp.add_argument("--limit", type=int, default=50_000,
+                    help="newest spans to fetch from the GCS trace table")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("events", help="cluster lifecycle event log")
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--kind", help="filter by event kind "
+                                   "(e.g. node_down, task_retry)")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("submit", help="run a driver script on the cluster")
     sp.add_argument("--address")

@@ -132,6 +132,40 @@ def one_run(serial_n: int, batch_k: int) -> dict:
         c.shutdown()
 
 
+def trace_run(batch_k: int, top_k: int, sample: int = 8) -> None:
+    """Straggler run: one fresh cluster with per-task tracing forced to
+    1/``sample``, a warm fan-out, then the top-k slowest sampled tasks with
+    their latency attributed by phase (the per-task complement to the
+    aggregate phases_ms_per_1k table)."""
+    import ray_tpu
+    from ray_tpu._private.tracing import straggler_report
+    from ray_tpu.cluster.testing import Cluster
+
+    # Before Cluster(): spawned controllers/workers inherit the env, and
+    # the driver-side sampler reads it per task.
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = str(sample)
+    c = Cluster(num_workers=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(20)])
+        ray_tpu.get([noop.remote() for _ in range(batch_k)])
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core
+        # Worker-side spans flush on a 2 s timer; wait them out so traces
+        # arrive complete before reporting.
+        time.sleep(2.5)
+        spans = core.cluster_trace_spans()
+        print(straggler_report(spans, top_k=top_k))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # simulated many-node scaling (control-plane ceiling vs node count)
 # ---------------------------------------------------------------------------
@@ -306,11 +340,22 @@ def main():
                     help="comma list of simulated-controller counts "
                          "(e.g. 16,64,256) for the scaling rows")
     ap.add_argument("--sim-tasks", type=int, default=5000)
+    ap.add_argument("--traces", action="store_true",
+                    help="run ONE traced cluster window and print the "
+                         "per-task straggler report instead of the "
+                         "aggregate protocol")
+    ap.add_argument("--trace-top", type=int, default=10)
+    ap.add_argument("--trace-sample", type=int, default=8,
+                    help="1-in-N sampling for the traced window")
     ap.add_argument("--note", type=str, default=None,
                     help="annotation recorded with the history entry")
     ap.add_argument("--no-record", action="store_true",
                     help="don't append to CLUSTER_LAT.json")
     args = ap.parse_args()
+
+    if args.traces:
+        trace_run(args.batch, args.trace_top, args.trace_sample)
+        return
 
     runs = []
     for i in range(args.runs):

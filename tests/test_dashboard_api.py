@@ -1,0 +1,128 @@
+"""Dashboard JSON API + Prometheus endpoint coverage (ISSUE 3 satellite).
+
+Pins the contract of /api/nodes|memory|timeline|metrics (shape + JSON
+validity) and the /metrics Prometheus text exposition: content-type,
+label-value escaping, and counter monotonicity across scrapes.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import metrics as mx
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_raw(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.headers.get("Content-Type"), r.read().decode()
+
+
+@pytest.fixture()
+def dash(local_ray):
+    from ray_tpu.dashboard import start_dashboard
+
+    d = start_dashboard()
+    yield d
+    d.stop()
+
+
+def test_api_core_endpoints_shapes(dash):
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    ref = ray_tpu.put({"k": 1})
+    assert ray_tpu.get([work.remote(i) for i in range(4)]) == [0, 2, 4, 6]
+
+    nodes = _get_json(f"{dash.url}/api/nodes")
+    assert isinstance(nodes, list) and nodes and nodes[0]["Alive"]
+    assert {"NodeID", "Resources"} <= set(nodes[0])
+
+    memory = _get_json(f"{dash.url}/api/memory")
+    entry = memory.get(ref.hex())
+    assert entry is not None and entry["size"] > 0
+    assert {"holders", "task_pins", "in_directory"} <= set(entry)
+
+    timeline = _get_json(f"{dash.url}/api/timeline")
+    assert isinstance(timeline, list) and timeline
+    assert {"name", "ts", "dur", "pid", "cat"} <= set(timeline[-1])
+    assert any(e["cat"] == "task" for e in timeline)
+
+    metrics = _get_json(f"{dash.url}/api/metrics")
+    assert isinstance(metrics, dict)
+    for info in metrics.values():
+        assert {"kind", "values"} <= set(info)
+
+    # events endpoint exists and is a JSON list even in local mode
+    assert _get_json(f"{dash.url}/api/events") == []
+
+    traces = _get_json(f"{dash.url}/api/traces")
+    assert "stragglers" in traces
+
+    # unknown endpoints still 404
+    with pytest.raises(urllib.error.HTTPError):
+        _get_json(f"{dash.url}/api/nope")
+
+
+def test_prometheus_endpoint_exposition(dash):
+    c = mx.get_or_create(mx.Count, "dash_test_requests",
+                         description="test counter")
+    h = mx.get_or_create(mx.Histogram, "dash_test_latency_ms",
+                         description="test histogram",
+                         boundaries=[1, 10, 100])
+    c.record(3.0)
+    h.record(5.0)
+    h.record(50.0)
+
+    ctype, body = _get_raw(f"{dash.url}/metrics")
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+
+    # counter: TYPE line + _total-suffixed monotonic sample
+    assert "# TYPE dash_test_requests_total counter" in body
+    m = re.search(r"^dash_test_requests_total (\S+)$", body, re.M)
+    assert m and float(m.group(1)) == 3.0
+
+    # histogram: cumulative buckets + +Inf + sum/count
+    assert "# TYPE dash_test_latency_ms histogram" in body
+    assert re.search(r'^dash_test_latency_ms_bucket\{le="10"\} 1$', body,
+                     re.M)
+    assert re.search(r'^dash_test_latency_ms_bucket\{le="\+Inf"\} 2$', body,
+                     re.M)
+    assert re.search(r"^dash_test_latency_ms_count 2$", body, re.M)
+
+    # monotonicity: more increments can only raise the exposed value
+    c.record(2.0)
+    _, body2 = _get_raw(f"{dash.url}/metrics")
+    m2 = re.search(r"^dash_test_requests_total (\S+)$", body2, re.M)
+    assert float(m2.group(1)) == 5.0 >= float(m.group(1))
+
+    # the existing registry rides along: at least one counter and one
+    # histogram beyond the test-local ones (spill metrics register at
+    # store creation; tracing counters at first sample)
+    assert body.count("# TYPE") >= 2
+
+
+def test_prometheus_label_and_name_escaping(dash):
+    g = mx.get_or_create(mx.Gauge, "dash.test/weird-gauge",
+                         description="escaping test",
+                         tag_keys=("path",))
+    g.record(1.5, tags={"path": 'a"b\\c\nnext'})
+    _, body = _get_raw(f"{dash.url}/metrics")
+    # metric name sanitized to the prometheus charset
+    assert "dash_test_weird_gauge" in body
+    assert "dash.test/weird-gauge" not in body
+    # label value escaped: backslash, quote, newline
+    line = next(l for l in body.splitlines()
+                if l.startswith("dash_test_weird_gauge{"))
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # the raw newline never leaks into the sample

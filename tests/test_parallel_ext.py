@@ -19,7 +19,7 @@ from ray_tpu.parallel import (
     moe_param_shardings,
     ulysses_attention,
 )
-from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh, shard_map_compat
 
 
 @pytest.fixture(scope="module")
@@ -223,7 +223,7 @@ class TestCollectives:
             return s, g
 
         x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-        s, g = jax.shard_map(
+        s, g = shard_map_compat(
             body, mesh=mesh, in_specs=P("tp"), out_specs=(P("tp"), P("tp")),
             check_vma=False,
         )(x)
@@ -235,7 +235,7 @@ class TestCollectives:
             return collectives.reduce_scatter(x, "tp", axis=0)
 
         x = jnp.ones((8, 4), jnp.float32)
-        out = jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+        out = shard_map_compat(body, mesh=mesh, in_specs=P("tp"),
                             out_specs=P("tp"), check_vma=False)(x)
         # Each rank keeps 1/tp of the summed rows: global [8/tp, 4] of 2.0.
         assert out.shape == (4, 4)
@@ -246,7 +246,7 @@ class TestCollectives:
             return collectives.ring_permute(x, "sp")
 
         x = jnp.asarray([[1.0], [2.0]])
-        out = jax.shard_map(body, mesh=mesh, in_specs=P("sp"),
+        out = shard_map_compat(body, mesh=mesh, in_specs=P("sp"),
                             out_specs=P("sp"), check_vma=False)(x)
         np.testing.assert_allclose(np.asarray(out), [[2.0], [1.0]])
 
@@ -255,7 +255,7 @@ class TestCollectives:
             return collectives.broadcast_from(x, "tp", src=1)
 
         x = jnp.asarray([[3.0], [7.0]])
-        out = jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+        out = shard_map_compat(body, mesh=mesh, in_specs=P("tp"),
                             out_specs=P("tp"), check_vma=False)(x)
         np.testing.assert_allclose(np.asarray(out), [[7.0], [7.0]])
 
