@@ -74,15 +74,25 @@ def ensure_context(runtime) -> WorkerContext:
 
     User-spawned threads (e.g. a ThreadPoolExecutor in driver code) have no
     inherited context; they submit as children of the driver task.
+
+    A context auto-created here is tagged with its runtime and replaced
+    when that runtime changes: after shutdown()+init() in one process the
+    thread-local would otherwise keep deriving task/object IDs from the
+    DEAD job (their embedded job bytes then name a completion ring that
+    no longer exists, and cross-driver result serving breaks the same
+    way). Contexts set by task execution are never replaced — they carry
+    the SUBMITTING driver's job on purpose.
     """
     ctx = getattr(_LOCAL, "ctx", None)
-    if ctx is None:
+    if ctx is None or getattr(ctx, "scoped_runtime", None) \
+            not in (None, runtime):
         # Scope the thread under a unique pseudo-task so two threads never
         # derive colliding task/object IDs (counters alone are per-context).
         scope = TaskID.for_normal_task(
             runtime.job_id, runtime.driver_task_id, next(runtime._thread_scope_counter)
         )
         ctx = WorkerContext(runtime.job_id, scope)
+        ctx.scoped_runtime = runtime
         _LOCAL.ctx = ctx
     return ctx
 
@@ -525,6 +535,9 @@ class LocalRuntime:
         self._arg_pins: Dict[ObjectID, int] = {}
 
         _LOCAL.ctx = WorkerContext(self.job_id, self.driver_task_id)
+        # Tag so ensure_context replaces it if a DIFFERENT runtime (e.g. a
+        # later cluster init in this process) takes over this thread.
+        _LOCAL.ctx.scoped_runtime = self
 
     # -------------------------------------------------------------- refcount
     def add_local_ref(self, oid: ObjectID) -> None:

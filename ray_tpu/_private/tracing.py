@@ -99,17 +99,24 @@ def _trace_metrics() -> Dict[str, Any]:
 
 def make_span(trace: bytes, task_id: Optional[bytes], phase: str,
               start_mono: float, end_mono: float,
-              src: str = "") -> Dict[str, Any]:
+              src: str = "", via: str = "") -> Dict[str, Any]:
     """One phase span. Takes time.monotonic() endpoints (exact durations)
     and anchors them to wall clock here — the offset is constant per
     process, so durations stay exact while epochs become comparable
-    across machines (same convention as profile-event flush)."""
+    across machines (same convention as profile-event flush).
+
+    ``via`` attributes a span to its delivery mechanism — for
+    driver_fetch, whether the result arrived through the shm completion
+    ring ("ring"), rode inline in the completion record ("inline"), was
+    pushed with the directory answer ("inline_push"), or took a fetch
+    RPC ("rpc") — so a straggler report can separate data-plane tails
+    from control-plane ones."""
     off = time.time() - time.monotonic()
     m = _trace_metrics()
     tags = {"phase": phase}
     m["spans"].record(1.0, tags=tags)
     m["phase_ms"].record((end_mono - start_mono) * 1e3, tags=tags)
-    return {
+    out = {
         "trace": trace.hex() if isinstance(trace, bytes) else str(trace),
         "task_id": (task_id.hex() if isinstance(task_id, bytes)
                     else str(task_id or "")),
@@ -118,6 +125,9 @@ def make_span(trace: bytes, task_id: Optional[bytes], phase: str,
         "end": end_mono + off,
         "src": src,
     }
+    if via:
+        out["via"] = via
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -138,6 +148,9 @@ def group_traces(spans: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                                   "phases": {}})
         if sp.get("task_id"):
             rec["task_id"] = sp["task_id"]
+        if sp.get("via") and sp["phase"] == "driver_fetch":
+            # Result-plane attribution: how the owner got the bytes.
+            rec["fetch_via"] = sp["via"]
         cur = rec["phases"].get(sp["phase"])
         if cur is None:
             rec["phases"][sp["phase"]] = [sp["start"], sp["end"]]
@@ -171,6 +184,8 @@ def straggler_report(spans: List[Dict[str, Any]], top_k: int = 10) -> str:
             win = rec["phases"].get(p)
             cells.append(f"{(win[1] - win[0]) * 1e3:>11.3f}" if win
                          else f"{'.':>11}")
+        via = rec.get("fetch_via")
         lines.append(f"{tr:<18} {rec['task_id'][:16]:<18} "
-                     f"{rec['total_ms']:>9.3f} " + " ".join(cells))
+                     f"{rec['total_ms']:>9.3f} " + " ".join(cells)
+                     + (f"  [{via}]" if via else ""))
     return "\n".join(lines)

@@ -97,6 +97,18 @@ class NodeController:
             self.store.set_spill_callbacks(on_spill=self._on_object_spilled,
                                            on_restore=self._on_object_restored)
         self._overflow: Dict[bytes, bytes] = {}  # blobs too big for the arena
+        # Inline small results (the new result data plane): bytes carried
+        # in task_done "added" items are cached here so local dep staging
+        # and fetch_batch serve them without an arena slot. LRU under a
+        # byte budget; the GCS directory keeps its own inline copy, so an
+        # eviction here costs a directory round trip, never the object.
+        from collections import deque as _deque
+
+        self._inline: Dict[bytes, bytes] = {}
+        self._inline_order: Any = _deque()
+        self._inline_bytes = 0
+        self._inline_budget = int(os.environ.get(
+            "RAY_TPU_INLINE_NODE_CACHE_BYTES", 32 << 20))
         # Native data plane (reference: ObjectManager's dedicated transfer
         # service): a C++ thread streaming arena bytes peer-to-peer. Absent
         # (port 0) when the arena fell back to the Python store.
@@ -186,7 +198,7 @@ class NodeController:
                                     push_handler=self._on_gcs_push)
         from . import wire
 
-        self._gcs.call({
+        reg = self._gcs.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
             "store_name": self.store_name,
@@ -194,6 +206,14 @@ class NodeController:
             "label": self.label,
             "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION,
         })
+        # The GCS's advertised version gates the v2 inline-result frames
+        # on the task_done_batch relay (a v1 GCS gets pickle instead).
+        self._gcs.peer_wire = int(reg.get("wire") or 1)
+        # Reap completion rings left by SIGKILLed owners (each pins ~1 MiB
+        # of tmpfs); flock liveness keeps live rings untouched.
+        from .._native import completion_ring as _cring
+
+        _cring.sweep_stale_rings()
         for _ in range(self.num_workers):
             self._spawn_worker()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
@@ -537,7 +557,29 @@ class NodeController:
         blob = self.store.get_bytes(oid)
         if blob is None:
             blob = self._overflow.get(oid)
+        if blob is None:
+            blob = self._inline.get(oid)
         return blob
+
+    def _stash_inline(self, oid: bytes, blob: bytes) -> None:
+        """Cache one inline result carried in a completion (LRU under the
+        byte budget). Replaces nothing on duplicates — results are
+        immutable, and double-counting the budget would leak it."""
+        if oid in self._inline:
+            return
+        self._inline[oid] = blob
+        self._inline_order.append(oid)
+        self._inline_bytes += len(blob)
+        while self._inline_bytes > self._inline_budget and self._inline_order:
+            old = self._inline_order.popleft()
+            dropped = self._inline.pop(old, None)
+            if dropped is not None:
+                self._inline_bytes -= len(dropped)
+
+    def _drop_inline(self, oid: bytes) -> None:
+        blob = self._inline.pop(oid, None)
+        if blob is not None:
+            self._inline_bytes -= len(blob)
 
     def _transfer_client(self):
         """Lazy native data-plane client bound to this node's arena."""
@@ -567,7 +609,16 @@ class NodeController:
         transfer instead of racing N duplicate pulls (reference: the pull
         manager dedupes active pulls, object_manager.h:213).
         """
-        blob = self._local_blob(oid)
+        blob = self.store.get_bytes(oid)
+        if blob is None:
+            blob = self._overflow.get(oid)
+        if blob is None:
+            blob = self._inline.get(oid)
+            if blob is not None:
+                # Promote an inline-carried result into the arena before
+                # dispatch: the executing worker then resolves this dep
+                # zero-copy from shm instead of a directory round trip.
+                await self._store_put(oid, blob)
         if blob is not None:
             return blob
         task = self._inflight_fetch.get(oid)
@@ -589,6 +640,12 @@ class NodeController:
                 # The producing task failed terminally: the error blob is
                 # the object (consumers raise it on deserialize).
                 return resp["error_blob"]
+            if resp.get("inline_blob") is not None:
+                # Small result carried by the directory itself: land it in
+                # the arena so local consumers read zero-copy.
+                blob = resp["inline_blob"]
+                await self._store_put(oid, blob)
+                return blob
             blob = self._local_blob(oid)
             if blob is not None:
                 return blob
@@ -920,6 +977,7 @@ class NodeController:
         for oid in oids:
             self.store.delete(oid)
             self._overflow.pop(oid, None)
+            self._drop_inline(oid)
 
     async def _cancel_task(self, task_id: bytes, force: bool) -> None:
         """Cancel a GCS-dispatched task on this node: pre-dispatch tasks are
@@ -942,6 +1000,8 @@ class NodeController:
 
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
+        from . import wire
+
         s = self.server
         s.on_disconnect(self._on_conn_lost)
 
@@ -958,7 +1018,10 @@ class NodeController:
                 conn.meta["wire"] = int(msg["wire"])
             handle.ready.set()
             self._idle_event.set()
-            return {"ok": True, "node_id": self.node_id}
+            # Our own wire version rides back so the worker knows it may
+            # send v2 inline-result frames on the task_done path.
+            return {"ok": True, "node_id": self.node_id,
+                    "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION}
 
         @s.handler("assign_task")
         async def assign_task(msg, conn):
@@ -1009,8 +1072,13 @@ class NodeController:
             # task_done_batch item (one wave message carries both), so
             # registration still strictly precedes the finish processing.
             added = msg.get("added", [])
-            for oid, _size in added:
-                for ev in self._store_waiters.pop(oid, []):
+            for ent in added:
+                if len(ent) > 2 and ent[2] is not None:
+                    # Inline small result riding the completion: cache the
+                    # bytes so local dep staging and fetch_batch serve
+                    # them without an arena slot ever existing.
+                    self._stash_inline(ent[0], ent[2])
+                for ev in self._store_waiters.pop(ent[0], []):
                     ev.set()
             pid = msg.get("pid") or conn.meta.get("worker_pid")
             w = self.workers.get(pid)
@@ -1061,12 +1129,15 @@ class NodeController:
                         reported = True
             if not reported:
                 # Actor-method completion (or an unknown worker): no done
-                # item will carry these registrations — report directly.
-                for oid, size in added:
-                    self._gcs_send({
-                        "type": "add_object_location", "object_id": oid,
-                        "node_id": self.node_id, "size": size,
-                    })
+                # item will carry these registrations — report directly
+                # (inline bytes ride the pickled dict, no binary codec).
+                for ent in added:
+                    reg = {"type": "add_object_location",
+                           "object_id": ent[0],
+                           "node_id": self.node_id, "size": ent[1]}
+                    if len(ent) > 2 and ent[2] is not None:
+                        reg["blob"] = ent[2]
+                    self._gcs_send(reg)
             return None
 
         @s.handler("lease_worker")
@@ -1208,7 +1279,8 @@ class NodeController:
         @s.handler("has_object")
         async def has_object(msg, conn):
             oid = msg["object_id"]
-            has = self.store.contains(oid) or oid in self._overflow
+            has = self.store.contains(oid) or oid in self._overflow \
+                or oid in self._inline
             if not has:
                 self._drop_location(oid)
             return {"ok": True, "has": has}
@@ -1218,6 +1290,7 @@ class NodeController:
             for oid in msg["object_ids"]:
                 self.store.delete(oid)
                 self._overflow.pop(oid, None)
+                self._drop_inline(oid)
                 self._drop_location(oid)
             return None
 

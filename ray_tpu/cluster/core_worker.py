@@ -87,6 +87,20 @@ class ClusterCoreWorker:
         # it from their controller's env; drivers attach lazily — shm
         # existence doubles as the same-host check).
         self.local_store = None
+        # Same-host result data plane: a per-owner shm completion ring
+        # (see _native/completion_ring.py). Consumer side: THIS process's
+        # ring, harvested by get()/wait()/the future resolver — O(wave)
+        # ring pops instead of O(arena) rescans. Publisher side: rings of
+        # OTHER owners this process executes tasks for, opened by name
+        # derived from the return oid's job bytes (False = probed absent;
+        # re-probed after _PUB_RETRY_S so a late-created ring is found).
+        self._ring: Any = None
+        self._ring_ready: set = set()          # oids known sealed in arena
+        self._ring_ready_order: deque = deque()
+        self._pub_rings: Dict[str, Any] = {}
+        self._pub_lock = threading.Lock()
+        if role == "driver":
+            self._ensure_ring()
         self._transfer_cli: Any = None  # None=unprobed, False=unavailable
         self._transfer_has_store = False
         self._sub_client = None
@@ -373,10 +387,13 @@ class ClusterCoreWorker:
         return args, kwargs, deps, pins
 
     def record_trace_span(self, trace: bytes, task_id, phase: str,
-                          start_mono: float, end_mono: float) -> None:
-        """Buffer one phase span of a sampled task (flushed in batches)."""
+                          start_mono: float, end_mono: float,
+                          via: str = "") -> None:
+        """Buffer one phase span of a sampled task (flushed in batches).
+        ``via`` attributes a driver_fetch span to its delivery path
+        (ring / inline / inline_push / rpc)."""
         sp = tracing.make_span(trace, task_id, phase, start_mono, end_mono,
-                               src=self.role)
+                               src=self.role, via=via)
         with self._trace_span_lock:
             self.trace_spans.append(sp)
             if len(self.trace_spans) > 50_000:
@@ -390,6 +407,149 @@ class ClusterCoreWorker:
             cell = self.phase_stats[name] = [0, 0.0]
         cell[0] += n
         cell[1] += seconds
+
+    # ------------------------------------------------- result data plane
+    def _ensure_ring(self):
+        """Create this owner's completion ring (idempotent). Drivers do it
+        eagerly; worker cores only when they first own results (nested
+        submissions), so short-lived workers don't litter /dev/shm."""
+        from .._native import completion_ring as cring
+
+        if self._ring is None and cring.ring_enabled():
+            try:
+                self._ring = cring.CompletionRing(
+                    cring.ring_name(self.job_id.binary()), create=True)
+            except OSError:
+                self._ring = False  # creation failed: old path serves
+        return self._ring or None
+
+    def _ring_active(self) -> bool:
+        ring = self._ring
+        return bool(ring) and not ring.degraded
+
+    def publish_completion(self, oid: bytes, size: int,
+                           inline: Optional[bytes] = None) -> bool:
+        """Publish one sealed result straight into its owner's completion
+        ring (the ring name is derived from the oid's job bytes). Best
+        effort: False when the owner is cross-host, the ring is
+        full/degraded, or the plane is disabled — the result then reaches
+        the owner through the normal directory path."""
+        from .._native import completion_ring as cring
+
+        if not cring.ring_enabled() or len(oid) < 16:
+            return False
+        name = cring.ring_name(oid[12:16])
+        with self._pub_lock:
+            pub = self._pub_rings.get(name)
+            if pub is None or (isinstance(pub, float)
+                               and time.monotonic() > pub):
+                opened = cring.open_publisher(name)
+                if opened is None:
+                    # Probed absent: cross-host owner (common) or a ring
+                    # created after our probe — re-probe after a beat.
+                    self._pub_rings[name] = time.monotonic() + 5.0
+                    if len(self._pub_rings) > 256:
+                        self._pub_rings.pop(next(iter(self._pub_rings)))
+                    return False
+                pub = self._pub_rings[name] = opened
+            elif isinstance(pub, float):
+                return False
+        try:
+            ok = pub.publish(oid, size, inline=inline)
+        except (OSError, ValueError):
+            ok = False
+        if not ok and pub.degraded:
+            with self._pub_lock:
+                self._pub_rings[name] = time.monotonic() + 30.0
+            pub.close()
+        return ok
+
+    def _count_result(self, via: str, n: int = 1, nbytes: int = 0) -> None:
+        """Attribute n result deliveries to one path (ring / inline /
+        fetch_rpc ...): a phase-stats cell (read by the A/B script and the
+        message-count tests) plus the exported metrics."""
+        if n <= 0:
+            return
+        self._phase_add(f"result:{via}", 0.0, n)
+        m = getattr(self, "_rp_metrics", None)
+        if m is None:
+            from ..metrics import result_plane_metrics
+
+            m = self._rp_metrics = result_plane_metrics()
+        m["records"].record(n, tags={"via": via})
+        if nbytes:
+            m["inline_bytes"].record(nbytes)
+
+    def _ring_wait(self, budget_s: float,
+                   deadline: Optional[float]) -> bool:
+        """Ring-first wait (the plasma notification-socket discipline):
+        watch the ring's head word — one mmap read per tick — instead of
+        parking on the directory long-poll, so a same-host completion is
+        picked up in sub-millisecond time and the GCS never builds a wake
+        response for it. Returns True as soon as unpopped records exist;
+        False after ``budget_s`` of silence (the caller then falls back to
+        the long-poll, which remains the path for cross-host results,
+        worker crashes, and ring-full fallbacks)."""
+        ring = self._ring
+        if not ring or ring.degraded:
+            return False
+        end = time.monotonic() + budget_s
+        if deadline is not None and deadline < end:
+            end = deadline
+        sleep_s = 0.0002
+        while True:
+            if ring.has_pending():
+                return True
+            if time.monotonic() >= end:
+                return False
+            time.sleep(sleep_s)
+            if sleep_s < 0.001:
+                sleep_s *= 2
+
+    def _ring_harvest(self, pending: Optional[set] = None
+                      ) -> List[Tuple[bytes, bytes, str]]:
+        """Drain this owner's completion ring. Records matching ``pending``
+        resolve to (oid, blob, via) for the caller; everything else parks
+        in the blob cache (inline payloads) or the ring-ready set (arena
+        slots) for whichever get()/wait()/future asks next."""
+        ring = self._ring
+        if not ring or ring.degraded:
+            return []
+        recs = ring.pop_all()
+        if not recs:
+            return []
+        out: List[Tuple[bytes, bytes, str]] = []
+        store = self.local_store
+        n_ring = n_inline = inline_bytes = 0
+        for oid, flags, size, inline in recs:
+            if inline is not None:
+                n_inline += 1
+                inline_bytes += len(inline)
+                if pending is not None and oid in pending:
+                    out.append((oid, inline, "inline"))
+                else:
+                    self._cache_blob(oid, inline)
+                continue
+            n_ring += 1
+            if pending is not None and oid in pending and store is not None:
+                blob = store.get_bytes(oid)
+                if blob is not None:
+                    out.append((oid, blob, "ring"))
+                    continue
+            self._ring_ready.add(oid)
+            self._ring_ready_order.append(oid)
+            while len(self._ring_ready_order) > 65536:
+                self._ring_ready.discard(self._ring_ready_order.popleft())
+        if ring.degraded:
+            # Torn record detected mid-harvest (worker died mid-publish):
+            # everything already popped is intact; the rest of this job
+            # rides the RPC/directory path.
+            from ..metrics import result_plane_metrics
+
+            result_plane_metrics()["ring_torn"].record(1.0)
+        self._count_result("ring", n_ring)
+        self._count_result("inline", n_inline, inline_bytes)
+        return out
 
     # ---------------------------------------------------------- submit pipe
     def _queue_submit(self, msg: Dict) -> None:
@@ -499,6 +659,11 @@ class ClusterCoreWorker:
         * **queued** — everything else goes to the GCS task table, which
           owns placement (batch kernel), dispatch, and retry.
         """
+        if self._ring is None:
+            # Worker cores create their ring on first ownership (nested
+            # submissions) — before the spec leaves, so the executing
+            # worker's publish probe finds it.
+            self._ensure_ring()
         trace = tracing.maybe_sample()
         t0 = time.perf_counter()
         t0m = time.monotonic() if trace is not None else 0.0
@@ -1036,6 +1201,13 @@ class ClusterCoreWorker:
         out: Dict[bytes, bytes] = {}
         by_addr: Dict[tuple, list] = {}
         for oid, info in infos.items():
+            # Inline small result carried in the directory response: the
+            # bytes are already in hand, no node round trip needed.
+            blob = info.get("inline_blob")
+            if blob is not None:
+                out[oid] = blob
+                self._count_result("inline_push")
+                continue
             # Same-host results live in the shared shm arena already — a
             # direct read beats ANY fetch RPC (measured: the 5k-fan-out
             # client previously round-tripped fetch_batch to its own
@@ -1056,7 +1228,9 @@ class ClusterCoreWorker:
                         timeout=60.0)
                 except (RuntimeError, ConnectionError, TimeoutError):
                     continue
-                for oid, blob in resp.get("blobs", {}).items():
+                fetched = resp.get("blobs", {})
+                self._count_result("fetch_rpc", len(fetched))
+                for oid, blob in fetched.items():
                     out[oid] = blob
                     self._cache_blob(oid, blob)
         for oid, info in infos.items():
@@ -1067,9 +1241,12 @@ class ClusterCoreWorker:
                 info.get("transfer_addresses", []))
             if blob is not None:
                 out[oid] = blob
+                self._count_result("fetch_rpc")
         return out
 
     def _fetch_blob(self, oid: bytes, timeout: Optional[float]) -> bytes:
+        if self._ring_active():
+            self._ring_harvest()  # drain into the caches checked below
         if self.local_store is not None:
             blob = self.local_store.get_bytes(oid)
             if blob is not None:
@@ -1090,6 +1267,10 @@ class ClusterCoreWorker:
                 # Terminal task failure recorded in the GCS task table
                 # (retries exhausted / cancelled): no node holds a copy.
                 return resp["error_blob"]
+            if resp.get("inline_blob") is not None:
+                # Small result carried inline by the directory itself.
+                self._count_result("inline_push")
+                return resp["inline_blob"]
             blob = self._fetch_from(
                 oid, resp.get("addresses", []),
                 resp.get("transfer_addresses", []))
@@ -1137,50 +1318,80 @@ class ClusterCoreWorker:
                   if self._trace_by_oid else None)
         t_get = time.monotonic() if traced else 0.0
 
-        def _trace_note(oid):
+        def _trace_note(oid, via=""):
             traced.discard(oid)
             ent = self._trace_by_oid.pop(oid, None)
             if ent is not None:
                 self.record_trace_span(ent[0], ent[1], "driver_fetch",
-                                       t_get, time.monotonic())
+                                       t_get, time.monotonic(), via=via)
+
+        def _resolve(oid, blob, via=""):
+            blobs[oid] = blob
+            pending.discard(oid)
+            self._direct_observed(oid)
+            if traced:
+                _trace_note(oid, via)
+        ring_hot = False  # ring delivered on the previous cycle
         while pending:
-            # Full local scan every wake is INTENTIONAL: same-host workers
-            # deposit results into the shared arena ahead of the (batched)
-            # directory registration, so each long-poll wake harvests the
-            # whole arena backlog, not just the registered slice. Two A/Bs
-            # confirmed: restricting to direct-push oids measured 14%
-            # WORSE warm throughput (CLUSTER_LAT.json 1785482430 vs
-            # 1785482520), and a frontier window with a 512-miss cutoff
-            # measured 11% worse (1,131 vs 1,270 tasks/s) — a starved
-            # scan just shifts the load onto extra directory long-polls.
             t0 = time.perf_counter()
             n0 = len(pending)
             store = self.local_store
-            if store is not None and hasattr(store, "get_bytes_many"):
-                for oid, blob in store.get_bytes_many(list(pending)).items():
-                    blobs[oid] = blob
-                    pending.discard(oid)
-                    self._direct_observed(oid)
-                    if traced:
-                        _trace_note(oid)
-                if self._blob_cache and pending:
+            ring_on = self._ring_active()
+            if ring_on:
+                # Result data plane: O(completions-this-wave) ring pops —
+                # each record names a sealed (or inline-carried) result,
+                # so nothing is scanned and small results need no arena.
+                got = self._ring_harvest(pending)
+                for oid, blob, via in got:
+                    _resolve(oid, blob, via)
+                ring_hot = bool(got)
+                ring_on = self._ring_active()
+            if first or not ring_on:
+                # No ring (kill switch / degraded / non-owner results):
+                # the full local scan per wake is INTENTIONAL on this
+                # path: same-host workers deposit results into the shared
+                # arena ahead of the (batched) directory registration, so
+                # each long-poll wake harvests the whole arena backlog,
+                # not just the registered slice. Two A/Bs confirmed:
+                # restricting to direct-push oids measured 14% WORSE warm
+                # throughput (CLUSTER_LAT.json 1785482430 vs 1785482520),
+                # and a frontier window with a 512-miss cutoff measured
+                # 11% worse — a starved scan just shifts the load onto
+                # extra directory long-polls. (With the ring carrying the
+                # common path, the scan runs once, on entry, to pick up
+                # results that landed before this get().)
+                if store is not None and hasattr(store, "get_bytes_many"):
+                    for oid, blob in store.get_bytes_many(
+                            list(pending)).items():
+                        _resolve(oid, blob)
+                    if self._blob_cache and pending:
+                        for oid in list(pending):
+                            blob = self._blob_cache.get(oid)
+                            if blob is not None:
+                                _resolve(oid, blob)
+                else:
                     for oid in list(pending):
-                        blob = self._blob_cache.get(oid)
+                        blob = self._local_blob(oid)
                         if blob is not None:
-                            blobs[oid] = blob
-                            pending.discard(oid)
-                            self._direct_observed(oid)
-                            if traced:
-                                _trace_note(oid)
-            else:
+                            _resolve(oid, blob)
+            elif pending and not ring_hot:
+                # Ring active but quiet this cycle: results can still
+                # arrive via other paths (controller-stored blobs, another
+                # thread's fetch) — a cache sweep costs dict lookups, not
+                # arena syscalls. Skipped while the ring is delivering:
+                # with per-record wakeups an O(pending) sweep per cycle
+                # would be quadratic over a fan-out.
                 for oid in list(pending):
-                    blob = self._local_blob(oid)
+                    blob = self._blob_cache.get(oid)
+                    if blob is None and oid in self._ring_ready:
+                        self._ring_ready.discard(oid)
+                        blob = (store.get_bytes(oid)
+                                if store is not None else None)
+                        if blob is not None:
+                            _resolve(oid, blob, "ring")
+                            continue
                     if blob is not None:
-                        blobs[oid] = blob
-                        pending.discard(oid)
-                        self._direct_observed(oid)
-                        if traced:
-                            _trace_note(oid)
+                        _resolve(oid, blob)
             self._phase_add("driver_fetch", time.perf_counter() - t0,
                             n0 - len(pending))
             if not pending:
@@ -1191,31 +1402,44 @@ class ClusterCoreWorker:
             # pending oid dominated GCS CPU. First cycle asks with no wait
             # so an all-ready get never blocks.
             wait_s = 0.0 if first else 1.0
-            if len(pending) <= 4 and store is not None and (
+            if len(pending) <= 4 and (ring_on or store is not None) and (
                     not first or all(o in self._direct_outstanding
                                      for o in pending)):
-                # Small-get fast path: the result hits the same-host arena
-                # a full worker->controller->GCS->driver chain BEFORE the
-                # directory can wake our long-poll — a ~2 ms arena spin
-                # shaves that tail off every serial round trip (A/B'd:
-                # removing it measured p50 1.02 ms vs 0.85 ms with it).
-                # On the FIRST cycle it only runs when every ref was
+                # Small-get fast path: the result hits the same-host data
+                # plane a full worker->controller->GCS->driver chain
+                # BEFORE the directory can wake our long-poll — a ~2 ms
+                # spin (ring pops when active, else an arena probe) shaves
+                # that tail off every serial round trip (A/B'd: removing
+                # it measured p50 1.02 ms vs 0.85 ms with it). On the
+                # FIRST cycle it only runs when every ref was
                 # direct-pushed (the result is expected imminently; the
                 # wait_s=0 directory poll would be a wasted round trip).
                 spin_end = time.monotonic() + 0.002
                 while pending and time.monotonic() < spin_end:
-                    for oid, blob in store.get_bytes_many(
-                            list(pending)).items():
-                        blobs[oid] = blob
-                        pending.discard(oid)
-                        self._direct_observed(oid)
-                        if traced:
-                            _trace_note(oid)
+                    if ring_on:
+                        for oid, blob, via in self._ring_harvest(pending):
+                            _resolve(oid, blob, via)
+                        ring_on = self._ring_active()
+                    if pending and store is not None:
+                        for oid, blob in store.get_bytes_many(
+                                list(pending)).items():
+                            _resolve(oid, blob)
                     if pending:
                         time.sleep(0.0001)
                 if not pending:
                     break
+            was_first = first
             first = False
+            if not was_first and ring_on and self._ring_wait(
+                    0.025 if ring_hot else 0.002, deadline):
+                # Ring-first wait paid off: records landed — loop back to
+                # harvest them without a directory round trip. The long-
+                # poll below only runs once the ring goes quiet (~25 ms
+                # while it is delivering, ~2 ms when results are arriving
+                # some other way, e.g. cross-host), so the GCS stops
+                # building per-wave wake responses for results the ring
+                # already delivered.
+                continue
             if deadline is not None:
                 wait_s = max(0.0, min(wait_s,
                                       deadline - time.monotonic()))
@@ -1248,6 +1472,7 @@ class ClusterCoreWorker:
                 timeout=wait_s + 30.0)
             n_before = len(pending)
             to_fetch = {}
+            n_push = 0
             for oid, info in resp.get("objects", {}).items():
                 if info.get("error_blob") is not None:
                     blobs[oid] = info["error_blob"]
@@ -1255,7 +1480,17 @@ class ClusterCoreWorker:
                     if traced:
                         _trace_note(oid)
                     continue
+                blob = info.get("inline_blob")
+                if blob is not None:
+                    # Inline small result pushed WITH the completion (the
+                    # GCS carried the bytes): no fetch RPC at all — this
+                    # is how cross-host owners ride the new data plane.
+                    if oid in pending:
+                        _resolve(oid, blob, "inline_push")
+                        n_push += 1
+                    continue
                 to_fetch[oid] = info
+            self._count_result("inline_push", n_push)
             t0 = time.perf_counter()
             fetched = self._fetch_many(to_fetch)
             for oid, blob in fetched.items():
@@ -1263,10 +1498,12 @@ class ClusterCoreWorker:
                 pending.discard(oid)
                 self._direct_observed(oid)
                 if traced:
-                    _trace_note(oid)
-            if to_fetch:
+                    _trace_note(oid, "rpc")
+            if to_fetch or n_push:
+                # inline_push arrivals count as (zero-cost) fetches so the
+                # driver_fetch phase cell still reflects every delivery.
                 self._phase_add("driver_fetch", time.perf_counter() - t0,
-                                len(fetched))
+                                len(fetched) + n_push)
             if not pending:
                 break
             progressed = len(pending) < n_before
@@ -1300,6 +1537,11 @@ class ClusterCoreWorker:
         ready: set = set()
         last_probe = 0.0
         while True:
+            if self._ring_active():
+                # Drain completions into the caches _local_blob consults
+                # (inline payloads -> blob cache; slot records are covered
+                # by the arena probe itself).
+                self._ring_harvest()
             unknown = []
             for oid in list(pending):
                 if oid in ready:
@@ -1415,6 +1657,8 @@ class ClusterCoreWorker:
             self._direct_observed(oid)
             settled += 1
 
+        if self._ring_active():
+            self._ring_harvest()  # inline results land in the blob cache
         for oid in list(pending):
             blob = self._local_blob(oid)
             if blob is not None:
@@ -1583,6 +1827,13 @@ class ClusterCoreWorker:
             except (ConnectionError, OSError):
                 pass
         self.flush_events()
+        if self._ring:
+            self._ring.close()  # owner side unlinks the shm segment
+        with self._pub_lock:
+            pubs, self._pub_rings = list(self._pub_rings.values()), {}
+        for pub in pubs:
+            if not isinstance(pub, float):
+                pub.close()
         for client in self._controllers.values():
             client.close()
         if self._sub_client is not None:

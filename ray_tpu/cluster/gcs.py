@@ -101,6 +101,19 @@ class GcsServer:
         self.task_table: Dict[bytes, Dict[str, Any]] = {}
         self.lineage: Dict[bytes, bytes] = {}
         self.error_objects: Dict[bytes, bytes] = {}
+        # Inline small results (the result data plane): the serialized
+        # bytes of results <= RAY_TPU_INLINE_RESULT_MAX ride inside
+        # task_done_batch items and are kept on the directory entry
+        # ("inline"), served straight from locations responses — small
+        # objects need no arena slot and no fetch RPC anywhere. Bounded:
+        # beyond the byte budget the oldest inline payloads are dropped
+        # (consumers then fall back to holder caches or lineage).
+        import os as _os
+
+        self._inline_total = 0
+        self._inline_order: Any = _deque()
+        self._inline_budget = int(_os.environ.get(
+            "RAY_TPU_INLINE_GCS_BUDGET_BYTES", 64 << 20))
         # free() tombstones: a location registration that races the free
         # (put's add_object_location is one-way and may arrive after the
         # free_objects call) must not resurrect the object in the directory.
@@ -410,7 +423,11 @@ class GcsServer:
         # A SPILLED copy counts: the holding node restores it from disk on
         # fetch, which the consuming node's pull path does transparently.
         entry = self.objects.get(oid)
-        return bool(entry) and any(
+        if not entry:
+            return False
+        if entry.get("inline") is not None:
+            return True  # the directory itself holds the bytes
+        return any(
             n in self.nodes and self.nodes[n].alive
             for n in (*entry["locations"], *self._spilled_set(entry))
         )
@@ -753,6 +770,8 @@ class GcsServer:
         self._ref_zero_since.pop(oid, None)
         self._restore_requested.pop(oid, None)
         entry = self.objects.pop(oid, None)
+        if entry is not None and entry.get("inline") is not None:
+            self._inline_total -= len(entry["inline"])
         # SPILLED holders must delete their disk copies too.
         holders = (sorted({*entry["locations"], *self._spilled_set(entry)})
                    if entry else [])
@@ -806,6 +825,8 @@ class GcsServer:
         producing task from lineage (reference: ReconstructionPolicy +
         ObjectRecovery, which likewise consults the external store first)."""
         entry = self.objects.get(oid)
+        if entry is not None and entry.get("inline") is not None:
+            return True  # served straight from the directory
         if entry is not None:
             for nid in self._alive_nodes(self._spilled_set(entry)):
                 conn = self._node_conns.get(nid)
@@ -929,6 +950,8 @@ class GcsServer:
             entry["locations"].discard(node.node_id)
             self._spilled_set(entry).discard(node.node_id)
             if not entry["locations"] and not entry["spilled"]:
+                if entry.get("inline") is not None:
+                    continue  # the directory still holds the bytes
                 del self.objects[oid]
         # Tasks still sitting in this node's UNSENT dispatch buffer — or in
         # a pending batch whose send was never even attempted (conn-rebind
@@ -1386,7 +1409,10 @@ class GcsServer:
                               address=list(msg["address"]),
                               resources=dict(msg["resources"]))
             await self.publish("nodes", {"node_id": node_id, "state": "ALIVE"})
-            return {"ok": True, "node_index": entry.index}
+            from . import wire as _wire
+
+            return {"ok": True, "node_index": entry.index,
+                    "wire": 0 if _wire.pickle_only() else _wire.WIRE_VERSION}
 
         @s.handler("report_node_dead")
         async def report_node_dead(msg, conn):
@@ -1576,6 +1602,12 @@ class GcsServer:
                     if probe_recovery:
                         self._maybe_recover_object(oid)
                     continue
+                blob = entry.get("inline")
+                if blob is not None:
+                    # Inline small result: push the bytes with the answer —
+                    # the caller needs no address and no fetch RPC.
+                    out[oid] = {"inline_blob": blob}
+                    continue
                 alive = self._alive_nodes(entry["locations"])
                 if not alive:
                     # SPILLED copies are fetchable too: the holder restores
@@ -1637,6 +1669,8 @@ class GcsServer:
                     entry = self.objects.get(oid)
                     if not entry:
                         continue
+                    if entry.get("inline") is not None:
+                        return True
                     for n in entry["locations"]:
                         node = self.nodes.get(n)
                         if node is not None and node.alive:
@@ -1775,8 +1809,9 @@ class GcsServer:
             so a FINISHED record never has unindexed outputs."""
             node_id = msg["node_id"]
             for item in msg["items"]:
-                for oid, size in item.get("added") or ():
-                    _add_location(oid, node_id, size)
+                for ent in item.get("added") or ():
+                    _add_location(ent[0], node_id, ent[1],
+                                  ent[2] if len(ent) > 2 else None)
                 _handle_task_done({"node_id": node_id, **item})
             return None  # one-way
 
@@ -1857,10 +1892,13 @@ class GcsServer:
                         pass
             return {"ok": True, "cancelled": True}
 
-        def _add_location(oid: bytes, node_id: str, size: int) -> None:
+        def _add_location(oid: bytes, node_id: str, size: int,
+                          blob: bytes = None) -> None:
             """One directory registration (shared by the add_object_location
             oneway and the registrations riding inside task_done_batch
-            items)."""
+            items). ``blob`` is an inline small result carried with the
+            completion: the directory keeps the bytes and serves them
+            straight from locations responses — consumers never fetch."""
             if oid in self._freed:
                 # Late registration of a freed object: keep it out of the
                 # directory and tell the holder to evict its copy.
@@ -1871,6 +1909,19 @@ class GcsServer:
             entry = self.objects.setdefault(
                 oid, {"locations": set(), "size": size}
             )
+            if blob is not None and "inline" not in entry:
+                entry["inline"] = blob
+                self._inline_total += len(blob)
+                self._inline_order.append(oid)
+                while self._inline_total > self._inline_budget \
+                        and self._inline_order:
+                    old_oid = self._inline_order.popleft()
+                    old_entry = self.objects.get(old_oid)
+                    dropped = (old_entry.pop("inline", None)
+                               if old_entry else None)
+                    if dropped is not None:
+                        self._inline_total -= len(dropped)
+                        self._stat_add("inline:gcs_evicted", 0.0, 1)
             entry["locations"].add(node_id)
             # Back in an arena: the node's SPILLED marker (if any) is stale.
             self._spilled_set(entry).discard(node_id)
@@ -1881,7 +1932,7 @@ class GcsServer:
         @s.handler("add_object_location")
         async def add_object_location(msg, conn):
             _add_location(msg["object_id"], msg["node_id"],
-                          msg.get("size", 0))
+                          msg.get("size", 0), msg.get("blob"))
             return None
 
         @s.handler("object_spilled")
@@ -1923,6 +1974,9 @@ class GcsServer:
                     return {"ok": True, "locations": [], "addresses": [],
                             "error_blob": blob}
                 entry = self.objects.get(oid)
+                if entry is not None and entry.get("inline") is not None:
+                    return {"ok": True, "locations": [], "addresses": [],
+                            "inline_blob": entry["inline"]}
                 if entry is None and msg.get("wait"):
                     # No copy anywhere: if lineage knows the producer,
                     # re-execute it (reconstruction) while we wait.
@@ -1938,6 +1992,9 @@ class GcsServer:
                         return {"ok": True, "locations": [], "addresses": [],
                                 "error_blob": blob}
                     entry = self.objects.get(oid)
+                    if entry is not None and entry.get("inline") is not None:
+                        return {"ok": True, "locations": [], "addresses": [],
+                                "inline_blob": entry["inline"]}
                 locations = sorted(entry["locations"]) if entry else []
                 alive = [n for n in locations
                          if n in self.nodes and self.nodes[n].alive]
@@ -2086,7 +2143,8 @@ class GcsServer:
             if entry is not None:
                 entry["locations"].discard(msg["node_id"])
                 self._spilled_set(entry).discard(msg["node_id"])
-                if not entry["locations"] and not entry["spilled"]:
+                if not entry["locations"] and not entry["spilled"] \
+                        and entry.get("inline") is None:
                     self.objects.pop(msg["object_id"], None)
             return None
 
@@ -2242,6 +2300,7 @@ class GcsServer:
                     "locations": list(info.get("locations", [])),
                     "spilled": list(info.get("spilled", [])),
                     "size": info.get("size", 0),
+                    "inline": info.get("inline") is not None,
                 }
             return {"ok": True, "objects": out}
 

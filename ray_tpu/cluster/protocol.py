@@ -72,16 +72,19 @@ def _compact(bufs: List[bytes], small: int = 1 << 14) -> List[bytes]:
 
 
 def encode_frames(msg: Dict[str, Any], binary_ok: bool,
-                  req_type: Optional[str] = None) -> List[bytes]:
+                  req_type: Optional[str] = None,
+                  peer_wire: int = 1) -> List[bytes]:
     """Encode one message into a list of buffers (length header first).
 
     ``binary_ok`` gates the fast path; ``req_type`` selects a response
-    codec (responses carry no ``type`` field of their own). Falls back to
-    one pickled buffer for types without a binary codec."""
+    codec (responses carry no ``type`` field of their own); ``peer_wire``
+    is the receiver's advertised wire version — frames the peer could not
+    parse (v2 inline-result frames to a v1 peer) fall back per-message to
+    pickle, as do types without a binary codec."""
     if binary_ok and not wire.pickle_only():
         try:
-            bufs = (wire.encode_response(req_type, msg) if req_type
-                    else wire.encode(msg))
+            bufs = (wire.encode_response(req_type, msg, peer_wire) if req_type
+                    else wire.encode(msg, peer_wire))
         except wire.WireError:
             bufs = None
         if bufs is not None:
@@ -168,9 +171,17 @@ class RpcServer:
                 msg, was_binary = frame
                 if was_binary:
                     # Observed capability: this peer talks binary, so
-                    # responses/pushes to it may too.
-                    conn.meta["wire"] = wire.WIRE_VERSION
+                    # responses/pushes to it may too — but only v1 frames
+                    # are PROVEN; higher versions must be advertised.
+                    if not conn.meta.get("wire"):
+                        conn.meta["wire"] = 1
                 mtype = msg.get("type")
+                if mtype == "__hello__":
+                    # Connection-level capability advertisement (sent once
+                    # by RpcClient on connect): the peer can DECODE this
+                    # wire version, so responses/pushes may use its frames.
+                    conn.meta["wire"] = int(msg.get("wire") or 1)
+                    continue
                 handler = self._handlers.get(mtype)
                 if handler is None:
                     resp = {"ok": False, "error": f"unknown type {mtype}"}
@@ -230,8 +241,9 @@ class Connection:
         """Push/respond on this connection. Binary fast-path encoding is
         used when the peer has advertised or shown wire capability
         (``meta["wire"]``); ``req_type`` selects a response codec."""
-        bufs = encode_frames(msg, binary_ok=bool(self.meta.get("wire")),
-                             req_type=req_type)
+        peer = int(self.meta.get("wire") or 0)
+        bufs = encode_frames(msg, binary_ok=bool(peer), req_type=req_type,
+                             peer_wire=peer or 1)
         async with self._wlock:
             self.writer.writelines(bufs)
             await self.writer.drain()
@@ -243,7 +255,9 @@ class Connection:
         the await-per-send of the locked path was pure overhead there.
         writelines() is atomic into the transport buffer, so interleaving
         with concurrent send() calls is safe."""
-        bufs = encode_frames(msg, binary_ok=bool(self.meta.get("wire")))
+        peer = int(self.meta.get("wire") or 0)
+        bufs = encode_frames(msg, binary_ok=bool(peer),
+                             peer_wire=peer or 1)
         self.writer.writelines(bufs)
 
 
@@ -286,6 +300,20 @@ class RpcClient:
         self._counter = itertools.count(1)
         self._push_handler = push_handler
         self._closed = False
+        # The highest wire version the SERVER side of this connection can
+        # parse: conservative v1 until a handshake (register_* response)
+        # reports better — v2-only frames fall back to pickle until then.
+        self.peer_wire = 1
+        # Advertise our own decode capability so server->client pushes and
+        # responses may use this wire version's frames (decode support is
+        # unconditional, so this holds even for pickle-pinned senders).
+        try:
+            with self._wlock:
+                self._send_buffers(
+                    [_dumps({"type": "__hello__",
+                             "wire": wire.WIRE_VERSION})], 1)
+        except OSError:
+            pass
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -362,7 +390,8 @@ class RpcClient:
         msg = dict(msg, rpc_id=rpc_id)
         ev = threading.Event()
         self._pending[rpc_id] = ev
-        bufs = encode_frames(msg, binary_ok=self._binary)
+        bufs = encode_frames(msg, binary_ok=self._binary,
+                             peer_wire=self.peer_wire)
         with self._wlock:
             self._send_buffers(bufs, 1)
         if not ev.wait(timeout):
@@ -382,7 +411,8 @@ class RpcClient:
     def send_oneway(self, msg: Dict[str, Any]) -> None:
         if self._closed:
             raise ConnectionError(f"connection to {self.addr} closed")
-        bufs = encode_frames(msg, binary_ok=self._binary)
+        bufs = encode_frames(msg, binary_ok=self._binary,
+                             peer_wire=self.peer_wire)
         with self._wlock:
             self._send_buffers(bufs, 1)
 
@@ -396,7 +426,8 @@ class RpcClient:
             raise ConnectionError(f"connection to {self.addr} closed")
         bufs: List[bytes] = []
         for msg in msgs:
-            bufs.extend(encode_frames(msg, binary_ok=self._binary))
+            bufs.extend(encode_frames(msg, binary_ok=self._binary,
+                                      peer_wire=self.peer_wire))
         with self._wlock:
             self._send_buffers(bufs, len(msgs))
 

@@ -50,7 +50,11 @@ import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 MAGIC = 0xBF
-WIRE_VERSION = 1
+# v2 adds the inline-result frames (TASK_DONE2 / TASK_DONE_BATCH2 and the
+# _LOC_INLINE location flag). Senders emit them only to peers that
+# advertised wire >= 2; everything else still goes out as v1 frames or
+# pickle, so v1/pickle-only peers interoperate per-message.
+WIRE_VERSION = 2
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -65,6 +69,10 @@ OBJECT_ADDED = 0x08
 ASSIGN_BATCH = 0x09
 EXECUTE_TASK = 0x0A
 TASK_DONE = 0x0B
+# v2 twins of the completion frames: each "added" registration item may
+# carry the serialized result inline (the small-result data plane).
+TASK_DONE2 = 0x0C
+TASK_DONE_BATCH2 = 0x0D
 
 # Task-spec versions. v1 is the base header; v2 appends a trace context
 # (sampled tasks only — unsampled specs still encode as v1, so the hot
@@ -317,7 +325,7 @@ def _head(code: int, rpc_id) -> bytes:
     return struct.pack("<BBQ", MAGIC, code, int(rpc_id or 0))
 
 
-def _enc_submit_batch(msg) -> List[bytes]:
+def _enc_submit_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     tasks = msg["tasks"]
     out = [_head(SUBMIT_BATCH, msg.get("rpc_id")), _U32.pack(len(tasks))]
     for t in tasks:
@@ -336,7 +344,7 @@ def _dec_submit_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"type": "submit_batch", "tasks": tasks, "rpc_id": rpc_id}
 
 
-def _enc_submit_batch_resp(msg) -> List[bytes]:
+def _enc_submit_batch_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     return [_head(SUBMIT_BATCH_RESP, msg.get("rpc_id")),
             _U32.pack(int(msg.get("count", 0)))]
 
@@ -347,41 +355,89 @@ def _dec_submit_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "count": count, "rpc_id": rpc_id}
 
 
-def _enc_task_done_batch(msg) -> List[bytes]:
+def _added_has_blob(added) -> bool:
+    return any(len(ent) > 2 and ent[2] is not None for ent in added)
+
+
+def _enc_added_v1(out: List[bytes], added) -> None:
+    out.append(_U16.pack(len(added)))
+    for ent in added:
+        out.append(_b8(ent[0]))
+        out.append(_U64.pack(int(ent[1])))
+
+
+def _enc_added_v2(out: List[bytes], added) -> None:
+    """v2 added item: oid, size, has-blob flag, optional inline result."""
+    out.append(_U16.pack(len(added)))
+    for ent in added:
+        out.append(_b8(ent[0]))
+        out.append(_U64.pack(int(ent[1])))
+        blob = ent[2] if len(ent) > 2 else None
+        if blob is None:
+            out.append(_U8.pack(0))
+        else:
+            out.append(_U8.pack(1))
+            out.append(_U32.pack(len(blob)))
+            out.append(blob)    # pass-through buffer: no copy on encode
+
+
+def _dec_added_v1(r: _Reader) -> list:
+    n = r.count(r.u16())
+    return [[r.b8(), r.u64()] for _ in range(n)]
+
+
+def _dec_added_v2(r: _Reader) -> list:
+    n = r.count(r.u16())
+    out = []
+    for _ in range(n):
+        oid = r.b8()
+        size = r.u64()
+        blob = r.b32() if r.u8() else None
+        out.append([oid, size, blob])
+    return out
+
+
+def _enc_task_done_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     items = msg["items"]
-    out = [_head(TASK_DONE_BATCH, msg.get("rpc_id")), _s(msg["node_id"]),
+    v2 = any(_added_has_blob(it.get("added") or ()) for it in items)
+    if v2 and peer_wire < 2:
+        return None  # v1 peer can't parse inline items: pickle carries it
+    code = TASK_DONE_BATCH2 if v2 else TASK_DONE_BATCH
+    out = [_head(code, msg.get("rpc_id")), _s(msg["node_id"]),
            _U32.pack(len(items))]
+    enc_added = _enc_added_v2 if v2 else _enc_added_v1
     for it in items:
         out.append(_b8(it.get("task_id") or b""))
         out.append(_resources(it.get("resources") or {}))
         out.append(_F32.pack(float(it.get("exec_s", 0.0))))
         out.append(_F32.pack(float(it.get("reg_s", 0.0))))
-        added = it.get("added") or ()
-        out.append(_U16.pack(len(added)))
-        for oid, size in added:
-            out.append(_b8(oid))
-            out.append(_U64.pack(int(size)))
+        enc_added(out, it.get("added") or ())
     return out
 
 
-def _dec_task_done_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
+def _dec_task_done_batch(r: _Reader, rpc_id, v2: bool = False
+                         ) -> Dict[str, Any]:
     node_id = r.s()
     n = r.count(r.u32())
+    dec_added = _dec_added_v2 if v2 else _dec_added_v1
     items = []
     for _ in range(n):
         tid = r.b8()
         item = {"task_id": tid or None,
                 "resources": _read_resources(r),
                 "exec_s": r.f32(), "reg_s": r.f32()}
-        n_added = r.count(r.u16())
-        item["added"] = [[r.b8(), r.u64()] for _ in range(n_added)]
+        item["added"] = dec_added(r)
         items.append(item)
     r.done()
     return {"type": "task_done_batch", "node_id": node_id, "items": items,
             "rpc_id": rpc_id}
 
 
-def _enc_locations_batch(msg) -> List[bytes]:
+def _dec_task_done_batch2(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_task_done_batch(r, rpc_id, v2=True)
+
+
+def _enc_locations_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     oids = msg["object_ids"]
     out = [_head(LOCATIONS_BATCH, msg.get("rpc_id")),
            _F64.pack(float(msg.get("wait_s") or 0.0)),
@@ -406,10 +462,15 @@ def _dec_locations_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
 
 _LOC_ERROR = 1
 _LOC_SPILLED = 2
+_LOC_INLINE = 4
 
 
-def _enc_locations_batch_resp(msg) -> List[bytes]:
+def _enc_locations_batch_resp(msg, peer_wire: int = WIRE_VERSION
+                              ) -> List[bytes]:
     objects = msg.get("objects", {})
+    if peer_wire < 2 and any(info.get("inline_blob") is not None
+                             for info in objects.values()):
+        return None  # v1 peer can't parse _LOC_INLINE: pickle carries it
     out = [_head(LOCATIONS_BATCH_RESP, msg.get("rpc_id")),
            _U32.pack(len(objects))]
     for oid, info in objects.items():
@@ -417,6 +478,14 @@ def _enc_locations_batch_resp(msg) -> List[bytes]:
         blob = info.get("error_blob")
         if blob is not None:
             out.append(_U8.pack(_LOC_ERROR))
+            out.append(_U64.pack(len(blob)))
+            out.append(blob)
+            continue
+        blob = info.get("inline_blob")
+        if blob is not None:
+            # Inline small result: the bytes ride the completion push —
+            # the caller needs no address and no fetch RPC at all.
+            out.append(_U8.pack(_LOC_INLINE))
             out.append(_U64.pack(len(blob)))
             out.append(blob)
             continue
@@ -442,6 +511,9 @@ def _dec_locations_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
         if flags & _LOC_ERROR:
             objects[oid] = {"error_blob": r.b64()}
             continue
+        if flags & _LOC_INLINE:
+            objects[oid] = {"inline_blob": r.b64()}
+            continue
         n_addr = r.u8()
         addrs, transfer = [], []
         for _ in range(n_addr):
@@ -455,7 +527,7 @@ def _dec_locations_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "objects": objects, "rpc_id": rpc_id}
 
 
-def _enc_fetch_batch(msg) -> List[bytes]:
+def _enc_fetch_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     oids = msg["object_ids"]
     out = [_head(FETCH_BATCH, msg.get("rpc_id")), _U32.pack(len(oids))]
     for oid in oids:
@@ -469,7 +541,7 @@ def _dec_fetch_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"type": "fetch_batch", "object_ids": oids, "rpc_id": rpc_id}
 
 
-def _enc_fetch_batch_resp(msg) -> List[bytes]:
+def _enc_fetch_batch_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     blobs = msg.get("blobs", {})
     out = [_head(FETCH_BATCH_RESP, msg.get("rpc_id")), _U32.pack(len(blobs))]
     for oid, blob in blobs.items():
@@ -489,7 +561,7 @@ def _dec_fetch_batch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "blobs": blobs, "rpc_id": rpc_id}
 
 
-def _enc_object_added(msg) -> List[bytes]:
+def _enc_object_added(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     return [_head(OBJECT_ADDED, msg.get("rpc_id")),
             _b8(msg["object_id"]), _U64.pack(int(msg.get("size", 0)))]
 
@@ -502,7 +574,7 @@ def _dec_object_added(r: _Reader, rpc_id) -> Dict[str, Any]:
             "rpc_id": rpc_id}
 
 
-def _enc_assign_batch(msg) -> List[bytes]:
+def _enc_assign_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     tasks = msg["tasks"]
     blobs = []
     for t in tasks:
@@ -524,7 +596,7 @@ def _dec_assign_batch(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"type": "assign_batch", "tasks": tasks, "rpc_id": rpc_id}
 
 
-def _enc_execute_task(msg) -> Optional[List[bytes]]:
+def _enc_execute_task(msg, peer_wire: int = WIRE_VERSION) -> Optional[List[bytes]]:
     blob = msg.get("_spec")
     if blob is None:
         return None
@@ -543,31 +615,34 @@ def _dec_execute_task(r: _Reader, rpc_id) -> Dict[str, Any]:
     return out
 
 
-def _enc_task_done(msg) -> List[bytes]:
+def _enc_task_done(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     added = msg.get("added", ())
-    out = [_head(TASK_DONE, msg.get("rpc_id")),
+    v2 = _added_has_blob(added)
+    if v2 and peer_wire < 2:
+        return None  # v1 peer can't parse inline items: pickle carries it
+    out = [_head(TASK_DONE2 if v2 else TASK_DONE, msg.get("rpc_id")),
            _U32.pack(int(msg.get("pid", 0))),
-           _oids(msg.get("return_ids", ())),
-           _U16.pack(len(added))]
-    for oid, size in added:
-        out.append(_b8(oid))
-        out.append(_U64.pack(int(size)))
+           _oids(msg.get("return_ids", ()))]
+    (_enc_added_v2 if v2 else _enc_added_v1)(out, added)
     out.append(_F32.pack(float(msg.get("exec_s", 0.0))))
     out.append(_F32.pack(float(msg.get("reg_s", 0.0))))
     return out
 
 
-def _dec_task_done(r: _Reader, rpc_id) -> Dict[str, Any]:
+def _dec_task_done(r: _Reader, rpc_id, v2: bool = False) -> Dict[str, Any]:
     pid = r.u32()
     return_ids = _read_oids(r)
-    n = r.count(r.u16())
-    added = [[r.b8(), r.u64()] for _ in range(n)]
+    added = (_dec_added_v2 if v2 else _dec_added_v1)(r)
     exec_s = r.f32()
     reg_s = r.f32()
     r.done()
     return {"type": "task_done", "pid": pid, "return_ids": return_ids,
             "added": added, "exec_s": exec_s, "reg_s": reg_s,
             "rpc_id": rpc_id}
+
+
+def _dec_task_done2(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_task_done(r, rpc_id, v2=True)
 
 
 # Request/push encoders keyed by message "type".
@@ -601,20 +676,26 @@ _DECODERS = {
     ASSIGN_BATCH: _dec_assign_batch,
     EXECUTE_TASK: _dec_execute_task,
     TASK_DONE: _dec_task_done,
+    TASK_DONE2: _dec_task_done2,
+    TASK_DONE_BATCH2: _dec_task_done_batch2,
 }
 
 
-def encode(msg: Dict[str, Any]) -> Optional[List[bytes]]:
+def encode(msg: Dict[str, Any],
+           peer_wire: int = WIRE_VERSION) -> Optional[List[bytes]]:
     """Binary-encode a request/push message; None when the type has no
-    fast-path codec (caller falls back to pickle)."""
+    fast-path codec (caller falls back to pickle). ``peer_wire`` is the
+    receiver's advertised wire version: messages that would need a frame
+    the peer cannot parse (e.g. inline-result items to a v1 peer) return
+    None so the universally-decodable pickle body carries them."""
     enc = _ENCODERS.get(msg.get("type"))
     if enc is None:
         return None
-    return enc(msg)
+    return enc(msg, peer_wire)
 
 
-def encode_response(req_type: str, msg: Dict[str, Any]
-                    ) -> Optional[List[bytes]]:
+def encode_response(req_type: str, msg: Dict[str, Any],
+                    peer_wire: int = WIRE_VERSION) -> Optional[List[bytes]]:
     """Binary-encode a response to ``req_type``; only ok-responses have a
     binary form (error dicts carry tracebacks and stay pickled)."""
     if msg.get("ok") is False:
@@ -622,7 +703,7 @@ def encode_response(req_type: str, msg: Dict[str, Any]
     enc = _RESP_ENCODERS.get(req_type)
     if enc is None:
         return None
-    return enc(msg)
+    return enc(msg, peer_wire)
 
 
 def is_binary(body) -> bool:

@@ -36,6 +36,7 @@ def main():
     chost, cport = args.controller.rsplit(":", 1)
     ghost, gport = args.gcs.rsplit(":", 1)
 
+    from ray_tpu._native import completion_ring as cring
     from ray_tpu._native import open_store
     from ray_tpu._private.serialization import get_context
     from ray_tpu.cluster import wire
@@ -102,8 +103,14 @@ def main():
     worker.mode = "worker"
     worker.connected = True
 
-    controller.call({"type": "register_worker", "pid": os.getpid(),
-                     "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION})
+    reg = controller.call(
+        {"type": "register_worker", "pid": os.getpid(),
+         "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION})
+    # The controller's advertised wire version gates the v2 inline-result
+    # frames on the task_done path (a v1 controller gets pickle instead).
+    peer_wire = int(reg.get("wire") or 1)
+    controller.peer_wire = peer_wire
+    core._controller((chost, int(cport))).peer_wire = peer_wire
 
     # Periodic profile-span flush to the GCS (reference: profiling.cc's
     # batched AddProfileData timer).
@@ -194,13 +201,31 @@ def main():
     _phase_times: Dict[int, list] = {}
 
     def _store_blob(oid: bytes, blob: bytes) -> None:
-        """Arena write with DEFERRED registration (falls back to the
-        immediate path when the arena is unavailable/full — or over the
-        spill high watermark, where the controller route spills cold
-        objects to disk instead of the native evictor dropping them)."""
+        """Result store on the new data plane (see ARCHITECTURE.md
+        "Result data plane"):
+
+        * **inline** — results at or under RAY_TPU_INLINE_RESULT_MAX ride
+          inside the owner's completion-ring record AND inside this task's
+          task_done "added" item, so they never touch an arena slot or a
+          fetch RPC: the same-host owner pops them from its ring; everyone
+          else gets the bytes carried through the GCS directory;
+        * **arena** — bigger results keep the zero-copy arena write with
+          DEFERRED registration, plus a slot record into the owner's ring
+          (same-host owners then read the arena without scanning it);
+        * **RPC** — arena unavailable/full (or over the spill high
+          watermark, where the controller route spills cold objects to
+          disk instead of the native evictor dropping them).
+        """
+        if 0 < len(blob) <= cring.inline_result_max() \
+                and cring.ring_enabled():
+            core.publish_completion(oid, len(blob), inline=blob)
+            _pending_adds.setdefault(
+                threading.get_ident(), []).append([oid, len(blob), blob])
+            return
         if core.local_store is not None and core.arena_admits(len(blob)):
             try:
                 core.local_store.put(oid, blob)
+                core.publish_completion(oid, len(blob))
                 _pending_adds.setdefault(
                     threading.get_ident(), []).append([oid, len(blob)])
                 return

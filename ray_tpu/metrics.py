@@ -140,6 +140,27 @@ def get_or_create(cls, name: str, **kwargs) -> "Metric":
     return cls(name, **kwargs)
 
 
+def result_plane_metrics() -> Dict[str, "Metric"]:
+    """Counters for the same-host result data plane (completion ring +
+    inline small results): how results reached their owner, serialized
+    bytes that skipped the arena, and torn-record ring degradations.
+    Lazily registered; ``get_or_create`` makes re-entry idempotent."""
+    return {
+        "records": get_or_create(
+            Count, "result_plane_records", tag_keys=("via",),
+            description="results delivered per path (ring / inline / "
+                        "inline_push / fetch_rpc)"),
+        "inline_bytes": get_or_create(
+            Count, "result_inline_bytes",
+            description="serialized result bytes that rode inline in "
+                        "completion records instead of arena slots"),
+        "ring_torn": get_or_create(
+            Count, "result_ring_torn_records",
+            description="torn completion records detected (ring degraded "
+                        "to the RPC path)"),
+    }
+
+
 def collect_all() -> Dict[str, Dict]:
     """Snapshot every registered metric (the dashboard's /api/metrics)."""
     with _LOCK:

@@ -68,6 +68,13 @@ def _phase_snapshot(core) -> dict:
     return out
 
 
+# Result data-plane delivery counters (driver cells): how each result
+# reached its owner — completion-ring pop, inline in the ring record,
+# inline pushed with the directory answer, or a fetch RPC.
+_RESULT_PATHS = ("result:ring", "result:inline", "result:inline_push",
+                 "result:fetch_rpc")
+
+
 def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
     """Per-1k-task milliseconds spent in each phase over the window."""
     out = {}
@@ -76,7 +83,7 @@ def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
         c1, s1 = after.get(name, [0, 0.0])
         dc, ds = c1 - c0, s1 - s0
         out[name] = round(ds / dc * 1e6, 3) if dc > 0 else None
-    for key in ("relay:opaque", "relay:pickled"):
+    for key in ("relay:opaque", "relay:pickled", *_RESULT_PATHS):
         out[key.replace(":", "_")] = (after.get(key, [0, 0.0])[0]
                                       - before.get(key, [0, 0.0])[0])
     return out
@@ -392,7 +399,20 @@ def main():
             phases[name] = statistics.median(vals)
         phases["relay_pickled"] = max(
             r["phases_ms_per_1k"].get("relay_pickled", 0) for r in runs)
+        for key in _RESULT_PATHS:
+            k = key.replace(":", "_")
+            phases[k] = statistics.median(
+                sorted(r["phases_ms_per_1k"].get(k, 0) for r in runs))
         out["phases_ms_per_1k"] = phases
+        # Per-run phase tables (previously only printed to stderr): the
+        # machine-readable phase trajectory across rounds — each run's
+        # warm throughput next to its full ms/1k-task breakdown.
+        out["per_run"] = [
+            {"batch_warm_tasks_per_sec": r["batch_warm_tasks_per_sec"],
+             "batch_tasks_per_sec": r["batch_tasks_per_sec"],
+             "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
+             "phases_ms_per_1k": r["phases_ms_per_1k"]}
+            for r in runs]
     if args.sim_nodes:
         rows = []
         for n in (int(x) for x in args.sim_nodes.split(",") if x):

@@ -283,3 +283,41 @@ def test_cancel_finished_task_is_noop(cluster):
     assert ray_tpu.get(ref, timeout=60) == 42
     ray_tpu.cancel(ref)
     assert ray_tpu.get(ref, timeout=60) == 42
+
+
+def test_torn_completion_record_falls_back_to_rpc_path(cluster):
+    """Worker death mid-publish leaves a torn record (simulated via the
+    commit-word test hook): the owner's ring degrades and every
+    subsequent result must still arrive exactly once through the
+    RPC/directory path — no hang, no duplicate delivery."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker().core
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    # Warm: ring live, publishers attached.
+    assert ray_tpu.get([sq.remote(i) for i in range(10)], timeout=60) \
+        == [i * i for i in range(10)]
+    ring = core._ring
+    assert ring and not ring.degraded
+
+    # Inject what a publisher dying mid-write of a reserve-first protocol
+    # would leave: a visible record with a corrupt commit word.
+    ring._debug_publish_torn()
+
+    # In-flight refs submitted BEFORE the harvest trips on the torn
+    # record, plus a batch after: all must resolve, exactly once each.
+    refs = [sq.remote(i) for i in range(30)]
+    assert ray_tpu.get(refs, timeout=90) == [i * i for i in range(30)]
+    assert ring.degraded and ring.torn_records >= 1
+    assert not core._ring_active()
+
+    # Degraded ring: later batches ride the directory path end-to-end.
+    assert ray_tpu.get([sq.remote(i) for i in range(40)], timeout=90) \
+        == [i * i for i in range(40)]
+    # No duplicate delivery: a second get() of the SAME refs returns the
+    # same values (results are immutable and still resolvable).
+    assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(30)]

@@ -1,0 +1,220 @@
+"""Completion-ring unit tests (ray_tpu/_native/completion_ring.py).
+
+The ring is the same-host result data plane: workers publish fixed-size
+completion records (optionally carrying the serialized result inline)
+into the owning driver's shm ring, and the owner's get() harvest becomes
+O(completions-this-wave) ring pops. Covers the PR acceptance set:
+wraparound, full-ring backpressure (the publisher falls back, never
+blocks), records straddling the wrap point, mixed inline/slot records,
+torn-record degradation, and the kill switch / inline-threshold knobs.
+"""
+
+import os
+import struct
+import uuid
+
+import pytest
+
+from ray_tpu._native import completion_ring as cring
+
+
+def _name():
+    return f"rtcr-test-{uuid.uuid4().hex[:12]}"
+
+
+def _oid(i: int) -> bytes:
+    return i.to_bytes(4, "little") + os.urandom(4) + bytes(16)
+
+
+@pytest.fixture
+def ring():
+    r = cring.CompletionRing(_name(), capacity=4096, create=True)
+    yield r
+    r.close()
+
+
+@pytest.fixture
+def pub(ring):
+    p = cring.RingPublisher(ring.name)
+    yield p
+    p.close()
+
+
+class TestBasic:
+    def test_publish_pop_round_trip(self, ring, pub):
+        oid = _oid(1)
+        assert pub.publish(oid, 128) is True
+        recs = ring.pop_all()
+        assert recs == [(oid, 0, 128, None)]
+        assert ring.pop_all() == []  # drained
+
+    def test_inline_record_carries_payload(self, ring, pub):
+        oid = _oid(2)
+        blob = b"x" * 300
+        assert pub.publish(oid, len(blob), inline=blob) is True
+        ((roid, flags, size, inline),) = ring.pop_all()
+        assert roid == oid
+        assert flags & cring.FLAG_INLINE
+        assert size == 300
+        assert inline == blob
+
+    def test_mixed_inline_and_slot_records(self, ring, pub):
+        oids = [_oid(i) for i in range(8)]
+        for i, oid in enumerate(oids):
+            if i % 2:
+                assert pub.publish(oid, 64 + i, inline=b"v" * (64 + i))
+            else:
+                assert pub.publish(oid, 1 << 20)  # arena-slot record
+        recs = ring.pop_all()
+        assert [r[0] for r in recs] == oids  # FIFO order preserved
+        for i, (oid, flags, size, inline) in enumerate(recs):
+            if i % 2:
+                assert flags & cring.FLAG_INLINE and inline == b"v" * size
+            else:
+                assert flags == 0 and inline is None and size == 1 << 20
+
+    def test_open_publisher_absent_ring_returns_none(self):
+        assert cring.open_publisher(_name()) is None
+
+
+class TestWraparound:
+    def test_many_cycles_wrap_the_ring(self, ring, pub):
+        """Publish/drain far more bytes than the capacity: records keep
+        round-tripping intact across many wrap points."""
+        total = 0
+        i = 0
+        while total < ring.capacity * 5:
+            oid = _oid(i)
+            blob = bytes([i % 251]) * (50 + (i * 37) % 200)
+            assert pub.publish(oid, len(blob), inline=blob) is True
+            ((roid, flags, size, inline),) = ring.pop_all()
+            assert roid == oid and inline == blob
+            total += len(blob)
+            i += 1
+        assert i > 20
+
+    def test_record_straddles_wrap_point(self, ring, pub):
+        """Park the head just shy of the capacity boundary, then publish a
+        record bigger than the remaining contiguous span — its bytes wrap
+        and the pop reassembles them."""
+        pad = b"p" * 100
+        # Advance head (publish+drain) until fewer contiguous bytes remain
+        # before the capacity boundary than the next record needs.
+        while ring.capacity - (pub._u64(16) % ring.capacity) > 160:
+            assert pub.publish(_oid(0), len(pad), inline=pad)
+            ring.pop_all()
+        head = pub._u64(16)
+        assert 0 < ring.capacity - (head % ring.capacity) <= 160
+        blob = b"w" * 500  # record straddles the boundary
+        oid = _oid(99)
+        assert pub.publish(oid, len(blob), inline=blob) is True
+        assert pub._u64(16) % ring.capacity < head % ring.capacity  # wrapped
+        ((roid, _fl, _sz, inline),) = ring.pop_all()
+        assert roid == oid and inline == blob
+
+
+class TestBackpressure:
+    def test_full_ring_publish_returns_false_never_blocks(self, ring, pub):
+        blob = b"f" * 200
+        published = 0
+        for i in range(200):  # 200 * ~250B >> 4096B capacity
+            if not pub.publish(_oid(i), len(blob), inline=blob):
+                break
+            published += 1
+        else:
+            pytest.fail("ring never reported full")
+        assert 0 < published < 200
+        # Drain; space opens; publishing works again.
+        assert len(ring.pop_all()) == published
+        assert pub.publish(_oid(999), len(blob), inline=blob) is True
+
+    def test_oversized_record_refused(self, ring, pub):
+        big = b"B" * (ring.capacity // 2)
+        assert pub.publish(_oid(0), len(big), inline=big) is False
+        assert ring.pop_all() == []
+
+
+class TestCrashSafety:
+    def test_torn_record_degrades_ring(self, ring, pub):
+        ok_oid = _oid(1)
+        assert pub.publish(ok_oid, 7)
+        ring._debug_publish_torn()
+        assert pub.publish(_oid(2), 9)  # behind the torn record
+        recs = ring.pop_all()
+        # Everything before the torn record is delivered; the torn record
+        # stops the harvest and flips the degraded flag.
+        assert [r[0] for r in recs] == [ok_oid]
+        assert ring.degraded
+        assert ring.torn_records == 1
+        assert ring.pop_all() == []  # degraded: no further harvests
+        # Publishers observe the degraded flag and stop appending.
+        assert pub.publish(_oid(3), 11) is False
+
+    def test_consumer_restart_rejects_stale_garbage(self, ring):
+        # Corrupt the magic: a reopen (attach) must refuse the segment.
+        with open(ring.path, "r+b") as f:
+            f.write(struct.pack("<I", 0x0BADF00D))
+        with pytest.raises(OSError):
+            cring.CompletionRing(ring.name, create=False)
+
+
+class TestKnobs:
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_COMPLETION_RING", "0")
+        assert not cring.ring_enabled()
+        monkeypatch.setenv("RAY_TPU_COMPLETION_RING", "1")
+        assert cring.ring_enabled()
+        monkeypatch.delenv("RAY_TPU_COMPLETION_RING")
+        assert cring.ring_enabled()  # default on
+
+    def test_inline_threshold_env(self, monkeypatch):
+        monkeypatch.delenv("RAY_TPU_INLINE_RESULT_MAX", raising=False)
+        assert cring.inline_result_max() == 4096
+        monkeypatch.setenv("RAY_TPU_INLINE_RESULT_MAX", "512")
+        assert cring.inline_result_max() == 512
+        monkeypatch.setenv("RAY_TPU_INLINE_RESULT_MAX", "0")
+        assert cring.inline_result_max() == 0
+        monkeypatch.setenv("RAY_TPU_INLINE_RESULT_MAX", "junk")
+        assert cring.inline_result_max() == 4096
+
+    def test_ring_name_derivation(self):
+        job = bytes.fromhex("a1b2c3d4")
+        assert cring.ring_name(job) == "rtcr-a1b2c3d4"
+        # An executing worker derives the owner's ring from the oid alone.
+        oid = bytes(12) + job + bytes(8)
+        assert cring.ring_name(oid[12:16]) == "rtcr-a1b2c3d4"
+
+    def test_owner_close_unlinks_segment(self):
+        r = cring.CompletionRing(_name(), capacity=1024, create=True)
+        path = r.path
+        assert os.path.exists(path)
+        r.close()
+        assert not os.path.exists(path)
+
+
+class TestStaleSweep:
+    def test_sweep_removes_dead_owner_ring_keeps_live(self):
+        import subprocess
+        import sys
+
+        live = cring.CompletionRing(_name(), capacity=1024, create=True)
+        # A ring whose owner is ALREADY GONE: create it in a child process
+        # that dies without close() (the SIGKILLed-worker leak).
+        dead_name = _name()
+        subprocess.run(
+            [sys.executable, "-c",
+             "import os, sys; sys.path.insert(0, os.getcwd());"
+             "from ray_tpu._native import completion_ring as cring;"
+             f"cring.CompletionRing({dead_name!r}, capacity=1024);"
+             "os._exit(0)"],  # skips atexit: simulates SIGKILL
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            check=True, timeout=60)
+        assert os.path.exists(cring.ring_path(dead_name))
+        try:
+            removed = cring.sweep_stale_rings()
+            assert removed >= 1
+            assert not os.path.exists(cring.ring_path(dead_name))
+            assert os.path.exists(live.path)  # flock held: untouched
+            assert cring.open_publisher(live.name) is not None
+        finally:
+            live.close()

@@ -148,6 +148,61 @@ def test_phase_profiler_covers_all_seven_phases(driver):
         assert handlers[name]["count"] > 0, name
 
 
+def test_result_plane_zero_fetch_batch(driver):
+    """The result data plane (PR 4): a warm same-host 500-task batch
+    delivers EVERY result through the completion ring / inline path —
+    zero fetch_batch RPCs, zero fetch-RPC deliveries — and the dispatch
+    relay stays opaque."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker().core
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    # Warm OUTSIDE the window (worker spawn, fn export, lease, ring probe).
+    assert ray_tpu.get([one.remote() for _ in range(20)], timeout=60) \
+        == [1] * 20
+    time.sleep(0.3)  # drain the warmup's coalesced completion batches
+
+    def _result_counts():
+        return {k: core.phase_stats.get(f"result:{k}", [0, 0.0])[0]
+                for k in ("ring", "inline", "inline_push", "fetch_rpc")}
+
+    def _ctrl_fetch_batch():
+        stats = core._controller(core._home_addr).call({"type": "stats"})
+        cell = stats.get("handler_stats", {}).get("fetch_batch")
+        return cell[0] if cell else 0
+
+    assert core._ring_active(), "driver completion ring should be live"
+    fetch0 = _ctrl_fetch_batch()
+    res0 = _result_counts()
+    h0 = _gcs_handlers(core)
+
+    n = 500
+    assert ray_tpu.get([one.remote() for _ in range(n)], timeout=120) \
+        == [1] * n
+
+    res1 = _result_counts()
+    # THE invariant: the same-host warm batch performed no fetch_batch
+    # RPC anywhere — neither as an RPC into the node controller nor as a
+    # fetch-RPC-delivered result on the driver.
+    assert _ctrl_fetch_batch() - fetch0 == 0
+    assert res1["fetch_rpc"] - res0["fetch_rpc"] == 0
+    # Every result rode the new data plane (ring pop, inline record, or
+    # inline push with the directory answer). >= n: a ring record whose
+    # oid already resolved via inline_push is still popped and counted.
+    delivered = sum(res1[k] - res0[k]
+                    for k in ("ring", "inline", "inline_push"))
+    assert delivered >= n, (res0, res1)
+    assert res1["inline"] - res0["inline"] > 0, "ring carried no records"
+    # And the PR-2 relay invariant still holds alongside the new frames.
+    h1 = _gcs_handlers(core)
+    assert _cell(h1, "relay:pickled")["count"] \
+        == _cell(h0, "relay:pickled")["count"] == 0
+
+
 def test_pickle_only_driver_interoperates(cluster):
     """Codec compat E2E: a pickle-pinned driver (the 'old peer') runs real
     tasks against a binary-capable cluster on the same sockets."""
@@ -263,3 +318,47 @@ def test_tracing_overhead_smoke(monkeypatch):
         f"tracing at the default sample rate cost "
         f"{(1 - on / off) * 100:.1f}% warm throughput "
         f"(off={off:.0f}/s on={on:.0f}/s, budget 5%)")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("ring_env", ["0", "1"])
+def test_completion_ring_fallback_smoke(ring_env, monkeypatch):
+    """The RAY_TPU_COMPLETION_RING=0 kill switch pins the pre-ring path;
+    both arms must run a real mixed-size cluster batch correctly so the
+    fallback cannot rot. Env is set BEFORE Cluster() so every spawned
+    controller/worker inherits the arm."""
+    from ray_tpu._private.worker import global_worker
+
+    monkeypatch.setenv("RAY_TPU_COMPLETION_RING", ring_env)
+    c = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        @ray_tpu.remote
+        def big(i):
+            return bytes([i % 251]) * 65536  # arena-slot regime (> inline)
+
+        ray_tpu.get([sq.remote(i) for i in range(20)], timeout=60)
+        assert ray_tpu.get([sq.remote(i) for i in range(300)], timeout=120) \
+            == [i * i for i in range(300)]
+        blobs = ray_tpu.get([big.remote(i) for i in range(8)], timeout=120)
+        assert blobs == [bytes([i % 251]) * 65536 for i in range(8)]
+        # A tiny follow-up get forces one more ring harvest so straggling
+        # slot records are popped before the counters are read.
+        assert ray_tpu.get(sq.remote(9), timeout=60) == 81
+
+        core = global_worker().core
+        plane = sum(core.phase_stats.get(f"result:{k}", [0, 0.0])[0]
+                    for k in ("ring", "inline"))
+        if ring_env == "0":
+            assert core._ring is None  # kill switch: never created
+            assert plane == 0, "ring path used with the kill switch on"
+        else:
+            assert core._ring_active()
+            assert plane > 0, "ring carried nothing on the enabled arm"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

@@ -347,3 +347,96 @@ class TestMixedWireConnection:
             assert msg["pong"] is True
         finally:
             sock.close()
+
+
+class TestInlineResultFrames:
+    """v2 inline-result frames (PR 4): TASK_DONE2 / TASK_DONE_BATCH2 carry
+    serialized small results inside "added" items; locations responses may
+    answer with the bytes themselves (_LOC_INLINE). v1 peers must get
+    pickle for exactly these messages and binary for everything else."""
+
+    def test_task_done_inline_round_trip(self):
+        added = [[b"R" * 24, 128, b"x" * 128],   # inline small result
+                 [b"S" * 24, 1 << 20]]           # arena-slot registration
+        out = _rt({"type": "task_done", "pid": 7, "return_ids": [b"R" * 24],
+                   "added": added, "exec_s": 0.5, "reg_s": 0.25})
+        assert out["pid"] == 7
+        # Mixed items decode as 3-lists: slot entries carry blob=None.
+        assert out["added"] == [[b"R" * 24, 128, b"x" * 128],
+                                [b"S" * 24, 1 << 20, None]]
+
+    def test_task_done_batch_inline_round_trip(self):
+        items = [{"task_id": b"T" * 16, "resources": {"CPU": 1.0},
+                  "exec_s": 0.1, "reg_s": 0.2,
+                  "added": [[b"A" * 24, 5, b"hello"]]},
+                 {"task_id": b"U" * 16, "resources": {},
+                  "exec_s": 0.0, "reg_s": 0.0,
+                  "added": [[b"B" * 24, 64]]}]
+        out = _rt({"type": "task_done_batch", "node_id": "n1",
+                   "items": items, "rpc_id": 9})
+        assert out["items"][0]["added"] == [[b"A" * 24, 5, b"hello"]]
+        assert out["items"][1]["added"] == [[b"B" * 24, 64, None]]
+
+    def test_blobless_messages_still_encode_v1_frames(self):
+        # Without inline blobs the v1 frame bytes are emitted (old code,
+        # same codes) — cross-version history stays byte-compatible.
+        msg = {"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+               "added": [[b"R" * 24, 16]], "exec_s": 0.0, "reg_s": 0.0}
+        body = b"".join(wire.encode(msg))
+        assert body[1] == wire.TASK_DONE  # not TASK_DONE2
+        assert b"".join(wire.encode(msg, peer_wire=1)) == body
+
+    def test_v1_peer_gets_pickle_fallback_for_inline_frames(self):
+        msg = {"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+               "added": [[b"R" * 24, 3, b"abc"]],
+               "exec_s": 0.0, "reg_s": 0.0}
+        assert wire.encode(msg, peer_wire=1) is None     # pickle carries it
+        assert wire.encode(msg) is not None              # v2 peer: binary
+        batch = {"type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {}, "exec_s": 0.0,
+             "reg_s": 0.0, "added": [[b"R" * 24, 3, b"abc"]]}]}
+        assert wire.encode(batch, peer_wire=1) is None
+        assert wire.encode(batch) is not None
+
+    def test_locations_response_inline_blob_round_trip(self):
+        oid = b"L" * 24
+        out = _rt({"ok": True, "rpc_id": 5, "objects": {
+            oid: {"inline_blob": b"tiny-result"},
+            b"M" * 24: {"addresses": [["127.0.0.1", 4001]],
+                        "transfer_addresses": [], "spilled": False},
+        }}, req_type="locations_batch")
+        assert out["objects"][oid] == {"inline_blob": b"tiny-result"}
+        assert out["objects"][b"M" * 24]["addresses"] == [["127.0.0.1", 4001]]
+
+    def test_locations_response_inline_v1_peer_pickles(self):
+        msg = {"ok": True, "objects": {b"L" * 24: {"inline_blob": b"x"}}}
+        assert wire.encode_response("locations_batch", msg,
+                                    peer_wire=1) is None
+        assert wire.encode_response("locations_batch", msg) is not None
+
+    def test_truncated_inline_frames_raise(self):
+        msgs = [
+            {"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+             "added": [[b"R" * 24, 3, b"abc"]], "exec_s": 0.0, "reg_s": 0.0},
+            {"type": "task_done_batch", "node_id": "n", "items": [
+                {"task_id": b"T" * 16, "resources": {}, "exec_s": 0.0,
+                 "reg_s": 0.0, "added": [[b"R" * 24, 9, b"blob-body"]]}]},
+        ]
+        for msg in msgs:
+            body = b"".join(wire.encode(msg))
+            for cut in range(0, len(body), max(1, len(body) // 17)):
+                with pytest.raises(wire.WireError):
+                    wire.decode(body[:cut])
+
+    def test_garbage_inline_bodies_raise(self):
+        rng = random.Random(12)
+        for code in (wire.TASK_DONE2, wire.TASK_DONE_BATCH2):
+            for _ in range(50):
+                body = bytes([wire.MAGIC, code]) + bytes(
+                    rng.getrandbits(8) for _ in range(rng.randint(8, 64)))
+                try:
+                    wire.decode(body)
+                except wire.WireError:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    pytest.fail(f"non-WireError escaped decode: {e!r}")
