@@ -32,12 +32,19 @@ from .exceptions import (  # noqa: F401
     ActorError,
     GetTimeoutError,
     ObjectLostError,
+    PlacementGroupError,
     RayTpuError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
 from .object_ref import ObjectRef  # noqa: F401
+from .placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
 from .remote_function import remote  # noqa: F401
 from .actor import Checkpointable, exit_actor  # noqa: F401
 from .profiling import profile  # noqa: F401
@@ -67,6 +74,10 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "timeline",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "PlacementGroup",
     "profile",
     "state",
     "exit_actor",
@@ -78,6 +89,7 @@ __all__ = [
     "ActorError",
     "ActorDiedError",
     "ObjectLostError",
+    "PlacementGroupError",
     "GetTimeoutError",
     "TaskCancelledError",
     "WorkerCrashedError",

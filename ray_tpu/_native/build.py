@@ -22,21 +22,26 @@ _cache: dict = {}
 
 def _san_mode() -> str:
     """Sanitizer build mode (reference: ci/asan_tests): RAY_TPU_NATIVE_SAN
-    = "asan" compiles the native libraries with ASAN+UBSAN (-O1 -g, own
-    .so names so sanitized and plain builds never share a cache slot).
-    dlopen'ing a sanitized .so requires the asan runtime preloaded — the
-    harness for that is scripts/native_san.py."""
+    = "asan" compiles the native libraries with ASAN+UBSAN, "tsan" with
+    ThreadSanitizer (-O1 -g either way, own .so names so sanitized and
+    plain builds never share a cache slot). dlopen'ing a sanitized .so
+    requires the matching runtime preloaded — the harness for both modes
+    is scripts/native_san.py."""
     return os.environ.get("RAY_TPU_NATIVE_SAN", "").lower()
 
 
 def _san_flags():
-    if _san_mode() == "asan":
+    mode = _san_mode()
+    if mode == "asan":
         return ["-fsanitize=address,undefined", "-g", "-O1"]
+    if mode == "tsan":
+        return ["-fsanitize=thread", "-g", "-O1"]
     return ["-O2"]
 
 
 def _san_suffix() -> str:
-    return ".asan" if _san_mode() == "asan" else ""
+    mode = _san_mode()
+    return f".{mode}" if mode in ("asan", "tsan") else ""
 
 
 def _needs_build(src: str, out: str) -> bool:

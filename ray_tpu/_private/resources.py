@@ -146,13 +146,92 @@ class NodeResources:
     def release(self, demand: ResourceSet) -> None:
         released = self.available.add(demand)
         # Clamp: a release should never exceed total (defensive vs. double
-        # release). New object, not in-place: ResourceSet caches its key().
+        # release). Custom keys no longer in total (a removed placement
+        # group's bundle resources, a deleted dynamic resource) are
+        # dropped — a late release must not resurrect them as phantom
+        # availability. New object, not in-place: ResourceSet caches key().
+        custom = {k: min(v, self.total.custom[k])
+                  for k, v in released.custom.items()
+                  if k in self.total.custom}
         self.available = ResourceSet(
             np.minimum(released.predefined, self.total.predefined),
-            released.custom)
+            custom)
 
     def __repr__(self):
         return f"NodeResources(total={self.total}, available={self.available})"
+
+
+# --------------------------------------------------------------------------
+# Placement-group resource naming (ray_tpu/placement_group.py).
+#
+# A created group's bundles materialize as CUSTOM resources on their nodes
+# (reference: the formatted ``CPU_group_0_<id>`` resources placement groups
+# create on raylets). Tasks targeting a bundle demand those names instead of
+# the base resources, so the ENTIRE existing machinery — kernel placement,
+# greedy placer, GCS accounting, controller local admission — schedules
+# them with zero special cases: only the bundle's node owns the name.
+# --------------------------------------------------------------------------
+
+PG_BUNDLE_MARKER = "bundle"        # synthetic per-bundle membership resource
+PG_BUNDLE_CAPACITY = 1000.0        # marker capacity per bundle (ref: 1000)
+PG_MARKER_DEMAND = 0.001           # marker slice a member task consumes
+_PG_SEP = "_group_"
+
+
+def pg_resource_name(base: str, pg_hex: str,
+                     bundle_index: Optional[int] = None) -> str:
+    """``CPU_group_3_<hex>`` (one bundle) or ``CPU_group_<hex>`` (wildcard:
+    any bundle of the group)."""
+    if bundle_index is None or bundle_index < 0:
+        return f"{base}{_PG_SEP}{pg_hex}"
+    return f"{base}{_PG_SEP}{bundle_index}_{pg_hex}"
+
+
+def parse_pg_resource(name: str) -> Optional[Tuple[str, Optional[int], str]]:
+    """(base, bundle_index|None, pg_hex) for a placement-group resource
+    name; None for ordinary resources."""
+    idx = name.rfind(_PG_SEP)
+    if idx <= 0:
+        return None
+    base, tail = name[:idx], name[idx + len(_PG_SEP):]
+    head, _, rest = tail.partition("_")
+    if rest and head.isdigit():
+        return base, int(head), rest
+    return (base, None, tail) if tail else None
+
+
+def translate_pg_demand(resources: Dict[str, float], pg_hex: str,
+                        bundle_index: int = -1) -> Dict[str, float]:
+    """Rewrite a task/actor demand to its in-group form: every base
+    resource becomes the group-scoped name (bundle-specific or wildcard),
+    plus a sliver of the bundle marker so even zero-resource tasks are
+    pinned to the group's nodes."""
+    idx = bundle_index if bundle_index >= 0 else None
+    out = {pg_resource_name(k, pg_hex, idx): v
+           for k, v in resources.items() if v > 0}
+    out[pg_resource_name(PG_BUNDLE_MARKER, pg_hex, idx)] = PG_MARKER_DEMAND
+    return out
+
+
+def pg_bundle_grants(bundles, pg_hex: str):
+    """Per-bundle custom-resource grant maps a reservation creates on its
+    node: bundle-specific names, wildcard names (any-bundle demand), and
+    the membership markers. Returns one dict per bundle; a node hosting
+    several bundles sums its dicts."""
+    grants = []
+    for i, bundle in enumerate(bundles):
+        add: Dict[str, float] = {}
+        for k, v in bundle.items():
+            if v <= 0:
+                continue
+            add[pg_resource_name(k, pg_hex, i)] = v
+            add[pg_resource_name(k, pg_hex)] = \
+                add.get(pg_resource_name(k, pg_hex), 0.0) + v
+        add[pg_resource_name(PG_BUNDLE_MARKER, pg_hex, i)] = \
+            PG_BUNDLE_CAPACITY
+        add[pg_resource_name(PG_BUNDLE_MARKER, pg_hex)] = PG_BUNDLE_CAPACITY
+        grants.append(add)
+    return grants
 
 
 def dense_matrix(sets: Iterable[ResourceSet], custom_names: Tuple[str, ...] = ()) -> np.ndarray:

@@ -46,7 +46,7 @@ from ..object_ref import ObjectRef
 from .config import Config
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from .memory_store import MemoryStore, StoredObject
-from .resources import NodeResources, ResourceSet
+from .resources import KILO, NodeResources, ResourceSet
 from .serialization import get_context as get_serialization_context
 from .task_spec import TaskSpec, TaskType
 
@@ -519,6 +519,8 @@ class LocalRuntime:
         self._named_actors: Dict[str, ActorID] = {}
         self._actor_seq: Dict[ActorID, itertools.count] = {}
         self._pool = _TaskPool(max_threads=4096, name="task")
+        # Placement groups (single-node gang admission): pg_id -> record.
+        self._placement_groups: Dict[bytes, Dict[str, Any]] = {}
         # Counter namespace for user-thread contexts; starts high so it never
         # collides with the driver thread's own task counters.
         self._thread_scope_counter = itertools.count(1 << 31)
@@ -1016,6 +1018,132 @@ class LocalRuntime:
         return TaskID.for_normal_task(
             ctx.job_id, ctx.current_task_id, next(ctx.task_counter)
         )
+
+    # -------------------------------------------------------- placement groups
+    def _pg_apply_custom(self, grants: Dict[str, float], sign: int) -> None:
+        """Add (+1) / remove (-1) group-scoped custom resources on this
+        node. Caller holds _resource_cv."""
+        new_total = dict(self.node.total.custom)
+        new_avail = dict(self.node.available.custom)
+        for name, qty in grants.items():
+            fixed = sign * int(round(qty * KILO))
+            if sign > 0:
+                new_total[name] = new_total.get(name, 0) + fixed
+                new_avail[name] = new_avail.get(name, 0) + fixed
+            else:
+                new_total.pop(name, None)
+                new_avail.pop(name, None)
+        self.node.total = ResourceSet(self.node.total.predefined, new_total)
+        self.node.available = ResourceSet(
+            self.node.available.predefined, new_avail)
+
+    def create_placement_group(self, pg_id: bytes, bundles, strategy: str,
+                               name: str = "") -> None:
+        """Single-node gang admission: all bundles must co-reside here, so
+        the gang fits iff the bundle SUM fits (all-or-nothing by
+        construction) — except STRICT_SPREAD with more than one bundle,
+        which can never be satisfied by one node and is INFEASIBLE."""
+        total = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        total_set = ResourceSet.from_dict(total)
+        rec = {
+            "pg_id": pg_id, "bundles": [dict(b) for b in bundles],
+            "strategy": strategy, "name": name, "state": "PENDING",
+            "reason": "", "nodes": [], "created": threading.Event(),
+            "base": total_set,
+        }
+        with self._lock:
+            self._placement_groups[pg_id] = rec
+        if (strategy == "STRICT_SPREAD" and len(bundles) > 1) or \
+                not total_set.is_subset_of(self.node.total):
+            rec["reason"] = "infeasible"
+            return
+        threading.Thread(target=self._pg_admit_local, args=(rec,),
+                         daemon=True,
+                         name=f"pg-{pg_id.hex()[:8]}").start()
+
+    def _pg_admit_local(self, rec: Dict[str, Any]) -> None:
+        from .resources import pg_bundle_grants
+
+        with self._resource_cv:
+            while rec["state"] == "PENDING" and \
+                    not self.node.acquire(rec["base"]):
+                rec["reason"] = "waiting-for-capacity"
+                self._resource_cv.wait(timeout=0.5)
+            if rec["state"] != "PENDING":
+                if rec.get("base_acquired"):
+                    self.node.release(rec["base"])
+                return
+            rec["base_acquired"] = True
+            grants: Dict[str, float] = {}
+            for g in pg_bundle_grants(rec["bundles"], rec["pg_id"].hex()):
+                for k, v in g.items():
+                    grants[k] = grants.get(k, 0.0) + v
+            rec["grants"] = grants
+            self._pg_apply_custom(grants, +1)
+            rec["state"] = "CREATED"
+            rec["reason"] = ""
+            rec["nodes"] = [self.node_id.hex()] * len(rec["bundles"])
+            self._resource_cv.notify_all()
+        rec["created"].set()
+        self._dispatch()
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        from ..exceptions import PlacementGroupError
+
+        with self._lock:
+            rec = self._placement_groups.get(pg_id)
+        if rec is None:
+            return
+        with self._resource_cv:
+            was_created = rec["state"] == "CREATED"
+            rec["state"] = "REMOVED"
+            if was_created:
+                self._pg_apply_custom(rec.get("grants", {}), -1)
+                self.node.release(rec["base"])
+                rec["base_acquired"] = False
+            self._resource_cv.notify_all()
+        # Fail queued tasks pinned to the removed group: their demands can
+        # never be admitted again (the group names are gone from totals).
+        marker = "_group_"
+        hexid = pg_id.hex()
+        victims: List[PendingTask] = []
+        with self._lock:
+            for klass in list(self._ready.keys()):
+                _, custom = klass
+                if any(marker in k and k.endswith(hexid)
+                       for k, _v in custom):
+                    dq = self._ready.pop(klass)
+                    victims.extend(dq)
+        for p in victims:
+            p.cancelled = True
+            self._store_error(p.spec, PlacementGroupError(
+                f"placement group {hexid[:12]} was removed"))
+            self._unpin_args(p.spec.dependencies())
+        self._dispatch()
+
+    def placement_group_wait(self, pg_id: bytes,
+                             timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            rec = self._placement_groups.get(pg_id)
+        if rec is None:
+            return False
+        rec["created"].wait(timeout)
+        return rec["state"] == "CREATED"
+
+    def placement_group_table(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            recs = list(self._placement_groups.values())
+        return {
+            rec["pg_id"].hex(): {
+                "state": rec["state"], "strategy": rec["strategy"],
+                "name": rec["name"], "bundles": rec["bundles"],
+                "nodes": list(rec["nodes"]), "reason": rec["reason"],
+            }
+            for rec in recs
+        }
 
     def shutdown(self):
         with self._lock:

@@ -90,6 +90,10 @@ class TaskSpec:
     name: Optional[str] = None
     # Placement hints
     placement_node: Optional[Any] = None
+    # Placement-group linkage (observability; the scheduling effect is
+    # carried entirely by the translated group-scoped resource names).
+    placement_group_id: Optional[bytes] = None
+    placement_group_bundle_index: int = -1
     metadata: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):

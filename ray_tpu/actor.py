@@ -181,7 +181,9 @@ class ActorClass:
                 resources: Optional[Dict[str, float]] = None,
                 max_concurrency: Optional[int] = None,
                 max_restarts: Optional[int] = None,
-                lifetime: Optional[str] = None):
+                lifetime: Optional[str] = None,
+                placement_group=None,
+                placement_group_bundle_index: int = -1):
         parent = self
 
         class _Options:
@@ -190,6 +192,8 @@ class ActorClass:
                     args, kwargs, name=name, num_cpus=num_cpus, num_tpus=num_tpus,
                     resources=resources, max_concurrency=max_concurrency,
                     max_restarts=max_restarts, lifetime=lifetime,
+                    placement_group=placement_group,
+                    placement_group_bundle_index=placement_group_bundle_index,
                 )
 
         return _Options()
@@ -199,7 +203,8 @@ class ActorClass:
 
     def _remote(self, args, kwargs, *, name=None, num_cpus=None, num_tpus=None,
                 resources=None, max_concurrency=None, max_restarts=None,
-                lifetime=None) -> ActorHandle:
+                lifetime=None, placement_group=None,
+                placement_group_bundle_index=-1) -> ActorHandle:
         worker = global_worker()
         worker.check_connected()
         core = worker.core
@@ -219,6 +224,12 @@ class ActorClass:
             resource_set = ResourceSet.from_dict(res)
         else:
             resource_set = self._resources
+        if placement_group is not None:
+            # The actor's lifetime resources come out of the bundle's
+            # reservation (group-scoped names exist only on its node).
+            resource_set = ResourceSet.from_dict(
+                placement_group.translated_resources(
+                    resource_set.to_dict(), placement_group_bundle_index))
 
         spec = TaskSpec(
             task_id=creation_task_id,
@@ -235,6 +246,9 @@ class ActorClass:
                              else self._max_concurrency),
             is_asyncio=self._is_asyncio,
             name=name or self._default_name,
+            placement_group_id=(placement_group.id
+                                if placement_group is not None else None),
+            placement_group_bundle_index=placement_group_bundle_index,
         )
         core.create_actor(self._cls, spec, args, kwargs)
         return ActorHandle(

@@ -83,11 +83,13 @@ class StandardAutoscaler:
             target = max(target, math.ceil(
                 cur * util / cfg["target_utilization_fraction"]) - 1)
         demands = self.load_metrics.pending_demands
-        if demands:
+        pg_demands = self.load_metrics.pending_pg_demands
+        if demands or pg_demands:
             free = list(self.load_metrics.dynamic_resources.values())
             extra = get_nodes_to_launch(
                 demands, free, cfg["worker_resources"],
-                max_new_nodes=cfg["max_workers"] - len(workers))
+                max_new_nodes=cfg["max_workers"] - len(workers),
+                pending_pg_demands=pg_demands)
             target = max(target, len(workers) + extra)
 
         target = min(target, cfg["max_workers"])

@@ -13,6 +13,11 @@ class LoadMetrics:
         self.dynamic_resources: Dict[str, Dict[str, float]] = {}  # ip -> avail
         self.last_heartbeat: Dict[str, float] = {}
         self.pending_demands: List[Dict[str, float]] = []  # unplaceable tasks
+        # Pending placement groups: each an ATOMIC demand unit — a gang
+        # that cannot fit the fleet needs whole nodes for ALL its bundles
+        # at once, never capacity for one bundle's worth. Shape:
+        # {"strategy": str, "bundles": [resource dicts], "reason": str}.
+        self.pending_pg_demands: List[Dict] = []
 
     def update(self, ip: str, static: Dict[str, float],
                dynamic: Dict[str, float]) -> None:
@@ -27,6 +32,9 @@ class LoadMetrics:
 
     def set_pending_demands(self, demands: List[Dict[str, float]]) -> None:
         self.pending_demands = list(demands)
+
+    def set_pending_placement_groups(self, pg_demands: List[Dict]) -> None:
+        self.pending_pg_demands = list(pg_demands)
 
     def prune_inactive(self, timeout_s: float) -> None:
         now = time.monotonic()
@@ -74,4 +82,5 @@ class LoadMetrics:
     def summary(self) -> str:
         return (f"LoadMetrics: {self.num_nodes()} nodes, "
                 f"utilization={self.utilization():.2f}, "
-                f"pending={len(self.pending_demands)}")
+                f"pending={len(self.pending_demands)}, "
+                f"pending_pgs={len(self.pending_pg_demands)}")

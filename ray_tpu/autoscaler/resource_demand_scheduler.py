@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 def _fits(demand: Dict[str, float], free: Dict[str, float]) -> bool:
@@ -15,18 +15,95 @@ def _consume(demand: Dict[str, float], free: Dict[str, float]) -> None:
         free[k] = free.get(k, 0.0) - v
 
 
+def _pack_gang(gang: Dict, free: List[Dict[str, float]],
+               new_nodes: List[Dict[str, float]],
+               node_type_resources: Dict[str, float],
+               max_new_nodes: int) -> bool:
+    """Place one placement-group gang ATOMICALLY: all bundles fit (over
+    existing free capacity, already-planned new nodes, and — within the
+    budget — fresh nodes), or NOTHING is consumed and no node is
+    requested. A gang must never eat free capacity or launch nodes for
+    one bundle's worth (the partial reservation could never be used)."""
+    bundles = sorted((dict(b) for b in gang.get("bundles", [])),
+                     key=lambda b: -sum(b.values()))
+    if not bundles:
+        return True
+    strategy = gang.get("strategy", "PACK")
+    trial_free = [dict(f) for f in free]
+    trial_new = [dict(f) for f in new_nodes]
+    added: List[Dict[str, float]] = []
+
+    if strategy == "STRICT_PACK":
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        for f in trial_free + trial_new:
+            if _fits(total, f):
+                _consume(total, f)
+                free[:] = trial_free
+                new_nodes[:] = trial_new
+                return True
+        if (len(new_nodes) < max_new_nodes
+                and _fits(total, dict(node_type_resources))):
+            fresh = dict(node_type_resources)
+            _consume(total, fresh)
+            new_nodes.append(fresh)
+            return True
+        return False
+
+    distinct = strategy == "STRICT_SPREAD"
+    used: set = set()
+    for b in bundles:
+        placed = False
+        for pool in (trial_free, trial_new, added):
+            for f in pool:
+                if distinct and id(f) in used:
+                    continue
+                if _fits(b, f):
+                    _consume(b, f)
+                    used.add(id(f))
+                    placed = True
+                    break
+            if placed:
+                break
+        if placed:
+            continue
+        if len(new_nodes) + len(added) >= max_new_nodes:
+            return False
+        if not _fits(b, dict(node_type_resources)):
+            return False  # a bundle no node type can hold: infeasible
+        fresh = dict(node_type_resources)
+        _consume(b, fresh)
+        used.add(id(fresh))
+        added.append(fresh)
+    free[:] = trial_free
+    new_nodes[:] = trial_new
+    new_nodes.extend(added)
+    return True
+
+
 def get_nodes_to_launch(
     pending_demands: List[Dict[str, float]],
     existing_free: List[Dict[str, float]],
     node_type_resources: Dict[str, float],
     max_new_nodes: int,
+    pending_pg_demands: Optional[List[Dict]] = None,
 ) -> int:
     """First-fit-decreasing pack of pending demands onto existing free
-    capacity, then onto hypothetical new nodes; returns new-node count."""
+    capacity, then onto hypothetical new nodes; returns new-node count.
+    Pending placement groups are packed FIRST, each as one atomic unit
+    (see _pack_gang) — gangs are the demands that need whole nodes."""
     free = [dict(f) for f in existing_free]
+    new_nodes: List[Dict[str, float]] = []
+    gangs = sorted(
+        pending_pg_demands or [],
+        key=lambda g: -sum(sum(b.values()) for b in g.get("bundles", [])))
+    for gang in gangs:
+        _pack_gang(gang, free, new_nodes, node_type_resources,
+                   max_new_nodes)
     demands = sorted(pending_demands,
                      key=lambda d: -sum(d.values()))
-    new_nodes: List[Dict[str, float]] = []
     for demand in demands:
         placed = False
         for f in free:

@@ -895,6 +895,9 @@ class NodeController:
             coro = self._delete_objects(msg["object_ids"])
         elif mtype == "restore_object":
             coro = self._restore_object(msg["object_id"])
+        elif mtype in ("pg_reserve", "pg_release"):
+            self._loop.call_soon_threadsafe(self._apply_pg_update, msg)
+            return
         elif mtype == "pubsub":
             return
         else:
@@ -957,8 +960,35 @@ class NodeController:
         if not task.pop("local_acquired", False):
             return
         for k, v in task.get("resources", {}).items():
+            if k not in self.resources:
+                # A removed placement group's bundle share (pg_release
+                # stripped the name): don't resurrect it locally.
+                self.local_avail.pop(k, None)
+                continue
             self.local_avail[k] = min(
-                self.local_avail.get(k, 0.0) + v, self.resources.get(k, v))
+                self.local_avail.get(k, 0.0) + v, self.resources[k])
+        self._admit_event.set()
+
+    def _apply_pg_update(self, msg: Dict) -> None:
+        """Placement-group bundle reservation pushed by the GCS: the base
+        resources move out of the node's free pool and come back as
+        group-scoped custom names (pg_reserve), or the reverse on group
+        removal/rescheduling (pg_release). Local admission then treats
+        member tasks exactly like any other custom-resource demand."""
+        if msg.get("type") == "pg_reserve":
+            for k, v in (msg.get("deduct") or {}).items():
+                self.local_avail[k] = self.local_avail.get(k, 0.0) - v
+            for k, v in (msg.get("add") or {}).items():
+                self.resources[k] = self.resources.get(k, 0.0) + v
+                self.local_avail[k] = self.local_avail.get(k, 0.0) + v
+        else:  # pg_release
+            for k in (msg.get("remove") or ()):
+                self.resources.pop(k, None)
+                self.local_avail.pop(k, None)
+            for k, v in (msg.get("restore") or {}).items():
+                self.local_avail[k] = min(
+                    self.local_avail.get(k, 0.0) + v,
+                    self.resources.get(k, 0.0))
         self._admit_event.set()
 
     async def _restore_object(self, oid: bytes) -> None:

@@ -890,6 +890,45 @@ class ClusterCoreWorker:
             if not lease.get("acquiring"):
                 self._release_lease(lease)
 
+    # ------------------------------------------------------ placement groups
+    def create_placement_group(self, pg_id: bytes, bundles, strategy: str,
+                               name: str = "") -> None:
+        """Register the group with the GCS; gang admission is async (the
+        GCS admits all bundles atomically when capacity allows)."""
+        self._flush_submits()
+        resp = self.gcs.call({
+            "type": "create_placement_group", "pg_id": pg_id,
+            "bundles": bundles, "strategy": strategy, "name": name})
+        if not resp.get("ok", True):
+            raise ValueError(resp.get("error", "create_placement_group"))
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        self.gcs.call({"type": "remove_placement_group", "pg_id": pg_id})
+
+    def placement_group_wait(self, pg_id: bytes,
+                             timeout: Optional[float] = None) -> bool:
+        """Long-poll the GCS until the group is CREATED (or the timeout /
+        a terminal REMOVED state)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 30.0 if deadline is None else \
+                min(30.0, deadline - time.monotonic())
+            if step <= 0:
+                return False
+            resp = self.gcs.call({"type": "wait_placement_group",
+                                  "pg_id": pg_id, "timeout": step},
+                                 timeout=step + 30.0)
+            if resp.get("created"):
+                return True
+            if resp.get("state") == "REMOVED" \
+                    or not resp.get("known", True):
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+
+    def placement_group_table(self) -> Dict[str, Dict]:
+        return self.gcs.call({"type": "list_placement_groups"})["groups"]
+
     # ----------------------------------------------------------------- actors
     def create_actor(self, cls: type, spec: TaskSpec, args, kwargs) -> ActorID:
         self._flush_submits()

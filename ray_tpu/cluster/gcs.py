@@ -148,6 +148,18 @@ class GcsServer:
         self._early_task_done_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
         self.node_stats: Dict[str, Dict[str, Any]] = {}  # reporter data
+        # ---- Placement groups (all-or-nothing gang scheduling). Each
+        # record: pg_id, bundles, strategy, state (PENDING -> CREATED ->
+        # REMOVED / RESCHEDULING), per-bundle node ids, pending reason
+        # ("infeasible" vs "waiting-for-capacity"), waiter events. A
+        # created group's bundles exist as group-scoped custom resources
+        # on their nodes, so member tasks ride the ordinary placement
+        # path; admission itself is the gang pass (scheduler kernel /
+        # reference, bit-identical) run by _pg_loop.
+        self.placement_groups: Dict[bytes, Dict[str, Any]] = {}
+        self._pg_event = asyncio.Event()
+        self._pg_seq = 0
+        self._pg_round = 0
         self._place_event = asyncio.Event()
         self._seed = 0
         # (path, batch-bucket) -> [ema_seconds, samples]; see
@@ -263,7 +275,11 @@ class GcsServer:
                 self._spawn(self._drive_task(rec))
         self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
         self._tasks.append(asyncio.create_task(self._placement_loop()))
+        self._tasks.append(asyncio.create_task(self._pg_loop()))
         self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
+        if any(r["state"] in ("PENDING", "RESCHEDULING")
+               for r in self.placement_groups.values()):
+            self._pg_event.set()
         if self.persist_path:
             self._tasks.append(asyncio.create_task(self._snapshot_loop()))
         return port
@@ -295,6 +311,10 @@ class GcsServer:
             "task_table": self.task_table,
             "lineage": self.lineage,
             "error_objects": self.error_objects,
+            "placement_groups": {
+                pid: {k: v for k, v in rec.items() if k != "waiters"}
+                for pid, rec in self.placement_groups.items()
+            },
         }
 
     def _write_snapshot(self) -> None:
@@ -340,6 +360,9 @@ class GcsServer:
         self.task_table = state.get("task_table", {})
         self.lineage = state.get("lineage", {})
         self.error_objects = state.get("error_objects", {})
+        self.placement_groups = state.get("placement_groups", {})
+        for rec in self.placement_groups.values():
+            rec["waiters"] = []
         for oid in self.error_objects:
             self._error_order.append(oid)
         for tid, rec in self.task_table.items():
@@ -1001,6 +1024,18 @@ class GcsServer:
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node.node_id and                     info["state"] in ("ALIVE", "PENDING"):
                 await self._actor_died(actor_id, info, no_restart=False)
+        # Placement groups with a bundle on the dead node: release the
+        # WHOLE gang (surviving bundles included — partial groups are
+        # never left standing) and re-enter admission.
+        for rec in self.placement_groups.values():
+            if rec["state"] == "CREATED" and node.node_id in rec["nodes"]:
+                self.record_event("pg_member_node_death",
+                                  pg_id=rec["pg_id"].hex()[:16],
+                                  node_id=node.node_id)
+                await self._pg_release_nodes(rec, skip_node=node.node_id)
+                rec["state"] = "RESCHEDULING"
+                rec["reason"] = "waiting-for-capacity"
+                self._pg_event.set()
         await self.publish("nodes", {"node_id": node.node_id, "state": "DEAD"})
 
     # -------------------------------------------------------------- placement
@@ -1381,9 +1416,335 @@ class GcsServer:
         if node is None:
             return
         for key, val in demand.items():
+            if key not in node.resources:
+                # The resource no longer exists on the node (a removed /
+                # rescheduled placement group's bundle share, a deleted
+                # dynamic resource): a late release must not resurrect it
+                # as phantom availability.
+                node.available.pop(key, None)
+                continue
             node.available[key] = min(
-                node.available.get(key, 0.0) + val, node.resources.get(key, val)
+                node.available.get(key, 0.0) + val, node.resources[key]
             )
+
+    # ------------------------------------------------------ placement groups
+    def _pg_pending(self) -> List[Dict[str, Any]]:
+        return sorted(
+            (r for r in self.placement_groups.values()
+             if r["state"] in ("PENDING", "RESCHEDULING")),
+            key=lambda r: r["seq"])
+
+    async def _pg_loop(self):
+        """Gang-admission loop: one all-or-nothing pass over every pending
+        group per tick. Kept separate from the task placement loop so an
+        unplaceable gang NEVER stalls singleton placement — a pending
+        group holds zero resources until the pass admits all its bundles."""
+        while True:
+            if not self._pg_pending():
+                await self._pg_event.wait()
+                self._pg_event.clear()
+                continue
+            try:
+                await self._pg_admit_tick()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                import traceback
+
+                traceback.print_exc()
+            if self._pg_pending():
+                # Capacity may free at any completion; re-pass on a short
+                # cadence (gangs are rare and the pass is numpy-cheap).
+                try:
+                    await asyncio.wait_for(self._pg_event.wait(), 0.05)
+                except asyncio.TimeoutError:
+                    pass
+                self._pg_event.clear()
+
+    @staticmethod
+    def _pg_strategy_code(strategy: str) -> int:
+        return {"PACK": 0, "SPREAD": 1,
+                "STRICT_PACK": 2, "STRICT_SPREAD": 3}[strategy]
+
+    def _pg_place(self, pending, avail: np.ndarray,
+                  custom_names) -> np.ndarray:
+        """Run one gang-admission pass (thread-side; may compile). The
+        scalar reference IS the production spec here — gang counts are
+        tiny and numpy beats an XLA round trip; RAY_TPU_PG_KERNEL=1 routes
+        through the jit'd kernel pass instead (bit-identical, pinned by
+        tests/test_scheduler.py)."""
+        import os as _os
+
+        from .._private.resources import dense_matrix
+        from ..scheduler import reference as _ref
+
+        demand_sets = []
+        group = []
+        strategy = []
+        for g, rec in enumerate(pending):
+            strategy.append(self._pg_strategy_code(rec["strategy"]))
+            for b in rec["bundles"]:
+                demand_sets.append(ResourceSet.from_dict(b))
+                group.append(g)
+        demand = dense_matrix(demand_sets, custom_names)
+        group = np.asarray(group, np.int32)
+        strategy = np.asarray(strategy, np.int32)
+        import jax
+
+        key = jax.random.PRNGKey(0)
+        self._pg_round += 1
+        if _os.environ.get("RAY_TPU_PG_KERNEL", "") not in ("", "0"):
+            from ..scheduler.kernel import admit_gangs_host
+
+            return admit_gangs_host(
+                demand.astype(np.int32), group, strategy,
+                avail.astype(np.int32), key, round_idx=self._pg_round)
+        return _ref.admit_gangs_reference(
+            demand, group, strategy, avail, key, round_idx=self._pg_round)
+
+    def _pg_place_greedy(self, pending, avail: np.ndarray,
+                         custom_names) -> np.ndarray:
+        """jax-free fallback pass (first-fit, still strictly
+        all-or-nothing per group; strategies honored)."""
+        from .._private.resources import dense_matrix
+
+        out: List[int] = []
+        resid = avail.astype(np.int64).copy()
+        N = resid.shape[0]
+        for rec in pending:
+            d = dense_matrix(
+                [ResourceSet.from_dict(b) for b in rec["bundles"]],
+                custom_names)
+            k = d.shape[0]
+            s = rec["strategy"]
+            picks: Optional[List[int]] = None
+            if s in ("PACK", "STRICT_PACK"):
+                total = d.sum(0)
+                for n in range(N):
+                    if (total <= resid[n]).all():
+                        picks = [n] * k
+                        break
+            if picks is None and s != "STRICT_PACK":
+                scratch = resid.copy()
+                trial = []
+                used = set()
+                for j in range(k):
+                    found = None
+                    for n in range(N):
+                        if s == "STRICT_SPREAD" and n in used:
+                            continue
+                        if (d[j] <= scratch[n]).all():
+                            found = n
+                            break
+                    if found is None:
+                        break
+                    trial.append(found)
+                    used.add(found)
+                    scratch[found] -= d[j]
+                if len(trial) == k:
+                    picks = trial
+            if picks is None:
+                out.extend([-1] * k)
+            else:
+                for j, n in enumerate(picks):
+                    resid[n] -= d[j]
+                out.extend(picks)
+        return np.asarray(out, np.int32)
+
+    def _pg_feasible_vs_totals(self, rec, totals: np.ndarray,
+                               custom_names) -> bool:
+        """Could the gang EVER fit the current fleet (idle)? Decides the
+        pending reason: infeasible (needs new/bigger nodes — the
+        autoscaler's cue) vs waiting-for-capacity (running work must
+        drain first)."""
+        from .._private.resources import dense_matrix
+
+        N = totals.shape[0]
+        d = dense_matrix([ResourceSet.from_dict(b) for b in rec["bundles"]],
+                         custom_names)
+        if rec["strategy"] == "STRICT_SPREAD":
+            if d.shape[0] > N:
+                return False
+            # each bundle on a distinct node: greedy matching on totals
+            scratch = totals.astype(np.int64).copy()
+            used: set = set()
+            for j in range(d.shape[0]):
+                found = None
+                for n in range(N):
+                    if n not in used and (d[j] <= scratch[n]).all():
+                        found = n
+                        break
+                if found is None:
+                    return False
+                used.add(found)
+            return True
+        if rec["strategy"] == "STRICT_PACK":
+            return bool((d.sum(0) <= totals).all(-1).any())
+        return bool(all((d[j] <= totals).all(-1).any()
+                        for j in range(d.shape[0])))
+
+    async def _pg_admit_tick(self):
+        pending = self._pg_pending()
+        if not pending:
+            return
+        custom_names = tuple(sorted(
+            {name for rec in pending for b in rec["bundles"]
+             for name in ResourceSet.from_dict(b).custom}))
+        avail, totals, order = self._avail_matrix(custom_names)
+        if not order:
+            for rec in pending:
+                rec["reason"] = "waiting-for-capacity"
+            return
+        t0 = time.monotonic()
+        try:
+            placement = await asyncio.to_thread(
+                self._pg_place, pending, avail, custom_names)
+        except Exception:  # noqa: BLE001 - jax unavailable: greedy fallback
+            placement = self._pg_place_greedy(pending, avail, custom_names)
+        self._stat_add("phase:pg_admit", time.monotonic() - t0,
+                       len(pending))
+        off = 0
+        for rec in pending:
+            k = len(rec["bundles"])
+            slots = placement[off:off + k]
+            off += k
+            if (slots >= 0).all():
+                nodes = [order[int(n)] for n in slots]
+                if await self._pg_reserve(rec, nodes):
+                    continue
+            # Not admitted this pass: classify the reason for the
+            # autoscaler/monitor (and emit the infeasible event once).
+            if not self._pg_feasible_vs_totals(rec, totals, custom_names):
+                rec["reason"] = "infeasible"
+                if not rec.get("infeasible_logged"):
+                    rec["infeasible_logged"] = True
+                    self.record_event(
+                        "pg_infeasible", pg_id=rec["pg_id"].hex()[:16],
+                        strategy=rec["strategy"],
+                        bundles=len(rec["bundles"]))
+                    self._stat_add("pg:infeasible", 0.0, 1)
+            else:
+                rec["reason"] = "waiting-for-capacity"
+                rec.pop("infeasible_logged", None)
+
+    def _pg_grants_by_node(self, rec, nodes) -> Dict[str, Dict[str, Dict]]:
+        """Per-node {deduct: base-resources, add: group-scoped resources}
+        for the group's bundles living on each node."""
+        from .._private.resources import pg_bundle_grants
+
+        grants = pg_bundle_grants(rec["bundles"], rec["pg_id"].hex())
+        by_node: Dict[str, Dict[str, Dict]] = {}
+        for i, nid in enumerate(nodes):
+            e = by_node.setdefault(nid, {"deduct": {}, "add": {}})
+            for k, v in rec["bundles"][i].items():
+                if v > 0:
+                    e["deduct"][k] = e["deduct"].get(k, 0.0) + v
+            for k, v in grants[i].items():
+                e["add"][k] = e["add"].get(k, 0.0) + v
+        return by_node
+
+    def _pg_wake(self, rec) -> None:
+        for ev in rec.get("waiters", []):
+            ev.set()
+        rec["waiters"] = []
+
+    async def _pg_reserve(self, rec, nodes: List[str]) -> bool:
+        """Materialize an admitted gang: acquire every bundle's base share
+        (synchronously — no partial acquisition is ever observable), push
+        the reservation to each node controller, then expose the
+        group-scoped resources in the GCS accounting. Any failed push
+        rolls the WHOLE gang back."""
+        by_node = self._pg_grants_by_node(rec, nodes)
+        for nid, e in by_node.items():
+            self._acquire(nid, ResourceSet.from_dict(e["deduct"]))
+        reserved: List[str] = []
+        ok = True
+        for nid, e in by_node.items():
+            sent = await self._send_with_retry(nid, {
+                "type": "pg_reserve", "pg_id": rec["pg_id"],
+                "deduct": e["deduct"], "add": e["add"]})
+            if not sent:
+                ok = False
+                break
+            reserved.append(nid)
+        if not ok:
+            for nid, e in by_node.items():
+                self._release(nid, e["deduct"])
+            for nid in reserved:
+                await self._send_with_retry(nid, {
+                    "type": "pg_release", "pg_id": rec["pg_id"],
+                    "restore": by_node[nid]["deduct"],
+                    "remove": list(by_node[nid]["add"])})
+            rec["reason"] = "waiting-for-capacity"
+            return False
+        for nid, e in by_node.items():
+            node = self.nodes[nid]
+            for k, v in e["add"].items():
+                node.resources[k] = node.resources.get(k, 0.0) + v
+                node.available[k] = node.available.get(k, 0.0) + v
+        rescheduled = rec["state"] == "RESCHEDULING"
+        rec["state"] = "CREATED"
+        rec["nodes"] = list(nodes)
+        rec["reason"] = ""
+        rec.pop("infeasible_logged", None)
+        self.record_event(
+            "pg_rescheduled" if rescheduled else "pg_created",
+            pg_id=rec["pg_id"].hex()[:16], strategy=rec["strategy"],
+            nodes=[n[:8] for n in nodes])
+        self._stat_add("pg:rescheduled" if rescheduled else "pg:created",
+                       0.0, 1)
+        self._pg_metric("rescheduled" if rescheduled else "created")
+        self._pg_wake(rec)
+        self._place_event.set()   # queued member tasks can place now
+        return True
+
+    def _pg_metric(self, kind: str) -> None:
+        from ..metrics import placement_group_metrics
+
+        try:
+            placement_group_metrics()["events"].record(1.0,
+                                                       tags={"kind": kind})
+            placement_group_metrics()["pending"].record(float(len(
+                self._pg_pending())))
+        except Exception:  # noqa: BLE001 - metrics must never fail control
+            pass
+
+    async def _pg_release_nodes(self, rec, skip_node: Optional[str] = None
+                                ) -> None:
+        """Whole-gang release: strip the group-scoped resources from every
+        (surviving) member node, return the base shares, and tell the
+        controllers. Shared by removal and member-node-death handling."""
+        if not rec.get("nodes"):
+            return
+        by_node = self._pg_grants_by_node(rec, rec["nodes"])
+        for nid, e in by_node.items():
+            node = self.nodes.get(nid)
+            if node is None or nid == skip_node:
+                continue
+            for k in e["add"]:
+                node.resources.pop(k, None)
+                node.available.pop(k, None)
+            self._release(nid, e["deduct"])
+            if node.alive:
+                await self._send_with_retry(nid, {
+                    "type": "pg_release", "pg_id": rec["pg_id"],
+                    "restore": e["deduct"], "remove": list(e["add"])})
+        rec["nodes"] = []
+
+    def _pg_fail_member_tasks(self, rec) -> None:
+        """A removed group's queued member tasks can never place again
+        (the group-scoped names are gone): fail them now instead of
+        leaving their refs pending forever."""
+        from ..exceptions import PlacementGroupError
+
+        hexid = rec["pg_id"].hex()
+        for trec in list(self.task_table.values()):
+            if trec["state"] != "PENDING":
+                continue
+            if not any("_group_" in k and k.endswith(hexid)
+                       for k in trec.get("resources", {})):
+                continue
+            trec["cancelled"] = True
+            self._fail_record(trec, PlacementGroupError(
+                f"placement group {hexid[:12]} was removed"))
 
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
@@ -2320,7 +2681,99 @@ class GcsServer:
 
         @s.handler("pending_demands")
         async def pending_demands(msg, conn):
-            return {"ok": True, "demands": list(self._unplaceable.values())}
+            # Group-scoped demands (tasks pending on a not-yet-created
+            # placement group) are excluded: the gang itself is the
+            # autoscaler's demand unit, reported atomically below.
+            demands = [d for d in self._unplaceable.values()
+                       if not any("_group_" in k for k in d)]
+            pg_demands = [
+                {"strategy": rec["strategy"],
+                 "bundles": [dict(b) for b in rec["bundles"]],
+                 "state": rec["state"], "reason": rec["reason"]}
+                for rec in self._pg_pending()]
+            return {"ok": True, "demands": demands,
+                    "pg_demands": pg_demands}
+
+        # ---- placement groups ----
+        @s.handler("create_placement_group")
+        async def create_placement_group(msg, conn):
+            pg_id = msg["pg_id"]
+            if pg_id in self.placement_groups:
+                return {"ok": True}  # client retry across a reconnect
+            strategy = msg.get("strategy", "PACK")
+            if strategy not in ("PACK", "SPREAD", "STRICT_PACK",
+                                "STRICT_SPREAD"):
+                return {"ok": False, "error": f"unknown strategy {strategy!r}"}
+            bundles = [dict(b) for b in msg.get("bundles", [])]
+            if not bundles:
+                return {"ok": False, "error": "no bundles"}
+            self._pg_seq += 1
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+                "name": msg.get("name") or "", "state": "PENDING",
+                "nodes": [], "reason": "waiting-for-capacity",
+                "seq": self._pg_seq, "waiters": [],
+            }
+            self._pg_event.set()
+            return {"ok": True}
+
+        @s.handler("remove_placement_group")
+        async def remove_placement_group(msg, conn):
+            rec = self.placement_groups.get(msg["pg_id"])
+            if rec is None or rec["state"] == "REMOVED":
+                return {"ok": True, "removed": False}
+            was_created = rec["state"] == "CREATED"
+            rec["state"] = "REMOVED"
+            if was_created:
+                await self._pg_release_nodes(rec)
+            rec["reason"] = ""
+            self._pg_fail_member_tasks(rec)
+            self.record_event("pg_removed", pg_id=rec["pg_id"].hex()[:16],
+                              strategy=rec["strategy"])
+            self._stat_add("pg:removed", 0.0, 1)
+            self._pg_metric("removed")
+            self._pg_wake(rec)
+            return {"ok": True, "removed": True}
+
+        @s.handler("wait_placement_group")
+        async def wait_placement_group(msg, conn):
+            async def work():
+                rec = self.placement_groups.get(msg["pg_id"])
+                if rec is None:
+                    return {"ok": True, "known": False, "created": False}
+                if rec["state"] in ("CREATED", "REMOVED"):
+                    return {"ok": True, "known": True,
+                            "created": rec["state"] == "CREATED",
+                            "state": rec["state"]}
+                ev = asyncio.Event()
+                rec.setdefault("waiters", []).append(ev)
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), float(msg.get("timeout") or 30.0))
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    ws = rec.get("waiters")
+                    if ws is not None and ev in ws:
+                        ws.remove(ev)
+                return {"ok": True, "known": True,
+                        "created": rec["state"] == "CREATED",
+                        "state": rec["state"]}
+
+            self._detach(msg, conn, work())
+            return None
+
+        @s.handler("list_placement_groups")
+        async def list_placement_groups(msg, conn):
+            return {"ok": True, "groups": {
+                rec["pg_id"].hex(): {
+                    "state": rec["state"], "strategy": rec["strategy"],
+                    "name": rec["name"],
+                    "bundles": [dict(b) for b in rec["bundles"]],
+                    "nodes": list(rec["nodes"]), "reason": rec["reason"],
+                }
+                for rec in self.placement_groups.values()
+            }}
 
         @s.handler("set_resource")
         async def set_resource(msg, conn):

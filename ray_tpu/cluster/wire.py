@@ -73,6 +73,17 @@ TASK_DONE = 0x0B
 # carry the serialized result inline (the small-result data plane).
 TASK_DONE2 = 0x0C
 TASK_DONE_BATCH2 = 0x0D
+# Placement-group control ops (create / remove / status+list). Rare
+# messages, but framed so a binary-only deployment never needs pickle for
+# the pg control surface.
+PG_CREATE = 0x0E
+PG_REMOVE = 0x0F
+PG_STATUS = 0x10
+PG_OK = 0x11
+PG_STATUS_RESP = 0x12
+
+_PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+_PG_STATES = ("PENDING", "CREATED", "RESCHEDULING", "REMOVED")
 
 # Task-spec versions. v1 is the base header; v2 appends a trace context
 # (sampled tasks only — unsampled specs still encode as v1, so the hot
@@ -645,6 +656,105 @@ def _dec_task_done2(r: _Reader, rpc_id) -> Dict[str, Any]:
     return _dec_task_done(r, rpc_id, v2=True)
 
 
+def _enc_pg_create(msg, peer_wire: int = WIRE_VERSION) -> Optional[List[bytes]]:
+    try:
+        strat = _PG_STRATEGIES.index(msg.get("strategy", "PACK"))
+    except ValueError:
+        return None  # unknown strategy: let pickle carry it (server errors)
+    out = [_head(PG_CREATE, msg.get("rpc_id")), _b8(msg["pg_id"]),
+           _U8.pack(strat), _s(msg.get("name") or ""),
+           _U16.pack(len(msg.get("bundles", ())))]
+    for bundle in msg.get("bundles", ()):
+        out.append(_resources(bundle))
+    return out
+
+
+def _dec_pg_create(r: _Reader, rpc_id) -> Dict[str, Any]:
+    pg_id = r.b8()
+    strat = r.u8()
+    if strat >= len(_PG_STRATEGIES):
+        raise WireError(f"unknown pg strategy code {strat}")
+    name = r.s()
+    n = r.count(r.u16())
+    bundles = [_read_resources(r) for _ in range(n)]
+    r.done()
+    return {"type": "create_placement_group", "pg_id": pg_id,
+            "strategy": _PG_STRATEGIES[strat], "name": name,
+            "bundles": bundles, "rpc_id": rpc_id}
+
+
+def _enc_pg_remove(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
+    return [_head(PG_REMOVE, msg.get("rpc_id")), _b8(msg["pg_id"])]
+
+
+def _dec_pg_remove(r: _Reader, rpc_id) -> Dict[str, Any]:
+    pg_id = r.b8()
+    r.done()
+    return {"type": "remove_placement_group", "pg_id": pg_id,
+            "rpc_id": rpc_id}
+
+
+def _enc_pg_status(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
+    return [_head(PG_STATUS, msg.get("rpc_id"))]
+
+
+def _dec_pg_status(r: _Reader, rpc_id) -> Dict[str, Any]:
+    r.done()
+    return {"type": "list_placement_groups", "rpc_id": rpc_id}
+
+
+def _enc_pg_ok(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
+    return [_head(PG_OK, msg.get("rpc_id")),
+            _U8.pack(1 if msg.get("removed") else 0)]
+
+
+def _dec_pg_ok(r: _Reader, rpc_id) -> Dict[str, Any]:
+    removed = r.u8()
+    r.done()
+    return {"ok": True, "removed": bool(removed), "rpc_id": rpc_id}
+
+
+def _enc_pg_status_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
+    groups = msg.get("groups", {})
+    out = [_head(PG_STATUS_RESP, msg.get("rpc_id")),
+           _U16.pack(len(groups))]
+    for pg_hex, info in groups.items():
+        out.append(_b8(bytes.fromhex(pg_hex)))
+        out.append(_U8.pack(_PG_STATES.index(info["state"])))
+        out.append(_U8.pack(_PG_STRATEGIES.index(info["strategy"])))
+        out.append(_s(info.get("name") or ""))
+        out.append(_s(info.get("reason") or ""))
+        out.append(_U16.pack(len(info.get("bundles", ()))))
+        for bundle in info.get("bundles", ()):
+            out.append(_resources(bundle))
+        nodes = info.get("nodes", ())
+        out.append(_U16.pack(len(nodes)))
+        for nid in nodes:
+            out.append(_s(nid))
+    return out
+
+
+def _dec_pg_status_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u16())
+    groups = {}
+    for _ in range(n):
+        pg_id = r.b8()
+        state = r.u8()
+        strat = r.u8()
+        if state >= len(_PG_STATES) or strat >= len(_PG_STRATEGIES):
+            raise WireError("bad pg state/strategy code")
+        name = r.s()
+        reason = r.s()
+        bundles = [_read_resources(r) for _ in range(r.count(r.u16()))]
+        nodes = [r.s() for _ in range(r.count(r.u16()))]
+        groups[pg_id.hex()] = {
+            "state": _PG_STATES[state], "strategy": _PG_STRATEGIES[strat],
+            "name": name, "reason": reason, "bundles": bundles,
+            "nodes": nodes}
+    r.done()
+    return {"ok": True, "groups": groups, "rpc_id": rpc_id}
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -655,6 +765,9 @@ _ENCODERS = {
     "assign_batch": _enc_assign_batch,
     "execute_task": _enc_execute_task,
     "task_done": _enc_task_done,
+    "create_placement_group": _enc_pg_create,
+    "remove_placement_group": _enc_pg_remove,
+    "list_placement_groups": _enc_pg_status,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -662,6 +775,9 @@ _RESP_ENCODERS = {
     "submit_batch": _enc_submit_batch_resp,
     "locations_batch": _enc_locations_batch_resp,
     "fetch_batch": _enc_fetch_batch_resp,
+    "create_placement_group": _enc_pg_ok,
+    "remove_placement_group": _enc_pg_ok,
+    "list_placement_groups": _enc_pg_status_resp,
 }
 
 _DECODERS = {
@@ -678,6 +794,11 @@ _DECODERS = {
     TASK_DONE: _dec_task_done,
     TASK_DONE2: _dec_task_done2,
     TASK_DONE_BATCH2: _dec_task_done_batch2,
+    PG_CREATE: _dec_pg_create,
+    PG_REMOVE: _dec_pg_remove,
+    PG_STATUS: _dec_pg_status,
+    PG_OK: _dec_pg_ok,
+    PG_STATUS_RESP: _dec_pg_status_resp,
 }
 
 

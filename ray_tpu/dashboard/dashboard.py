@@ -77,10 +77,10 @@ function laneView(events) {
 }
 async function refresh() {
   const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
-         timeline, events, traces] =
+         timeline, events, traces, pgs] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
-       "memory","serve","timeline","events","traces"].map(
+       "memory","serve","timeline","events","traces","pgs"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th>" +
@@ -111,7 +111,21 @@ async function refresh() {
   for (const n of nodes)
     h += `<tr><td>${(n.NodeID||"").slice(0,12)}</td><td>${n.Alive}</td>` +
          `<td>${JSON.stringify(n.Resources)}</td></tr>`;
-  h += "</table><h2>actors</h2><table><tr><th>id</th><th>state</th><th>name</th></tr>";
+  // placement groups: gang reservations and their lifecycle state
+  const pgEntries = Object.entries(pgs || {});
+  h += `</table><h2>placement groups (${pgEntries.length})</h2>`;
+  if (pgEntries.length) {
+    h += "<table><tr><th>group</th><th>state</th><th>strategy</th>" +
+         "<th>bundles</th><th>nodes</th><th>reason</th></tr>";
+    for (const [id, g] of pgEntries.slice(0, 50))
+      h += `<tr><td>${id.slice(0,12)}</td><td>${esc(g.state)}</td>` +
+           `<td>${esc(g.strategy)}</td>` +
+           `<td>${esc(JSON.stringify(g.bundles))}</td>` +
+           `<td>${(g.nodes||[]).map(n => esc(n).slice(0,8)).join(" ")}</td>` +
+           `<td>${esc(g.reason || "")}</td></tr>`;
+    h += "</table>";
+  } else h += "<i>no placement groups</i>";
+  h += "<h2>actors</h2><table><tr><th>id</th><th>state</th><th>name</th></tr>";
   for (const [id, a] of Object.entries(actors))
     h += `<tr><td>${id.slice(0,12)}</td><td>${a.State||a.state}</td>` +
          `<td>${a.Name||a.name||""}</td></tr>`;
@@ -231,6 +245,14 @@ def _collect(endpoint: str):
         from ..metrics import collect_all
 
         return collect_all()
+    if endpoint == "pgs":
+        # Placement groups (gang reservations): full table with lifecycle
+        # state, per-bundle nodes, and pending reason.
+        core = global_worker().core
+        try:
+            return core.placement_group_table()
+        except Exception:  # noqa: BLE001 - GCS restart window
+            return {}
     if endpoint == "events":
         # Cluster event log (node up/down, retries, spill/restore,
         # backpressure) straight from the GCS; local mode has no cluster

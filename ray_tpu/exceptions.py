@@ -115,12 +115,18 @@ class RuntimeEnvError(RayTpuError):
     pass
 
 
+class PlacementGroupError(RayTpuError):
+    """A placement-group operation failed (removed while tasks were
+    pending on it, invalid bundle/strategy, or an unknown group)."""
+
+
 class ClusterUnavailableError(RayTpuError):
     """Cluster infrastructure failure (no reachable nodes, undeliverable
     task) — distinct from user-code errors so callers can retry safely."""
 
 
 __all__ = [
+    "PlacementGroupError",
     "RayTpuError",
     "TaskError",
     "ActorError",

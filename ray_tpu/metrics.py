@@ -161,6 +161,22 @@ def result_plane_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def placement_group_metrics() -> Dict[str, "Metric"]:
+    """``pg:*`` counters for the gang-scheduling control plane: lifecycle
+    transitions by kind (created / rescheduled / removed / infeasible)
+    and the current pending-gang count. Lazily registered; idempotent."""
+    return {
+        "events": get_or_create(
+            Count, "pg_lifecycle_events", tag_keys=("kind",),
+            description="placement-group lifecycle transitions by kind "
+                        "(created / rescheduled / removed / infeasible)"),
+        "pending": get_or_create(
+            Gauge, "pg_pending_groups",
+            description="placement groups currently awaiting gang "
+                        "admission (PENDING or RESCHEDULING)"),
+    }
+
+
 def collect_all() -> Dict[str, Dict]:
     """Snapshot every registered metric (the dashboard's /api/metrics)."""
     with _LOCK:

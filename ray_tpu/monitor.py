@@ -9,9 +9,12 @@ gives identical information on our asyncio GCS.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 from .autoscaler import LoadMetrics, StandardAutoscaler
 from .autoscaler.node_provider import NodeProvider
@@ -31,6 +34,11 @@ class Monitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_updates = 0
+        # Pending placement groups from the last poll, with when each was
+        # first seen pending — feeds the stuck-gang report.
+        self._pg_pending_since: Dict[str, float] = {}
+        self._pg_report_last = 0.0
+        self.pg_table: Dict[str, Dict[str, Any]] = {}
 
     def poll_once(self) -> None:
         nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
@@ -49,13 +57,60 @@ class Monitor:
         for ip in list(self.load_metrics.static_resources):
             if ip not in seen:
                 self.load_metrics.mark_dead(ip)
-        demands = self.gcs.call({"type": "pending_demands"})["demands"]
-        self.load_metrics.set_pending_demands(demands)
+        resp = self.gcs.call({"type": "pending_demands"})
+        self.load_metrics.set_pending_demands(resp["demands"])
+        # Pending gangs are atomic demand units for the scaler.
+        self.load_metrics.set_pending_placement_groups(
+            resp.get("pg_demands", []))
+        try:
+            self.pg_table = self.gcs.call(
+                {"type": "list_placement_groups"})["groups"]
+        except (KeyError, ConnectionError, OSError):
+            self.pg_table = {}
+        now = time.monotonic()
+        pending_ids = set()
+        for pg_hex, info in self.pg_table.items():
+            if info.get("state") in ("PENDING", "RESCHEDULING"):
+                pending_ids.add(pg_hex)
+                self._pg_pending_since.setdefault(pg_hex, now)
+        for pg_hex in list(self._pg_pending_since):
+            if pg_hex not in pending_ids:
+                del self._pg_pending_since[pg_hex]
+
+    def stuck_placement_groups(self, min_pending_s: float = 10.0
+                               ) -> Dict[str, Dict[str, Any]]:
+        """Gangs stuck un-created past ``min_pending_s``, with the reason
+        the GCS classified: "infeasible" (the fleet can never hold the
+        gang — new/bigger nodes needed) vs "waiting-for-capacity"
+        (running work must drain first)."""
+        now = time.monotonic()
+        out: Dict[str, Dict[str, Any]] = {}
+        for pg_hex, since in self._pg_pending_since.items():
+            if now - since < min_pending_s:
+                continue
+            info = self.pg_table.get(pg_hex, {})
+            out[pg_hex] = {
+                "pending_s": round(now - since, 1),
+                "state": info.get("state", "PENDING"),
+                "reason": info.get("reason", ""),
+                "strategy": info.get("strategy", ""),
+                "bundles": info.get("bundles", []),
+            }
+        return out
 
     def update(self) -> None:
         self.poll_once()
         self.autoscaler.update()
         self.num_updates += 1
+        stuck = self.stuck_placement_groups()
+        if stuck and time.monotonic() - self._pg_report_last > 30.0:
+            self._pg_report_last = time.monotonic()
+            for pg_hex, info in stuck.items():
+                logger.warning(
+                    "placement group %s stuck %s for %.0fs (%s): %s x%d",
+                    pg_hex[:12], info["state"], info["pending_s"],
+                    info["reason"] or "unknown", info["strategy"],
+                    len(info["bundles"]))
 
     def run(self) -> None:
         while not self._stop.is_set():

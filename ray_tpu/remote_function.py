@@ -44,8 +44,15 @@ class RemoteFunction:
     def options(self, *, num_returns: Optional[int] = None,
                 num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
                 resources: Optional[Dict[str, float]] = None,
-                max_retries: Optional[int] = None, name: Optional[str] = None):
-        """Per-call-site overrides; returns a submit-only wrapper."""
+                max_retries: Optional[int] = None, name: Optional[str] = None,
+                placement_group=None,
+                placement_group_bundle_index: int = -1):
+        """Per-call-site overrides; returns a submit-only wrapper.
+
+        ``placement_group`` pins the task into a reserved bundle: its
+        demand is rewritten to the group-scoped resource names, so it can
+        only run on the bundle's node, consuming the bundle's reservation
+        (``placement_group_bundle_index=-1`` = any bundle of the group)."""
         parent = self
 
         class _Options:
@@ -54,6 +61,8 @@ class RemoteFunction:
                     args, kwargs,
                     num_returns=num_returns, num_cpus=num_cpus, num_tpus=num_tpus,
                     resources=resources, max_retries=max_retries, name=name,
+                    placement_group=placement_group,
+                    placement_group_bundle_index=placement_group_bundle_index,
                 )
 
         return _Options()
@@ -62,7 +71,8 @@ class RemoteFunction:
         return self._remote(args, kwargs)
 
     def _remote(self, args, kwargs, *, num_returns=None, num_cpus=None,
-                num_tpus=None, resources=None, max_retries=None, name=None):
+                num_tpus=None, resources=None, max_retries=None, name=None,
+                placement_group=None, placement_group_bundle_index=-1):
         worker = global_worker()
         worker.check_connected()
         core = worker.core
@@ -76,6 +86,10 @@ class RemoteFunction:
             resource_set = ResourceSet.from_dict(res)
         else:
             resource_set = self._resources
+        if placement_group is not None:
+            resource_set = ResourceSet.from_dict(
+                placement_group.translated_resources(
+                    resource_set.to_dict(), placement_group_bundle_index))
 
         task_id = core.next_task_id()
         spec = TaskSpec(
@@ -93,6 +107,9 @@ class RemoteFunction:
             ),
             name=name or self._name,
             metadata={"kwargs": kwargs} if kwargs else {},
+            placement_group_id=(placement_group.id
+                                if placement_group is not None else None),
+            placement_group_bundle_index=placement_group_bundle_index,
         )
         refs = core.submit_task(self._function, spec)
         if spec.num_returns == 1:

@@ -48,6 +48,13 @@ import numpy as np
 NO_PLACEMENT = -1   # not (yet) placed
 INFEASIBLE = -2     # cannot fit on any node even when idle
 
+# Placement-group strategies (ray_tpu.placement_group). Codes are part of
+# the gang-admission spec shared by admit_gangs / admit_gangs_reference.
+PACK, SPREAD, STRICT_PACK, STRICT_SPREAD = 0, 1, 2, 3
+STRATEGY_CODES = {"PACK": PACK, "SPREAD": SPREAD,
+                  "STRICT_PACK": STRICT_PACK,
+                  "STRICT_SPREAD": STRICT_SPREAD}
+
 
 @jax.jit
 def task_bits(key: jax.Array, round_idx, task_idx) -> jax.Array:
@@ -213,6 +220,178 @@ def schedule_dag(
 
     placement, rounds = jax.lax.while_loop(cond, body, (placement0, jnp.int32(0)))
     return placement, rounds
+
+
+@jax.jit
+def admit_gangs(
+    demand: jax.Array,      # [B, R] int32 bundle demands (padding rows zero)
+    group: jax.Array,       # [B] int32 group index, ascending-contiguous
+    #                         (bundles of a group adjacent, in submission
+    #                         order); -1 marks padding rows
+    strategy: jax.Array,    # [G] int32 strategy code (PACK..STRICT_SPREAD)
+    avail: jax.Array,       # [N, R] int32 per-node availability
+    key: jax.Array,         # threefry PRNGKey
+    round_idx,
+) -> jax.Array:
+    """One all-or-nothing gang-admission pass (placement groups).
+
+    The gang analogue of one ``schedule_dag`` round: every pending group
+    draws a candidate node per bundle under its strategy, then ONE
+    segmented prefix-sum over the whole bundle stream (grouped by
+    candidate node, submission order preserved) decides admission. A group
+    is admitted iff EVERY one of its bundles' prefixes fits its node —
+    zero partial acquisition is ever representable in the output. Groups
+    deferred this pass retry the next tick with a fresh draw, exactly like
+    deferred tasks retry the next round.
+
+    Candidate spec per strategy (deterministic; one threefry draw per
+    group index, shared with the scalar reference bit-for-bit):
+
+      STRICT_PACK   every bundle prefers the same node — the draw picks
+                    among nodes whose availability fits the group TOTAL;
+                    no such node => not admissible this pass.
+      PACK          same-node preference: the STRICT_PACK candidate when
+                    one exists, else the SPREAD fallback below.
+      SPREAD        bundle with in-group rank j prefers the
+                    ((start + j) mod n_feasible)-th node feasible for it,
+                    start = draw mod N — a rotation that de-clusters
+                    bundles without requiring distinctness.
+      STRICT_SPREAD bundle rank j takes node (start + j) mod N literally:
+                    candidates are distinct by construction (a group with
+                    more bundles than nodes is structurally INFEASIBLE,
+                    returned as such, never a silent hang). An infeasible
+                    rotation defers the group to the next pass's draw.
+
+    The prefix counts every bundle of every admissible group in the
+    stream (admitted or not) — the same conservative choice that makes
+    ``schedule_dag`` admission a cumsum instead of a sequential loop; a
+    rejected group can defer a later group on the same node for one pass,
+    never forever. Bundles of groups that are not admissible this pass
+    (no candidate) stay out of the stream, so one infeasible gang never
+    consumes prefix budget that feasible work behind it needs.
+    """
+    B, R = demand.shape
+    G = strategy.shape[0]
+    N = avail.shape[0]
+    demand = demand.astype(jnp.int32)
+    avail = avail.astype(jnp.int32)
+    group = group.astype(jnp.int32)
+
+    valid = group >= 0
+    gidx = jnp.where(valid, group, G)          # padding -> scratch bucket G
+    gclip = jnp.minimum(gidx, G - 1)           # safe gather index
+    idx = jnp.arange(B, dtype=jnp.int32)
+
+    first = jnp.full((G + 1,), B, jnp.int32).at[gidx].min(idx)
+    size = jnp.zeros((G + 1,), jnp.int32).at[gidx].add(
+        valid.astype(jnp.int32))
+    total = jnp.zeros((G + 1, R), jnp.int32).at[gidx].add(
+        demand * valid[:, None])
+    rank = idx - first[gidx]                   # in-group submission rank
+
+    feas = (demand[:, None, :] <= avail[None, :, :]).all(-1) \
+        & valid[:, None]                                        # [B, N]
+    cnt = feas.sum(-1).astype(jnp.int32)
+    packfeas = (total[:G, None, :] <= avail[None, :, :]).all(-1)  # [G, N]
+    packcnt = packfeas.sum(-1).astype(jnp.int32)
+
+    bits = task_bits(key, round_idx, jnp.arange(G, dtype=jnp.int32))
+    start = (bits % jnp.uint32(N)).astype(jnp.int32)            # [G]
+
+    # Pack candidate per group: the draw-th node fitting the group total.
+    r_pack = (bits % jnp.maximum(packcnt, 1).astype(jnp.uint32)
+              ).astype(jnp.int32)
+    cum_pack = jnp.cumsum(packfeas, axis=-1)
+    pack_pick = jnp.argmax((cum_pack == r_pack[:, None] + 1) & packfeas,
+                           axis=-1).astype(jnp.int32)
+
+    # Spread candidate per bundle: rank-rotated over ITS feasible nodes.
+    srt = start[gclip]
+    r_spread = jnp.where(cnt > 0, (srt + rank) % jnp.maximum(cnt, 1), 0)
+    cum_f = jnp.cumsum(feas, axis=-1)
+    spread_pick = jnp.argmax((cum_f == r_spread[:, None] + 1) & feas,
+                             axis=-1).astype(jnp.int32)
+
+    # Strict-spread candidate: rank-rotated over ALL nodes (distinct since
+    # size <= N is required for admissibility).
+    ss_pick = ((srt + rank) % N).astype(jnp.int32)
+    ss_ok = jnp.take_along_axis(
+        feas, jnp.maximum(ss_pick, 0)[:, None], axis=1)[:, 0] \
+        & (size[gidx] <= N)
+
+    strat = strategy[gclip]
+    pack_ok = (packcnt > 0)[gclip]
+    use_pack = (strat == STRICT_PACK) | ((strat == PACK) & pack_ok)
+    cand = jnp.where(
+        use_pack, pack_pick[gclip],
+        jnp.where(strat == STRICT_SPREAD, ss_pick, spread_pick))
+    ok = jnp.where(
+        strat == STRICT_PACK, pack_ok,
+        jnp.where(strat == STRICT_SPREAD, ss_ok, cnt > 0)) & valid
+
+    ready_g = jnp.ones((G + 1,), jnp.int32).at[gidx].min(
+        ok.astype(jnp.int32))
+
+    # Admission: ONE segmented prefix-sum over admissible groups' bundles,
+    # grouped by candidate node, submission order within a node.
+    in_stream = valid & (ready_g[gidx] > 0)
+    node_key = jnp.where(in_stream, cand, N)
+    order = jnp.argsort(node_key, stable=True)
+    sorted_pick = node_key[order]
+    sorted_d = demand[order] * (sorted_pick < N)[:, None]
+    cum = jnp.cumsum(sorted_d, axis=0)
+    seg_start = jnp.concatenate(
+        [jnp.array([True]), sorted_pick[1:] != sorted_pick[:-1]])
+    base = jnp.where(
+        seg_start[:, None],
+        jnp.concatenate([jnp.zeros((1, R), cum.dtype), cum[:-1]]), 0)
+    base = jax.lax.cummax(base, axis=0)
+    prefix = cum - base
+    cap = avail[jnp.minimum(sorted_pick, N - 1)]
+    fits_sorted = (prefix <= cap).all(-1) & (sorted_pick < N)
+    fits = jnp.zeros((B,), bool).at[order].set(
+        fits_sorted, unique_indices=True)
+
+    adm_g = jnp.ones((G + 1,), jnp.int32).at[gidx].min(
+        fits.astype(jnp.int32))
+    admitted = (adm_g[:G] > 0) & (ready_g[:G] > 0)
+
+    placement = jnp.where(valid & admitted[gclip], cand, NO_PLACEMENT)
+    inf_g = (strategy == STRICT_SPREAD) & (size[:G] > N)
+    placement = jnp.where(valid & inf_g[gclip], INFEASIBLE, placement)
+    return placement.astype(jnp.int32)
+
+
+def admit_gangs_host(demand: np.ndarray, group: np.ndarray,
+                     strategy: np.ndarray, avail, key,
+                     round_idx: int = 0) -> np.ndarray:
+    """Host entry for the jit'd gang pass: power-of-two padding on both
+    the bundle and group axes so cluster ticks don't recompile per pg
+    count, plus the same int32 overflow guard as BatchScheduler."""
+    demand = np.asarray(demand, np.int32)
+    group = np.asarray(group, np.int32)
+    strategy = np.asarray(strategy, np.int32)
+    avail_np = np.asarray(avail)
+    B = demand.shape[0]
+    if B == 0 or avail_np.shape[0] == 0:
+        return np.full((B,), NO_PLACEMENT, np.int32)
+    peak = int(avail_np.max(initial=0))
+    if peak > 0 and B * peak >= 2 ** 31:
+        raise ValueError("gang admission stream exceeds int32 scan range")
+    G = strategy.shape[0]
+    bpad = (1 << max(B - 1, 1).bit_length()) - B
+    gpad = (1 << max(G - 1, 1).bit_length()) - G
+    if bpad:
+        demand = np.concatenate(
+            [demand, np.zeros((bpad, demand.shape[1]), np.int32)])
+        group = np.concatenate([group, np.full(bpad, -1, np.int32)])
+    if gpad:
+        strategy = np.concatenate([strategy, np.zeros(gpad, np.int32)])
+    out = admit_gangs(jnp.asarray(demand), jnp.asarray(group),
+                      jnp.asarray(strategy),
+                      jnp.asarray(avail_np.astype(np.int32)), key,
+                      jnp.int32(round_idx))
+    return np.asarray(out)[:B]
 
 
 def schedule_dag_collapsed(

@@ -15,7 +15,15 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .kernel import INFEASIBLE, NO_PLACEMENT, task_bits_host
+from .kernel import (
+    INFEASIBLE,
+    NO_PLACEMENT,
+    PACK,
+    SPREAD,
+    STRICT_PACK,
+    STRICT_SPREAD,
+    task_bits_host,
+)
 
 
 def schedule_dag_reference(
@@ -90,3 +98,107 @@ def schedule_dag_reference(
         round_idx += 1
 
     return placement.astype(np.int32), round_idx
+
+
+def admit_gangs_reference(demand, group, strategy, avail, key,
+                          round_idx: int = 0):
+    """Scalar spec of ``kernel.admit_gangs`` (bit-identical by the same
+    contract as ``schedule_dag_reference``): sequential, obviously
+    all-or-nothing gang admission. The GCS serves placement groups with
+    THIS implementation (gang counts are tiny; numpy beats a compile),
+    which is exactly why the kernel must reproduce it bit-for-bit — the
+    two stay interchangeable per tick."""
+    demand = np.asarray(demand, dtype=np.int64)
+    group = np.asarray(group, dtype=np.int64)
+    strategy = np.asarray(strategy, dtype=np.int64)
+    avail = np.asarray(avail, dtype=np.int64)
+    B = demand.shape[0]
+    N = avail.shape[0]
+    G = strategy.shape[0]
+    placement = np.full(B, NO_PLACEMENT, dtype=np.int64)
+    if B == 0 or G == 0:
+        return placement.astype(np.int32)
+
+    bundles_of = [[] for _ in range(G)]
+    for i in range(B):
+        g = int(group[i])
+        if g >= 0:
+            bundles_of[g].append(i)
+
+    if N == 0:
+        for g in range(G):
+            if strategy[g] == STRICT_SPREAD and bundles_of[g]:
+                for i in bundles_of[g]:
+                    placement[i] = INFEASIBLE
+        return placement.astype(np.int32)
+
+    bits = task_bits_host(key, round_idx, np.arange(G, dtype=np.int32),
+                          max(G, 1))
+
+    # Phase 1 — candidates: one node per bundle under the group strategy.
+    cand: dict = {}
+    group_ready = [False] * G
+    for g in range(G):
+        idxs = bundles_of[g]
+        if not idxs:
+            continue
+        s = int(strategy[g])
+        start = int(bits[g] % np.uint32(N))
+        total = demand[idxs].sum(axis=0)
+        packfeas = (total <= avail).all(axis=1)
+        packcnt = int(packfeas.sum())
+        ok = True
+        picks = {}
+        for rank, i in enumerate(idxs):
+            feas_i = (demand[i] <= avail).all(axis=1)
+            cnt = int(feas_i.sum())
+            if s == STRICT_PACK or (s == PACK and packcnt > 0):
+                if packcnt == 0:
+                    ok = False
+                    break
+                r = int(bits[g] % np.uint32(packcnt))
+                picks[i] = int(np.nonzero(packfeas)[0][r])
+            elif s == STRICT_SPREAD:
+                if len(idxs) > N:
+                    ok = False
+                    break
+                pick = (start + rank) % N
+                if not feas_i[pick]:
+                    ok = False
+                    break
+                picks[i] = pick
+            else:  # SPREAD, or PACK with no single node fitting the total
+                if cnt == 0:
+                    ok = False
+                    break
+                r = (start + rank) % cnt
+                picks[i] = int(np.nonzero(feas_i)[0][r])
+        if ok:
+            group_ready[g] = True
+            cand.update(picks)
+
+    # Phase 2 — admission: one prefix stream over every admissible
+    # group's bundles in submission order, segmented by candidate node;
+    # a group is admitted iff ALL its bundles' prefixes fit.
+    prefix = np.zeros_like(avail)
+    fits = np.zeros(B, dtype=bool)
+    for i in range(B):
+        g = int(group[i])
+        if g < 0 or not group_ready[g]:
+            continue
+        pick = cand[i]
+        prefix[pick] += demand[i]
+        fits[i] = bool((prefix[pick] <= avail[pick]).all())
+
+    for g in range(G):
+        idxs = bundles_of[g]
+        if not idxs:
+            continue
+        if strategy[g] == STRICT_SPREAD and len(idxs) > N:
+            for i in idxs:
+                placement[i] = INFEASIBLE
+            continue
+        if group_ready[g] and all(fits[i] for i in idxs):
+            for i in idxs:
+                placement[i] = cand[i]
+    return placement.astype(np.int32)

@@ -287,6 +287,14 @@ def cmd_status(args) -> None:
             print(f"  {n['NodeID'][:12]} {state:<6} {n['Resources']}")
         print(f"total resources:     {res['total']}")
         print(f"available resources: {res['available']}")
+        groups = gcs.call({"type": "list_placement_groups"})["groups"]
+        if groups:
+            by_state: Dict[str, int] = {}
+            for g in groups.values():
+                by_state[g["state"]] = by_state.get(g["state"], 0) + 1
+            detail = " ".join(f"{k.lower()}={v}"
+                              for k, v in sorted(by_state.items()))
+            print(f"placement groups:    {len(groups)} ({detail})")
         # Per-phase latency table from the GCS handler stats (the same
         # cells scripts/cluster_lat.py harvests): avg wall per item for the
         # server-side phases of the 7-phase profiler.
@@ -378,6 +386,31 @@ def cmd_events(args) -> None:
             detail = " ".join(f"{k}={v}" for k, v in ev.items()
                               if k not in ("ts", "kind"))
             print(f"  {stamp} {ev['kind']:<22} {detail}")
+    finally:
+        gcs.close()
+
+
+def cmd_pgs(args) -> None:
+    """Placement-group table: lifecycle state, strategy, bundles, the
+    nodes holding each bundle, and — for stuck gangs — the pending reason
+    (infeasible vs waiting-for-capacity)."""
+    gcs = _gcs_client(args.address)
+    try:
+        groups = gcs.call({"type": "list_placement_groups"})["groups"]
+        print(f"{len(groups)} placement groups")
+        if not groups:
+            return
+        print(f"{'GROUP':<18} {'STATE':<13} {'STRATEGY':<14} "
+              f"{'BUNDLES':<8} {'NODES':<26} REASON")
+        for pg_hex, g in groups.items():
+            nodes = ",".join(n[:8] for n in g.get("nodes", [])) or "-"
+            name = f" name={g['name']}" if g.get("name") else ""
+            print(f"{pg_hex[:16]:<18} {g['state']:<13} "
+                  f"{g['strategy']:<14} {len(g['bundles']):<8} "
+                  f"{nodes:<26} {g.get('reason') or '-'}{name}")
+            if getattr(args, "verbose", False):
+                for i, b in enumerate(g["bundles"]):
+                    print(f"    bundle[{i}] {b}")
     finally:
         gcs.close()
 
@@ -614,6 +647,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--limit", type=int, default=50_000,
                     help="newest spans to fetch from the GCS trace table")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("pgs", help="placement-group table (gang "
+                                    "reservations and lifecycle state)")
+    sp.add_argument("--address")
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    help="print per-bundle resource dicts")
+    sp.set_defaults(fn=cmd_pgs)
 
     sp = sub.add_parser("events", help="cluster lifecycle event log")
     sp.add_argument("--address")

@@ -157,6 +157,140 @@ def run_case(name, demand, avail, seed=0):
     return out
 
 
+def _gang_fits_seq(bundles, strategy, load):
+    """Strategy-aware first-fit of one gang against ``load`` (the
+    sequential baseline's greedy step); returns per-bundle nodes or None."""
+    N = load.shape[0]
+    if strategy == "STRICT_PACK":
+        total = bundles.sum(0)
+        for n in range(N):
+            if (total <= load[n]).all():
+                return [n] * len(bundles)
+        return None
+    picks = []
+    used = set()
+    scratch = load.copy()
+    for b in bundles:
+        found = None
+        for n in range(N):
+            if strategy == "STRICT_SPREAD" and n in used:
+                continue
+            if (b <= scratch[n]).all():
+                found = n
+                break
+        if found is None:
+            return None
+        picks.append(found)
+        used.add(found)
+        scratch[found] -= b
+    return picks
+
+
+def drain_gang_mix_sequential(gangs, singles, avail, key, chunk=8192):
+    """Faithful sequential baseline for a gang+singleton mix: per round,
+    walk the pending stream in submission order — gangs as atomic units
+    (strategy-aware first-fit, all bundles or nothing), singletons as the
+    cc-loop's greedy admit — against the round's running load."""
+    avail = np.asarray(avail, np.int64)
+    singles = np.asarray(singles, np.int64)
+    pend_g = list(range(len(gangs)))
+    pend_s = list(range(len(singles)))
+    rounds = 0
+    while (pend_g or pend_s) and rounds < 10_000:
+        load = avail.copy()
+        bits = task_bits_host(key, rounds,
+                              np.asarray(pend_s or [0], np.int32), chunk)
+        for gi in list(pend_g):
+            bundles, strategy = gangs[gi]
+            picks = _gang_fits_seq(np.asarray(bundles, np.int64),
+                                   strategy, load)
+            if picks is not None:
+                for b, n in zip(np.asarray(bundles, np.int64), picks):
+                    load[n] -= b
+                pend_g.remove(gi)
+        for j, t in enumerate(list(pend_s)):
+            feas = (singles[t] <= load).all(axis=1)
+            cnt = int(feas.sum())
+            if cnt == 0:
+                continue
+            pick = int(np.nonzero(feas)[0][int(bits[j] % np.uint32(cnt))])
+            load[pick] -= singles[t]
+            pend_s.remove(t)
+        rounds += 1
+    return rounds
+
+
+def drain_gang_mix_prefix(gangs, singles, avail, key, chunk=8192):
+    """The shipped spec: per round, ONE all-or-nothing gang-admission
+    pass (scheduler.reference.admit_gangs_reference — bit-identical to
+    the jit'd kernel pass) over the pending gangs, then the singleton
+    prefix placement against the residual."""
+    from ray_tpu.scheduler.reference import admit_gangs_reference
+
+    strategy_code = {"PACK": 0, "SPREAD": 1,
+                     "STRICT_PACK": 2, "STRICT_SPREAD": 3}
+    avail = np.asarray(avail, np.int64)
+    singles = np.asarray(singles, np.int64)
+    pend_g = list(range(len(gangs)))
+    pend_s = np.arange(len(singles))
+    rounds = 0
+    while (pend_g or len(pend_s)) and rounds < 10_000:
+        residual = avail.copy()
+        if pend_g:
+            demand_rows = []
+            group = []
+            strats = []
+            for slot, gi in enumerate(pend_g):
+                bundles, strategy = gangs[gi]
+                strats.append(strategy_code[strategy])
+                for b in bundles:
+                    demand_rows.append(b)
+                    group.append(slot)
+            p = admit_gangs_reference(
+                np.asarray(demand_rows, np.int64),
+                np.asarray(group, np.int64),
+                np.asarray(strats, np.int64), residual, key,
+                round_idx=rounds)
+            off = 0
+            for slot, gi in enumerate(list(pend_g)):
+                bundles, _ = gangs[gi]
+                k = len(bundles)
+                slots = p[off:off + k]
+                off += k
+                if (slots >= 0).all():
+                    for b, n in zip(np.asarray(bundles, np.int64), slots):
+                        residual[int(n)] -= b
+                    pend_g.remove(gi)
+        if len(pend_s):
+            parents = np.full((len(pend_s), 1), -1, np.int64)
+            sp, _ = schedule_dag_reference(
+                singles[pend_s], parents, residual, key, max_rounds=1)
+            pend_s = pend_s[sp < 0]
+        rounds += 1
+    return rounds
+
+
+def run_gang_case(name, gangs, singles, avail, seed=0):
+    """Gang-mix A/B row: gangs interleaved with singleton tasks,
+    drain-rounds of the shipped all-or-nothing pass vs the sequential
+    baseline."""
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    out = {"case": name, "gangs": len(gangs),
+           "bundles": int(sum(len(b) for b, _ in gangs)),
+           "singles": int(len(singles)), "nodes": int(avail.shape[0])}
+    out["gang_prefix(shipped)"] = {
+        "rounds": int(drain_gang_mix_prefix(gangs, singles, avail, key))}
+    out["gang_sequential(baseline)"] = {
+        "rounds": int(drain_gang_mix_sequential(gangs, singles, avail,
+                                                key))}
+    out["extra_rounds_vs_seq"] = (
+        out["gang_prefix(shipped)"]["rounds"]
+        - out["gang_sequential(baseline)"]["rounds"])
+    return out
+
+
 def main():
     cases = []
     rng = np.random.RandomState(0)
@@ -185,8 +319,34 @@ def main():
     cases.append(run_case(
         "lognormal_mix(512, 2 nodes)", d, np.full((2, 1), 1000, np.int64)))
 
+    # ---- gang mixes: placement groups interleaved with singletons ----
+    # 4 spread gangs of 4x300m among 64 mixed singletons on 4 nodes.
+    gangs = [([[300]] * 4, "SPREAD") for _ in range(4)]
+    singles = rng.randint(50, 400, size=(64, 1)).astype(np.int64)
+    cases.append(run_gang_case(
+        "gang_mix_spread(4x4 gangs + 64 singles, 4 nodes)",
+        gangs, singles, np.full((4, 1), 1000, np.int64)))
+
+    # strict gangs on a tight fleet: 2 strict-spread 3x400m + a strict-pack
+    # 2x450m among 32 singletons on 3 nodes.
+    gangs = [([[400]] * 3, "STRICT_SPREAD"),
+             ([[450]] * 2, "STRICT_PACK"),
+             ([[400]] * 3, "STRICT_SPREAD")]
+    singles = rng.randint(50, 300, size=(32, 1)).astype(np.int64)
+    cases.append(run_gang_case(
+        "gang_mix_strict(2xSS3 + SP2 gangs + 32 singles, 3 nodes)",
+        gangs, singles, np.full((3, 1), 1000, np.int64)))
+
     for c in cases:
         print(json.dumps(c))
+    # Persist alongside the printed rows so successive runs are diffable
+    # (same pattern as the BENCH_r* artifacts).
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ADMISSION_AB.json")
+    with open(out_path, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"# wrote {out_path}")
 
 
 if __name__ == "__main__":

@@ -109,12 +109,74 @@ def test_bin_packing():
     assert n == 3
 
 
+def test_gang_demand_is_atomic():
+    """A pending placement group is ONE demand unit: a gang the fleet can
+    never fit requests whole nodes for ALL its bundles at once — never
+    capacity for one bundle's worth."""
+    # strict_spread 3x{CPU:4} on empty fleet, nodes of {CPU:4}: 3 nodes
+    # (one per bundle — distinctness forbids packing).
+    n = get_nodes_to_launch([], [], {"CPU": 4}, max_new_nodes=10,
+                            pending_pg_demands=[
+                                {"strategy": "STRICT_SPREAD",
+                                 "bundles": [{"CPU": 4}] * 3}])
+    assert n == 3
+    # pack gang of 2x{CPU:2} fits ONE new {CPU:4} node.
+    n = get_nodes_to_launch([], [], {"CPU": 4}, max_new_nodes=10,
+                            pending_pg_demands=[
+                                {"strategy": "PACK",
+                                 "bundles": [{"CPU": 2}, {"CPU": 2}]}])
+    assert n == 1
+    # strict_pack whose total exceeds any single node: infeasible, zero
+    # launches (a partial reservation could never be used).
+    n = get_nodes_to_launch([], [], {"CPU": 4}, max_new_nodes=10,
+                            pending_pg_demands=[
+                                {"strategy": "STRICT_PACK",
+                                 "bundles": [{"CPU": 4}, {"CPU": 4}]}])
+    assert n == 0
+    # a gang over the new-node budget launches NOTHING (atomic: no 2-of-3
+    # node request), and consumes no free capacity either.
+    free = [{"CPU": 4}]
+    n = get_nodes_to_launch([], free, {"CPU": 4}, max_new_nodes=1,
+                            pending_pg_demands=[
+                                {"strategy": "STRICT_SPREAD",
+                                 "bundles": [{"CPU": 4}] * 4}])
+    assert n == 0
+    assert free == [{"CPU": 4}]  # rollback left free capacity untouched
+    # existing free capacity absorbs part of a feasible gang.
+    n = get_nodes_to_launch([], [{"CPU": 4}], {"CPU": 4}, max_new_nodes=10,
+                            pending_pg_demands=[
+                                {"strategy": "STRICT_SPREAD",
+                                 "bundles": [{"CPU": 4}] * 3}])
+    assert n == 2
+    # gangs and singletons compose: gang takes the new node it needs,
+    # singles pack after it.
+    n = get_nodes_to_launch([{"CPU": 2}] * 2, [], {"CPU": 4},
+                            max_new_nodes=10,
+                            pending_pg_demands=[
+                                {"strategy": "PACK",
+                                 "bundles": [{"CPU": 4}]}])
+    assert n == 2
+
+
+def test_autoscaler_scales_for_pending_gang():
+    provider, lm, scaler = _mk(max_workers=10)
+    lm.update("head", {"CPU": 4}, {"CPU": 0})
+    lm.set_pending_placement_groups([
+        {"strategy": "STRICT_SPREAD", "bundles": [{"CPU": 2}] * 3,
+         "state": "PENDING", "reason": "infeasible"}])
+    scaler.update()
+    # worker_resources={"CPU": 2}: one node per strict-spread bundle
+    assert len(scaler.workers()) == 3
+
+
 # ---------- monitor against a real mini-cluster ----------
 
 @pytest.mark.slow
 def test_monitor_with_real_cluster():
     from ray_tpu.cluster.testing import Cluster
     from ray_tpu.monitor import Monitor
+
+    import ray_tpu
 
     cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
     try:
@@ -125,6 +187,24 @@ def test_monitor_with_real_cluster():
         assert mon.load_metrics.num_nodes() >= 1
         # min_workers drove mock launches
         assert len(mon.autoscaler.workers()) == 2
+        # A stuck gang surfaces atomically in the monitor's metrics and
+        # the stuck-PENDING report carries the classified reason.
+        ray_tpu.init(address=cluster.address)
+        try:
+            pg = ray_tpu.placement_group([{"CPU": 16}] * 2,
+                                         strategy="STRICT_SPREAD")
+            assert not pg.wait(1.0)
+            mon.update()
+            assert len(mon.load_metrics.pending_pg_demands) == 1
+            gang = mon.load_metrics.pending_pg_demands[0]
+            assert gang["strategy"] == "STRICT_SPREAD"
+            assert len(gang["bundles"]) == 2
+            stuck = mon.stuck_placement_groups(min_pending_s=0.0)
+            assert pg.hex in stuck
+            assert stuck[pg.hex]["reason"] == "infeasible"
+            ray_tpu.remove_placement_group(pg)
+        finally:
+            ray_tpu.shutdown()
         mon.stop()
     finally:
         cluster.shutdown()

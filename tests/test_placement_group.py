@@ -1,0 +1,333 @@
+"""Placement-group tests: API surface, local-mode gang admission, resource
+translation, and the cluster E2E lifecycle (create / wait / use / remove,
+node-kill -> whole-gang reschedule, no partial acquisition ever visible).
+
+The kernel-vs-reference bit-identity of the gang admission pass itself is
+covered in tests/test_scheduler.py::TestGangAdmission.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.resources import (
+    parse_pg_resource,
+    pg_bundle_grants,
+    pg_resource_name,
+    translate_pg_demand,
+)
+
+
+# ---------------------------------------------------------------- unit layer
+
+
+class TestResourceTranslation:
+    def test_names_round_trip(self):
+        assert pg_resource_name("CPU", "ab12", 3) == "CPU_group_3_ab12"
+        assert pg_resource_name("CPU", "ab12") == "CPU_group_ab12"
+        assert parse_pg_resource("CPU_group_3_ab12") == ("CPU", 3, "ab12")
+        assert parse_pg_resource("CPU_group_ab12") == ("CPU", None, "ab12")
+        assert parse_pg_resource("CPU") is None
+        assert parse_pg_resource("tpu_memory") is None
+
+    def test_translate_bundle_and_wildcard(self):
+        out = translate_pg_demand({"CPU": 2.0, "TPU": 4.0}, "ff00", 1)
+        assert out["CPU_group_1_ff00"] == 2.0
+        assert out["TPU_group_1_ff00"] == 4.0
+        assert out["bundle_group_1_ff00"] == 0.001
+        out = translate_pg_demand({}, "ff00", -1)
+        assert out == {"bundle_group_ff00": 0.001}
+
+    def test_bundle_grants_sum_wildcards(self):
+        grants = pg_bundle_grants([{"CPU": 2.0}, {"CPU": 1.0}], "ee00")
+        assert grants[0]["CPU_group_0_ee00"] == 2.0
+        assert grants[1]["CPU_group_1_ee00"] == 1.0
+        # wildcard appears in each grant with the bundle's own share
+        assert grants[0]["CPU_group_ee00"] == 2.0
+        assert grants[1]["CPU_group_ee00"] == 1.0
+        assert grants[0]["bundle_group_0_ee00"] == 1000.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ray_tpu.placement_group([], strategy="PACK")
+        with pytest.raises(ValueError):
+            ray_tpu.placement_group([{"CPU": 1}], strategy="NOPE")
+
+
+# ----------------------------------------------------------- local-mode E2E
+
+
+class TestLocalPlacementGroup:
+    def test_lifecycle_create_use_remove(self, local_ray):
+        before = ray_tpu.available_resources()
+        pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 1}],
+                                     strategy="PACK", name="train")
+        assert pg.wait(10)
+        info = ray_tpu.placement_group_table(pg)[pg.hex]
+        assert info["state"] == "CREATED"
+        assert info["name"] == "train"
+        avail = ray_tpu.available_resources()
+        assert avail["CPU"] == before["CPU"] - 3
+        assert avail[f"CPU_group_0_{pg.hex}"] == 2.0
+        assert avail[f"CPU_group_{pg.hex}"] == 3.0
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        ref = f.options(placement_group=pg,
+                        placement_group_bundle_index=0).remote(41)
+        assert ray_tpu.get(ref, timeout=30) == 42
+        # wildcard (any-bundle) targeting
+        ref = f.options(placement_group=pg).remote(1)
+        assert ray_tpu.get(ref, timeout=30) == 2
+
+        ray_tpu.remove_placement_group(pg)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            avail = ray_tpu.available_resources()
+            if avail.get("CPU") == before["CPU"] \
+                    and not any("_group_" in k for k in avail):
+                break
+            time.sleep(0.05)
+        assert avail.get("CPU") == before["CPU"], avail
+        assert not any("_group_" in k for k in avail), avail
+        assert ray_tpu.placement_group_table(pg)[pg.hex]["state"] == "REMOVED"
+
+    def test_ready_resolves_after_creation(self, local_ray):
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert ray_tpu.get(pg.ready(), timeout=30) == pg.hex
+        ray_tpu.remove_placement_group(pg)
+
+    def test_actor_in_bundle(self, local_ray):
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(placement_group=pg,
+                            placement_group_bundle_index=0,
+                            num_cpus=1).remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+        ray_tpu.kill(c)
+        ray_tpu.remove_placement_group(pg)
+
+    def test_strict_spread_on_one_node_reports_infeasible(self, local_ray):
+        pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                     strategy="STRICT_SPREAD")
+        assert not pg.wait(0.3)
+        info = ray_tpu.placement_group_table(pg)[pg.hex]
+        assert info["state"] == "PENDING"
+        assert info["reason"] == "infeasible"
+
+    def test_oversized_gang_reports_infeasible(self, local_ray):
+        pg = ray_tpu.placement_group([{"CPU": 64}, {"CPU": 64}])
+        assert not pg.wait(0.3)
+        info = ray_tpu.placement_group_table(pg)[pg.hex]
+        assert info["reason"] == "infeasible"
+
+    def test_gang_waits_for_capacity_then_creates(self, local_ray):
+        # Saturate, then create a gang that needs the whole node: it must
+        # stay pending until capacity frees, then admit atomically.
+        import threading
+
+        release = threading.Event()
+
+        @ray_tpu.remote(num_cpus=8)
+        def hog():
+            release.wait(30)
+            return "done"
+
+        ref = hog.remote()
+        time.sleep(0.2)
+        pg = ray_tpu.placement_group([{"CPU": 4}, {"CPU": 4}])
+        assert not pg.wait(0.3)
+        release.set()
+        assert ray_tpu.get(ref, timeout=30) == "done"
+        assert pg.wait(10)
+        ray_tpu.remove_placement_group(pg)
+
+    def test_removed_group_fails_pending_tasks(self, local_ray):
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert pg.wait(10)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        # Demand more bundle-CPU than the bundle holds: stays queued.
+        stuck = f.options(placement_group=pg, placement_group_bundle_index=0,
+                          num_cpus=8).remote()
+        ray_tpu.remove_placement_group(pg)
+        with pytest.raises(ray_tpu.PlacementGroupError):
+            ray_tpu.get(stuck, timeout=10)
+
+
+# -------------------------------------------------------------- cluster E2E
+
+
+@pytest.mark.slow
+@pytest.mark.cluster
+class TestClusterPlacementGroup:
+    def test_lifecycle_and_strict_spread_distinct_nodes(self):
+        from ray_tpu.cluster.testing import Cluster
+
+        with Cluster(head_resources={"CPU": 2}, num_workers=1) as cluster:
+            cluster.add_node(resources={"CPU": 2}, num_workers=1)
+            cluster.wait_for_nodes(2)
+            ray_tpu.init(address=cluster.address)
+            try:
+                pg = ray_tpu.placement_group(
+                    [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+                assert pg.wait(30)
+                info = ray_tpu.placement_group_table(pg)[pg.hex]
+                assert info["state"] == "CREATED"
+                assert len(set(info["nodes"])) == 2
+
+                @ray_tpu.remote
+                def where():
+                    import os
+
+                    return os.environ.get("RAY_TPU_STORE_NAME")
+
+                s0 = ray_tpu.get(where.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=0).remote(), timeout=60)
+                s1 = ray_tpu.get(where.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=1).remote(), timeout=60)
+                assert s0 != s1  # bundles ran on their own nodes
+
+                ray_tpu.remove_placement_group(pg)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    avail = ray_tpu.available_resources()
+                    if avail.get("CPU") == 4.0 \
+                            and not any("_group_" in k for k in avail):
+                        break
+                    time.sleep(0.2)
+                assert avail.get("CPU") == 4.0, avail
+                assert not any("_group_" in k for k in avail), avail
+            finally:
+                ray_tpu.shutdown()
+
+    def test_no_partial_acquisition_while_pending(self):
+        """An unplaceable gang must hold ZERO resources (pinned via the
+        GCS accounting) and must not starve singleton tasks behind it."""
+        from ray_tpu.cluster.testing import Cluster
+
+        with Cluster(head_resources={"CPU": 2}, num_workers=1) as cluster:
+            cluster.add_node(resources={"CPU": 2}, num_workers=1)
+            cluster.wait_for_nodes(2)
+            ray_tpu.init(address=cluster.address)
+            try:
+                pg = ray_tpu.placement_group(
+                    [{"CPU": 8}, {"CPU": 8}], strategy="PACK")
+                assert not pg.wait(1.0)
+                info = ray_tpu.placement_group_table(pg)[pg.hex]
+                assert info["state"] == "PENDING"
+                assert info["reason"] == "infeasible"
+                # zero acquisition: the full fleet is still available
+                avail = ray_tpu.available_resources()
+                assert avail.get("CPU") == 4.0, avail
+                assert not any("_group_" in k for k in avail), avail
+
+                @ray_tpu.remote
+                def ping():
+                    return "pong"
+
+                # singletons behind the stuck gang still run promptly
+                assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+                ray_tpu.remove_placement_group(pg)
+            finally:
+                ray_tpu.shutdown()
+
+    def test_node_kill_reschedules_whole_gang(self):
+        from ray_tpu.cluster.testing import Cluster
+
+        with Cluster(head_resources={"CPU": 2}, num_workers=1) as cluster:
+            cluster.add_node(resources={"CPU": 2}, num_workers=1)
+            cluster.add_node(resources={"CPU": 2}, num_workers=1)
+            cluster.wait_for_nodes(3)
+            ray_tpu.init(address=cluster.address)
+            try:
+                pg = ray_tpu.placement_group(
+                    [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+                assert pg.wait(30)
+                nodes_before = ray_tpu.placement_group_table(pg)[
+                    pg.hex]["nodes"]
+                victim = next(cn for cn in cluster.nodes[1:]
+                              if cn.node_id in nodes_before)
+                cluster.remove_node(victim)
+
+                deadline = time.monotonic() + 90
+                info = None
+                while time.monotonic() < deadline:
+                    info = ray_tpu.placement_group_table(pg)[pg.hex]
+                    if info["state"] == "CREATED" \
+                            and victim.node_id not in info["nodes"]:
+                        break
+                    time.sleep(0.5)
+                assert info["state"] == "CREATED", info
+                assert victim.node_id not in info["nodes"], info
+                assert len(set(info["nodes"])) == 2
+
+                # the rescheduled group is immediately usable
+                @ray_tpu.remote
+                def where():
+                    import os
+
+                    return os.environ.get("RAY_TPU_STORE_NAME")
+
+                s0 = ray_tpu.get(where.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=0).remote(), timeout=60)
+                s1 = ray_tpu.get(where.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=1).remote(), timeout=60)
+                assert s0 != s1
+
+                # full release on removal: accounting is consistent
+                ray_tpu.remove_placement_group(pg)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    avail = ray_tpu.available_resources()
+                    if avail.get("CPU") == 4.0 \
+                            and not any("_group_" in k for k in avail):
+                        break
+                    time.sleep(0.2)
+                assert avail.get("CPU") == 4.0, avail
+                assert not any("_group_" in k for k in avail), avail
+            finally:
+                ray_tpu.shutdown()
+
+    def test_gang_rendezvous_example_completes(self):
+        """The motivating workload: an N-process gang whose rank-0
+        address is published through the GCS kv (examples/
+        gang_rendezvous.py run as a driver against a 2-node cluster)."""
+        import os
+        import subprocess
+        import sys
+
+        from ray_tpu.cluster.testing import Cluster, _subprocess_env
+
+        with Cluster(head_resources={"CPU": 2}, num_workers=2) as cluster:
+            cluster.add_node(resources={"CPU": 2}, num_workers=2)
+            cluster.wait_for_nodes(2)
+            env = _subprocess_env()
+            env["RAY_TPU_ADDRESS"] = cluster.address
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(repo, "examples", "gang_rendezvous.py"),
+                 "--world-size", "4", "--strategy", "SPREAD"],
+                capture_output=True, text=True, timeout=180, env=env)
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert "rendezvous complete" in out.stdout, out.stdout

@@ -440,3 +440,133 @@ class TestSurvivorsPass:
         np.testing.assert_array_equal(kp, rp)
         assert kr == rr
         assert (kp >= 0).all()
+
+
+class TestGangAdmission:
+    """All-or-nothing gang admission (placement groups): the jit'd pass
+    (kernel.admit_gangs) must reproduce the scalar reference bit-for-bit,
+    and no input may ever produce a partially-admitted group."""
+
+    @staticmethod
+    def _mk(seed, max_groups=8, max_size=6, max_nodes=6, R=2):
+        rng = np.random.default_rng(seed)
+        G = int(rng.integers(1, max_groups))
+        sizes = [int(rng.integers(1, max_size)) for _ in range(G)]
+        group = np.concatenate(
+            [[g] * s for g, s in zip(range(G), sizes)]).astype(np.int32)
+        demand = rng.integers(0, 900, size=(len(group), R)).astype(np.int32)
+        strategy = rng.integers(0, 4, size=G).astype(np.int32)
+        N = int(rng.integers(1, max_nodes))
+        avail = rng.integers(100, 2000, size=(N, R)).astype(np.int32)
+        return demand, group, strategy, avail
+
+    @staticmethod
+    def _both(demand, group, strategy, avail, seed=0, round_idx=0):
+        from ray_tpu.scheduler.kernel import admit_gangs_host
+        from ray_tpu.scheduler.reference import admit_gangs_reference
+
+        key = jax.random.PRNGKey(seed)
+        kp = admit_gangs_host(demand, group, strategy, avail, key,
+                              round_idx=round_idx)
+        rp = admit_gangs_reference(demand, group, strategy, avail, key,
+                                   round_idx=round_idx)
+        return kp, rp
+
+    @pytest.mark.parametrize("seed", list(range(12)))
+    def test_random_mixes_bit_identical(self, seed):
+        demand, group, strategy, avail = self._mk(seed)
+        kp, rp = self._both(demand, group, strategy, avail, seed=seed,
+                            round_idx=seed % 5)
+        np.testing.assert_array_equal(kp, rp)
+
+    @pytest.mark.parametrize("seed", [0, 3, 7])
+    def test_adversarial_fragmentation_bit_identical(self, seed):
+        # Big gangs interleaved with near-capacity bundles on few nodes:
+        # the shape that stresses the shared-prefix admission hardest.
+        rng = np.random.default_rng(seed)
+        sizes = [4, 1, 3, 1, 4, 2]
+        group = np.concatenate(
+            [[g] * s for g, s in zip(range(len(sizes)), sizes)])
+        demand = np.where(
+            (np.arange(len(group)) % 2 == 0)[:, None], 700,
+            rng.integers(50, 400, size=(len(group), 1))).astype(np.int32)
+        strategy = np.asarray([0, 1, 3, 2, 1, 0], np.int32)
+        avail = np.full((3, 1), 1000, np.int32)
+        kp, rp = self._both(demand, group.astype(np.int32), strategy,
+                            avail, seed=seed)
+        np.testing.assert_array_equal(kp, rp)
+
+    def test_all_or_nothing_and_capacity(self):
+        from ray_tpu.scheduler.reference import admit_gangs_reference
+
+        for seed in range(20):
+            demand, group, strategy, avail = self._mk(seed + 100)
+            p = admit_gangs_reference(demand, group, strategy, avail,
+                                      jax.random.PRNGKey(seed))
+            used = np.zeros_like(avail, dtype=np.int64)
+            for g in range(int(group.max()) + 1):
+                idxs = np.nonzero(group == g)[0]
+                states = {int(p[i]) for i in idxs}
+                # never a mix of placed and unplaced bundles
+                assert states <= {NO_PLACEMENT} or states <= {INFEASIBLE} \
+                    or all(v >= 0 for v in states), (seed, g, states)
+                for i in idxs:
+                    if p[i] >= 0:
+                        used[p[i]] += demand[i]
+                if int(strategy[g]) == 3 and all(p[i] >= 0 for i in idxs):
+                    assert len({int(p[i]) for i in idxs}) == len(idxs)
+            assert (used <= avail).all(), seed
+
+    def test_strict_pack_single_node(self):
+        demand = np.full((3, 1), 300, np.int32)
+        group = np.zeros(3, np.int32)
+        strategy = np.asarray([2], np.int32)  # STRICT_PACK
+        avail = np.asarray([[500], [1000]], np.int32)
+        kp, rp = self._both(demand, group, strategy, avail)
+        np.testing.assert_array_equal(kp, rp)
+        assert (kp >= 0).all()
+        assert len(set(kp.tolist())) == 1          # one node holds all
+        assert kp[0] == 1                          # the only node that fits
+
+    def test_strict_spread_more_bundles_than_nodes_is_infeasible(self):
+        # INFEASIBLE, not a hang or a silent defer — both implementations.
+        demand = np.full((3, 1), 100, np.int32)
+        group = np.zeros(3, np.int32)
+        strategy = np.asarray([3], np.int32)  # STRICT_SPREAD
+        avail = np.full((2, 1), 1000, np.int32)
+        kp, rp = self._both(demand, group, strategy, avail)
+        np.testing.assert_array_equal(kp, rp)
+        assert (kp == INFEASIBLE).all()
+
+    def test_infeasible_gang_does_not_starve_feasible_gang_behind_it(self):
+        # Group 0 can never fit (bundle > any node); group 1 fits. The
+        # infeasible gang contributes NOTHING to the admission prefix, so
+        # group 1 must be admitted in the same pass.
+        demand = np.asarray([[5000], [5000], [200], [200]], np.int32)
+        group = np.asarray([0, 0, 1, 1], np.int32)
+        strategy = np.asarray([0, 0], np.int32)
+        avail = np.full((2, 1), 1000, np.int32)
+        kp, rp = self._both(demand, group, strategy, avail)
+        np.testing.assert_array_equal(kp, rp)
+        assert (kp[:2] < 0).all()
+        assert (kp[2:] >= 0).all()
+
+    def test_deferred_gang_admits_on_later_round(self):
+        # Two strict-spread bundles on 2 nodes where only one rotation is
+        # feasible: some round must admit (fresh draw per round).
+        from ray_tpu.scheduler.reference import admit_gangs_reference
+
+        demand = np.asarray([[900], [100]], np.int32)
+        group = np.asarray([0, 0], np.int32)
+        strategy = np.asarray([3], np.int32)
+        avail = np.asarray([[1000], [150]], np.int32)
+        key = jax.random.PRNGKey(0)
+        admitted_round = None
+        for r in range(8):
+            p = admit_gangs_reference(demand, group, strategy, avail, key,
+                                      round_idx=r)
+            if (p >= 0).all():
+                admitted_round = r
+                assert p[0] == 0 and p[1] == 1  # only feasible assignment
+                break
+        assert admitted_round is not None

@@ -440,3 +440,61 @@ class TestInlineResultFrames:
                     continue
                 except Exception as e:  # noqa: BLE001
                     pytest.fail(f"non-WireError escaped decode: {e!r}")
+
+
+class TestPlacementGroupFrames:
+    """Placement-group control frames (create / remove / status)."""
+
+    def test_pg_create_round_trip(self):
+        msg = {"type": "create_placement_group", "pg_id": b"\x01" * 8,
+               "strategy": "STRICT_SPREAD", "name": "trainers",
+               "bundles": [{"CPU": 2.0, "TPU": 4.0}, {"CPU": 1.5}]}
+        out = _rt(msg)
+        assert out["type"] == "create_placement_group"
+        assert out["pg_id"] == msg["pg_id"]
+        assert out["strategy"] == "STRICT_SPREAD"
+        assert out["name"] == "trainers"
+        assert out["bundles"] == msg["bundles"]
+
+    def test_pg_create_unknown_strategy_falls_back_to_pickle(self):
+        assert wire.encode({"type": "create_placement_group",
+                            "pg_id": b"x" * 8, "strategy": "BOGUS",
+                            "bundles": [{"CPU": 1.0}]}) is None
+
+    def test_pg_remove_and_ok_round_trip(self):
+        out = _rt({"type": "remove_placement_group", "pg_id": b"\x02" * 8})
+        assert out["type"] == "remove_placement_group"
+        assert out["pg_id"] == b"\x02" * 8
+        resp = _rt({"ok": True, "removed": True},
+                   req_type="remove_placement_group")
+        assert resp["ok"] and resp["removed"]
+        resp = _rt({"ok": True}, req_type="create_placement_group")
+        assert resp["ok"] and not resp["removed"]
+
+    def test_pg_status_and_response_round_trip(self):
+        out = _rt({"type": "list_placement_groups"})
+        assert out["type"] == "list_placement_groups"
+        groups = {
+            "ab" * 8: {"state": "CREATED", "strategy": "PACK",
+                       "name": "", "reason": "",
+                       "bundles": [{"CPU": 1.0}],
+                       "nodes": ["node-1"]},
+            "cd" * 8: {"state": "PENDING", "strategy": "STRICT_SPREAD",
+                       "name": "mesh", "reason": "infeasible",
+                       "bundles": [{"TPU": 8.0}, {"TPU": 8.0}],
+                       "nodes": []},
+        }
+        resp = _rt({"ok": True, "groups": groups},
+                   req_type="list_placement_groups")
+        assert resp["groups"] == groups
+
+    def test_truncated_pg_frames_raise(self):
+        bufs = wire.encode({"type": "create_placement_group",
+                            "pg_id": b"\x03" * 8, "strategy": "PACK",
+                            "name": "", "bundles": [{"CPU": 1.0}]})
+        body = b"".join(bufs)
+        for cut in (11, len(body) // 2, len(body) - 1):
+            with pytest.raises(wire.WireError):
+                wire.decode(body[:cut])
+        with pytest.raises(wire.WireError):
+            wire.decode(body + b"\x00")
