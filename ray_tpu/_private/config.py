@@ -73,6 +73,18 @@ class Config:
     # --- logging / debug ---
     debug_dump_period_ms: int = 10000
     event_log_enabled: bool = True
+    # Cluster event-log ring size (GCS cluster_events deque; overflow is
+    # counted in events_dropped and surfaced by `cli events`).
+    event_log_size: int = 20_000
+    # --- observability: flight recorder + time-series rollups ---
+    # Continuous stack sampler (env kill switch RAY_TPU_FLIGHT_RECORDER=0;
+    # rate via RAY_TPU_FLIGHT_RECORDER_HZ, default 20).
+    flight_recorder: bool = True
+    # GCS time-series store: fixed bucket width and per-series retention
+    # ring (360 x 10 s = one hour of rollups), rolled every tick.
+    timeseries_bucket_s: int = 10
+    timeseries_retention_buckets: int = 360
+    timeseries_tick_s: float = 2.0
     # --- raw overrides applied last ---
     _overrides: Dict[str, Any] = field(default_factory=dict)
 

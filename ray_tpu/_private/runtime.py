@@ -541,6 +541,14 @@ class LocalRuntime:
         # later cluster init in this process) takes over this thread.
         _LOCAL.ctx.scoped_runtime = self
 
+        if getattr(config, "flight_recorder", True):
+            from . import flight_recorder
+
+            # Local mode samples as "driver" (the only component here);
+            # shutdown() stops the thread so init()/shutdown() cycles
+            # never accumulate samplers.
+            flight_recorder.start("driver")
+
     # -------------------------------------------------------------- refcount
     def add_local_ref(self, oid: ObjectID) -> None:
         with self._ref_lock:
@@ -1154,6 +1162,11 @@ class LocalRuntime:
         self._pool.shutdown(wait=False, cancel_futures=True)
         if self._spiller is not None:
             self._spiller.close(remove=True)
+        from . import flight_recorder
+
+        rec = flight_recorder.get()
+        if rec is not None and rec.component == "driver":
+            flight_recorder.stop()
 
 
 def _sizeof(value: Any) -> int:

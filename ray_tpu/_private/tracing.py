@@ -44,12 +44,45 @@ _metrics_box: Dict[str, Any] = {}
 
 _rate_cache = ("\0unset", _DEFAULT_RATE)
 
+# Runtime override (``cli trace --sample N`` broadcast through the GCS kv,
+# applied by each process's stats/heartbeat poll): takes precedence over
+# the env var so the rate is adjustable on a LIVE cluster without
+# restarting every process. None = no override (env/default applies).
+TRACE_SAMPLE_KV_KEY = "__ray_tpu_trace_sample__"
+_rate_override: Optional[int] = None
+
+
+def set_rate_override(rate: Optional[int]) -> None:
+    """Install (or clear, with None) the cluster-broadcast sampling rate."""
+    global _rate_override
+    _rate_override = max(0, int(rate)) if rate is not None else None
+
+
+def rate_override() -> Optional[int]:
+    return _rate_override
+
+
+def apply_kv_rate(raw: Optional[bytes]) -> None:
+    """Fold the GCS kv cell for TRACE_SAMPLE_KV_KEY into the override
+    (shared by the controller heartbeat and driver stats polls). A missing
+    or unparsable cell clears the override back to env/default."""
+    if raw is None:
+        set_rate_override(None)
+        return
+    try:
+        set_rate_override(int(bytes(raw).decode()))
+    except (ValueError, UnicodeDecodeError):
+        set_rate_override(None)
+
 
 def sample_rate() -> int:
-    """1-in-N sampling rate from ``RAY_TPU_TRACE_SAMPLE`` (0 = off). The
-    env var is re-read per call (tests monkeypatch it) but parsed once
-    per distinct value — this runs on the per-task submit hot path."""
+    """1-in-N sampling rate (0 = off): the kv-broadcast runtime override
+    when one is installed, else ``RAY_TPU_TRACE_SAMPLE``. The env var is
+    re-read per call (tests monkeypatch it) but parsed once per distinct
+    value — this runs on the per-task submit hot path."""
     global _rate_cache
+    if _rate_override is not None:
+        return _rate_override
     raw = os.environ.get("RAY_TPU_TRACE_SAMPLE", "")
     cached = _rate_cache
     if cached[0] == raw:

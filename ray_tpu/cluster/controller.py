@@ -216,12 +216,23 @@ class NodeController:
         _cring.sweep_stale_rings()
         for _ in range(self.num_workers):
             self._spawn_worker()
+        if getattr(self.config, "flight_recorder", True):
+            from .._private import flight_recorder
+
+            # Worker-node processes sample as "controller"; the head's
+            # colocated controller thread shares the GCS's sampler.
+            flight_recorder.start("controller")
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
         return port
 
     async def stop(self):
         self._shutting_down = True
+        from .._private import flight_recorder
+
+        rec = flight_recorder.get()
+        if rec is not None and rec.component == "controller":
+            flight_recorder.stop()  # never a sampler another role started
         for t in self._tasks:
             t.cancel()
         for w in self.workers.values():
@@ -290,12 +301,14 @@ class NodeController:
                          name=f"logpump-{proc.pid}").start()
 
     async def _heartbeat_loop(self):
+        from .._private import flight_recorder, tracing
         from .._private.node_stats import NodeStatsSampler
 
         interval = self.config.heartbeat_interval_ms / 1000.0
         last_refresh = 0.0
         last_report = 0.0
         sampler = NodeStatsSampler()
+        trace_kv_last: Any = ("\0unset",)  # sentinel != any kv value
         while True:
             await asyncio.sleep(interval)
             try:
@@ -328,9 +341,48 @@ class NodeController:
                     last_report = now
                     stats = sampler.sample([os.getpid(), *self.workers])
                     stats["store"] = self.store.stats()
+                    # Handler stats ride along so the GCS's time-series
+                    # rollups see controller-side counters too.
+                    stats["handler_stats"] = {
+                        k: list(v)
+                        for k, v in self.server.handler_stats.items()}
+                    rec = flight_recorder.get()
+                    if rec is not None:
+                        # Flight-recorder drain piggybacks on the report
+                        # (the sampler needs no connection of its own).
+                        stacks = rec.drain()
+                        if stacks:
+                            stats["stacks"] = stacks
+                            stats["stack_component"] = rec.component
+                            stats["stack_samples"] = sum(stacks.values())
+                            flight_recorder.flush_metrics(
+                                rec, stats["stack_samples"])
                     self._gcs.send_oneway({"type": "node_stats",
                                            "node_id": self.node_id,
                                            "stats": stats})
+                    # Runtime-adjustable trace sampling: `cli trace
+                    # --sample N` writes the GCS kv; every node polls it on
+                    # the stats cadence and rebroadcasts changes to its
+                    # workers (nested submissions sample there too).
+                    try:
+                        resp = await asyncio.to_thread(
+                            self._gcs.call,
+                            {"type": "kv_get",
+                             "key": tracing.TRACE_SAMPLE_KV_KEY})
+                        raw = resp.get("value")
+                    except Exception:  # noqa: BLE001 - next poll retries
+                        raw = trace_kv_last
+                    if raw != trace_kv_last:
+                        trace_kv_last = raw
+                        tracing.apply_kv_rate(raw)
+                        for w in self.workers.values():
+                            if w.conn is not None:
+                                try:
+                                    w.conn.send_nowait(
+                                        {"type": "set_trace_sample",
+                                         "raw": raw})
+                                except Exception:  # noqa: BLE001
+                                    pass
             except ConnectionError:
                 return
 

@@ -160,6 +160,13 @@ class ClusterCoreWorker:
         self._ref_flusher: Any = None
         self._ref_refresher: Any = None
         self._ref_shutdown = threading.Event()
+        # Driver-side observability flush (flight-recorder drains, result-
+        # path counter deltas, phase-histogram deltas to the GCS
+        # time-series, trace-sample kv poll) — see _stats_flush_loop.
+        self._stats_stop = threading.Event()
+        self._stats_thread: Any = None
+        self._stats_counter_last: Dict[str, float] = {}
+        self._stats_hist_last: Dict[str, Dict] = {}
         if role == "driver":
             self._subscribe_logs()
             try:
@@ -168,6 +175,14 @@ class ClusterCoreWorker:
                 self._home_controller()
             except Exception:  # noqa: BLE001 - no nodes yet; attach lazily
                 pass
+            if getattr(self.config, "flight_recorder", True):
+                from .._private import flight_recorder
+
+                flight_recorder.start("driver")
+            self._stats_thread = threading.Thread(
+                target=self._stats_flush_loop, daemon=True,
+                name="driver-stats-flush")
+            self._stats_thread.start()
 
     # ------------------------------------------------------------- refcount
     def add_local_ref(self, oid) -> None:
@@ -398,6 +413,82 @@ class ClusterCoreWorker:
             self.trace_spans.append(sp)
             if len(self.trace_spans) > 50_000:
                 del self.trace_spans[:10_000]
+
+    # ------------------------------------------------ driver stats flush
+    def _stats_deltas(self) -> Tuple[Dict[str, float], Dict[str, Dict]]:
+        """Per-flush deltas of the driver's phase/result counters and the
+        trace_phase_ms histogram — the GCS time-series merges deltas
+        additively, so each flush ships only what happened since the last."""
+        from ..metrics import histogram_cells
+
+        counters: Dict[str, float] = {}
+        for name, cell in list(self.phase_stats.items()):
+            if name.startswith("result:"):
+                pairs = [(name, float(cell[0]))]
+            else:
+                # Driver-side phases join the GCS-side phase_* series so
+                # the time-series holds the full 7-phase view.
+                pairs = [(f"phase_count:{name}", float(cell[0])),
+                         (f"phase_seconds:{name}", cell[1])]
+            for key, cur in pairs:
+                last = self._stats_counter_last.get(key, 0.0)
+                if cur > last:
+                    counters[key] = cur - last
+                self._stats_counter_last[key] = cur
+        hists: Dict[str, Dict] = {}
+        for tags, cell in histogram_cells("trace_phase_ms").items():
+            phase = dict(tags).get("phase") or "unknown"
+            name = f"trace_phase_ms:{phase}"
+            last = self._stats_hist_last.get(name, {})
+            delta_buckets = {
+                bound: n - last.get("buckets", {}).get(bound, 0)
+                for bound, n in cell["buckets"].items()
+                if n - last.get("buckets", {}).get(bound, 0) > 0}
+            if delta_buckets:
+                hists[name] = {
+                    "buckets": delta_buckets,
+                    "sum": cell["sum"] - last.get("sum", 0.0),
+                    "count": cell["count"] - last.get("count", 0)}
+            self._stats_hist_last[name] = cell
+        return counters, hists
+
+    def _stats_flush_loop(self) -> None:
+        from .._private import flight_recorder, tracing
+
+        trace_kv_last: Any = ("\0unset",)
+        while not self._stats_stop.wait(2.0):
+            try:
+                msg: Dict[str, Any] = {"type": "driver_stats",
+                                       "worker": self.worker_uid}
+                counters, hists = self._stats_deltas()
+                if counters:
+                    msg["counters"] = counters
+                if hists:
+                    msg["hists"] = hists
+                rec = flight_recorder.get()
+                if rec is not None:
+                    stacks = rec.drain()
+                    if stacks:
+                        msg["stacks"] = stacks
+                        msg["component"] = rec.component
+                        msg["samples"] = sum(stacks.values())
+                        flight_recorder.flush_metrics(rec, msg["samples"])
+                if len(msg) > 2:
+                    self.gcs.send_oneway(msg)
+                # Runtime-adjustable trace sampling: the driver makes the
+                # per-task sampling decision, so it polls the kv cell
+                # `cli trace --sample` writes.
+                resp = self.gcs.call(
+                    {"type": "kv_get",
+                     "key": tracing.TRACE_SAMPLE_KV_KEY}, timeout=5.0)
+                raw = resp.get("value")
+                if raw != trace_kv_last:
+                    trace_kv_last = raw
+                    tracing.apply_kv_rate(raw)
+            except (ConnectionError, OSError):
+                continue  # GCS restart window: next tick retries
+            except Exception:  # noqa: BLE001 - observability never kills
+                continue
 
     def _phase_add(self, name: str, seconds: float, n: int = 1) -> None:
         """Accumulate one phase-profiler cell (GIL-tolerant; a lost sample
@@ -1839,6 +1930,24 @@ class ClusterCoreWorker:
             msg["limit"] = int(limit)
         return self.gcs.call(msg)["spans"]
 
+    def cluster_timeseries(self, last: Optional[int] = 60,
+                           names: Optional[list] = None) -> Dict[str, Any]:
+        """Rollup snapshot from the GCS time-series store (`cli top`,
+        dashboard sparklines): {bucket_s, series, driver_totals, ...}."""
+        msg: Dict[str, Any] = {"type": "get_timeseries"}
+        if last:
+            msg["last"] = int(last)
+        if names:
+            msg["names"] = list(names)
+        return self.gcs.call(msg)
+
+    def cluster_profile_stacks(self, component: Optional[str] = None):
+        """Cumulative flight-recorder folded-stack counts per component."""
+        msg: Dict[str, Any] = {"type": "get_profile_stacks"}
+        if component:
+            msg["component"] = component
+        return self.gcs.call(msg)["components"]
+
     def cluster_events(self, limit: Optional[int] = None,
                        kind: Optional[str] = None):
         """Structured lifecycle events from the GCS cluster event log."""
@@ -1852,6 +1961,18 @@ class ClusterCoreWorker:
     def shutdown(self):
         self._flush_submits()
         self._release_all_leases()
+        self._stats_stop.set()
+        if self._stats_thread is not None:
+            self._stats_thread.join(timeout=0.5)
+            self._stats_thread = None
+        from .._private import flight_recorder
+
+        rec = flight_recorder.get()
+        if rec is not None and self.role == "driver" \
+                and rec.component == "driver":
+            # Sampler thread must not outlive the runtime (init()/
+            # shutdown() cycles restart it; pinned by tests).
+            flight_recorder.stop()
         self._ref_shutdown.set()
         self._ref_dirty.set()  # unblock the flusher so it can exit
         self._flush_refs()

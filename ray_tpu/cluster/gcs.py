@@ -91,8 +91,36 @@ class GcsServer:
         self.trace_events: Any = _deque(maxlen=200_000)
         # Cluster event log: structured lifecycle events (node up/down,
         # task retry/reconstruct, actor restart, spill/restore,
-        # backpressure) queryable via get_events / `cli events`.
-        self.cluster_events: Any = _deque(maxlen=20_000)
+        # backpressure) queryable via get_events / `cli events`. Ring size
+        # is a config knob (RAY_TPU_EVENT_LOG_SIZE); overflow evictions are
+        # COUNTED (events_dropped, Prometheus-visible) instead of silent.
+        self.cluster_events: Any = _deque(
+            maxlen=max(int(getattr(config, "event_log_size", 20_000)), 1))
+        self.events_dropped = 0
+        # Cumulative event count per kind (feeds the time-series rollups
+        # and the SLO error-rate rule without scanning the ring).
+        self._event_counts: Dict[str, int] = {}
+        # ---- flight recorder + time-series store (the observability
+        # substrate ROADMAP items 3 and 5 read). profile_stacks: component
+        # (gcs / controller / worker / driver) -> folded stack -> cumulative
+        # samples, merged from every process's recorder drain (`cli
+        # profile` snapshot-diffs it). timeseries: fixed-resolution rollups
+        # of every counter/gauge/histogram stream reaching the GCS
+        # (`/api/timeseries`, `cli top`, monitor SLO rules).
+        from .._private.timeseries import TimeSeriesStore
+
+        self.profile_stacks: Dict[str, Dict[str, int]] = {}
+        self.profile_stack_samples: Dict[str, int] = {}
+        self.timeseries = TimeSeriesStore(
+            bucket_s=float(getattr(config, "timeseries_bucket_s", 10)),
+            retention_buckets=int(getattr(
+                config, "timeseries_retention_buckets", 360)))
+        # Cumulative-source watermarks for delta rollups (handler stats,
+        # event counts): name -> last value folded into the store.
+        self._ts_last: Dict[str, float] = {}
+        # Last driver-reported cumulative counters (result-path mix etc.),
+        # keyed by worker uid — summed for `cli top`'s totals row.
+        self._driver_counters: Dict[str, Dict[str, float]] = {}
         # ---- GCS-owned task lifecycle (reference: owner-side TaskManager
         # task_manager.h:57 + lineage; centralized here because placement
         # already is). task_table: task_id -> record; lineage: object_id ->
@@ -186,7 +214,20 @@ class GcsServer:
 
     def record_event(self, kind: str, **data) -> None:
         """Append one structured lifecycle event to the cluster event log.
-        Values must stay JSON-serializable (the dashboard serves them)."""
+        Values must stay JSON-serializable (the dashboard serves them).
+        A full ring evicts the oldest event — counted, not silent."""
+        self._event_counts[kind] = self._event_counts.get(kind, 0) + 1
+        if len(self.cluster_events) == self.cluster_events.maxlen:
+            self.events_dropped += 1
+            try:
+                from ..metrics import Count, get_or_create
+
+                get_or_create(
+                    Count, "cluster_events_dropped",
+                    description="cluster events evicted from the full "
+                                "event-log ring").record(1.0)
+            except Exception:  # noqa: BLE001 - metrics never fail control
+                pass
         self.cluster_events.append(
             {"ts": time.time(), "kind": kind, **data})
 
@@ -277,6 +318,13 @@ class GcsServer:
         self._tasks.append(asyncio.create_task(self._placement_loop()))
         self._tasks.append(asyncio.create_task(self._pg_loop()))
         self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
+        self._tasks.append(asyncio.create_task(self._stats_loop()))
+        if getattr(self.config, "flight_recorder", True):
+            from .._private import flight_recorder
+
+            # The head process's ONE sampler (a colocated controller
+            # thread shares it); samples merge under component "gcs".
+            flight_recorder.start("gcs")
         if any(r["state"] in ("PENDING", "RESCHEDULING")
                for r in self.placement_groups.values()):
             self._pg_event.set()
@@ -287,6 +335,13 @@ class GcsServer:
     async def stop(self):
         for t in self._tasks:
             t.cancel()
+        from .._private import flight_recorder
+
+        rec = flight_recorder.get()
+        if rec is not None and rec.component == "gcs":
+            # Only the sampler THIS server started: an in-process GCS
+            # (sim runs, unit tests) must not kill the host driver's.
+            flight_recorder.stop()
         if self.persist_path:
             self._write_snapshot()
             self._storage.close()
@@ -380,6 +435,99 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 # One failed snapshot must not end persistence for good.
                 continue
+
+    # ------------------------------------- flight recorder + time-series
+    _STACKS_PER_COMPONENT = 20_000
+
+    def merge_profile_stacks(self, component: str, stacks: Dict[str, int],
+                             samples: int = 0) -> None:
+        """Fold one recorder drain into the profile-stacks table. Bounded:
+        past the per-component cap, NEW stacks collapse into an overflow
+        key (known stacks keep accumulating — the hot ones, by
+        construction, already exist)."""
+        if not stacks:
+            return
+        table = self.profile_stacks.setdefault(component, {})
+        for key, n in stacks.items():
+            if key not in table and len(table) >= self._STACKS_PER_COMPONENT:
+                key = "<overflow>"
+            table[key] = table.get(key, 0) + int(n)
+        self.profile_stack_samples[component] = \
+            self.profile_stack_samples.get(component, 0) + int(samples)
+
+    def _roll_cum(self, series: str, current: float) -> None:
+        """Fold a cumulative source (handler-stat cell, event counter) into
+        the time-series store as this tick's delta. Sources share this
+        process's lifetime, so the implicit baseline is 0 — work done
+        before the first tick still lands; a backwards jump (a source
+        reset) re-baselines instead of recording a negative burst."""
+        last = self._ts_last.get(series, 0.0)
+        self._ts_last[series] = current
+        if current > last:
+            self.timeseries.add_delta(series, current - last)
+
+    def _roll_timeseries_tick(self) -> None:
+        """One rollup pass: every counter/gauge stream the GCS can see
+        becomes an aligned bucket sample. Runs on the event loop (dict
+        reads only; the store's own lock covers concurrent RPC reads)."""
+        stats = self.server.handler_stats
+        for key, cell in list(stats.items()):
+            if key.startswith("phase:"):
+                name = key[len("phase:"):]
+                self._roll_cum(f"phase_count:{name}", cell[0])
+                self._roll_cum(f"phase_seconds:{name}", cell[1])
+        worker_exec = stats.get("phase:worker_exec")
+        if worker_exec is not None:
+            # Completed task items — the tasks/s numerator `cli top` and
+            # the SLO throughput floor read.
+            self._roll_cum("tasks_finished", worker_exec[0])
+        for kind, n in list(self._event_counts.items()):
+            self._roll_cum(f"events:{kind}", n)
+        self._roll_cum("events_dropped", self.events_dropped)
+        alive = [n for n in self.nodes.values() if n.alive]
+        self.timeseries.add_gauge("nodes_alive", len(alive))
+        cpus = [st.get("cpu_percent") for st in self.node_stats.values()
+                if isinstance(st.get("cpu_percent"), (int, float))]
+        if cpus:
+            self.timeseries.add_gauge("node_cpu_percent_mean",
+                                      sum(cpus) / len(cpus))
+        mems = [st.get("mem_percent") for st in self.node_stats.values()
+                if isinstance(st.get("mem_percent"), (int, float))]
+        if mems:
+            self.timeseries.add_gauge("node_mem_percent_mean",
+                                      sum(mems) / len(mems))
+        if self.placement_groups:
+            by_state: Dict[str, int] = {}
+            for rec in self.placement_groups.values():
+                by_state[rec["state"]] = by_state.get(rec["state"], 0) + 1
+            for state, n in by_state.items():
+                self.timeseries.add_gauge(f"pg_state:{state}", n)
+        self.timeseries.add_gauge("objects_in_directory", len(self.objects))
+        self.timeseries.add_gauge("tasks_in_table", len(self.task_table))
+
+    async def _stats_loop(self):
+        """Periodic observability tick: drain this process's stack sampler
+        into the profile-stacks table and roll the time-series buckets."""
+        from .._private import flight_recorder
+
+        tick = float(getattr(self.config, "timeseries_tick_s", 2.0))
+        while True:
+            await asyncio.sleep(tick)
+            try:
+                rec = flight_recorder.get()
+                if rec is not None:
+                    stacks = rec.drain()
+                    if stacks:
+                        self.merge_profile_stacks(
+                            rec.component, stacks,
+                            samples=sum(stacks.values()))
+                        flight_recorder.flush_metrics(
+                            rec, sum(stacks.values()))
+                self._roll_timeseries_tick()
+            except Exception:  # noqa: BLE001 - observability never kills GCS
+                import traceback
+
+                traceback.print_exc()
 
     # ----------------------------------------------------- task lifecycle
     def _spawn(self, coro) -> None:
@@ -2387,9 +2535,84 @@ class GcsServer:
         @s.handler("node_stats")
         async def node_stats(msg, conn):
             """Latest physical stats per node (reference: the reporter ->
-            dashboard datapath)."""
-            self.node_stats[msg["node_id"]] = msg["stats"]
+            dashboard datapath). Stats may piggyback a flight-recorder
+            drain ("stacks") — merged into the profile-stacks table here so
+            the sampler needs no connection of its own."""
+            stats = msg["stats"]
+            stacks = stats.pop("stacks", None)
+            if stacks:
+                self.merge_profile_stacks(
+                    stats.pop("stack_component", "controller"), stacks,
+                    samples=stats.pop("stack_samples", 0) or
+                    sum(stacks.values()))
+            self.node_stats[msg["node_id"]] = stats
             return None
+
+        @s.handler("add_profile_stacks")
+        async def add_profile_stacks(msg, conn):
+            """Flight-recorder drain from a worker/driver process (binary
+            PROFILE_STACKS frame or pickle)."""
+            self.merge_profile_stacks(
+                str(msg.get("component") or "worker"),
+                msg.get("stacks") or {},
+                samples=int(msg.get("samples") or 0))
+            return None  # one-way
+
+        @s.handler("get_profile_stacks")
+        async def get_profile_stacks(msg, conn):
+            """Cumulative folded-stack counts per component. `cli profile`
+            snapshot-diffs two of these into a windowed self-time table."""
+            want = msg.get("component")
+            comps = ([want] if want else sorted(self.profile_stacks)) or []
+            return {"ok": True, "components": {
+                c: {"stacks": dict(self.profile_stacks.get(c, {})),
+                    "samples": self.profile_stack_samples.get(c, 0)}
+                for c in comps if c in self.profile_stacks
+            }}
+
+        @s.handler("driver_stats")
+        async def driver_stats(msg, conn):
+            """Periodic driver-side flush: result-path counter deltas and
+            phase-histogram deltas roll into the time-series (drivers are
+            the only place ring/inline delivery is visible), cumulative
+            totals are kept for `cli top`, and a recorder drain may ride
+            along."""
+            worker = str(msg.get("worker") or "")
+            for name, delta in (msg.get("counters") or {}).items():
+                if delta:
+                    self.timeseries.add_delta(str(name), float(delta))
+                totals = self._driver_counters.setdefault(worker, {})
+                totals[str(name)] = totals.get(str(name), 0.0) \
+                    + float(delta)
+            while len(self._driver_counters) > 256:
+                self._driver_counters.pop(next(iter(self._driver_counters)))
+            for name, h in (msg.get("hists") or {}).items():
+                self.timeseries.add_hist(
+                    str(name), h.get("buckets") or {},
+                    total=float(h.get("sum") or 0.0),
+                    count=int(h.get("count") or 0))
+            stacks = msg.get("stacks")
+            if stacks:
+                self.merge_profile_stacks(
+                    str(msg.get("component") or "driver"), stacks,
+                    samples=int(msg.get("samples") or 0))
+            return None  # one-way
+
+        @s.handler("get_timeseries")
+        async def get_timeseries(msg, conn):
+            """Rollup snapshot for `cli top`, the dashboard sparklines and
+            the monitor's SLO engine. Optional ``names`` filter and
+            ``last`` (newest N buckets per series)."""
+            totals: Dict[str, float] = {}
+            for per in self._driver_counters.values():
+                for name, v in per.items():
+                    totals[name] = totals.get(name, 0.0) + v
+            return {"ok": True,
+                    "bucket_s": self.timeseries.bucket_s,
+                    "series": self.timeseries.snapshot(
+                        names=msg.get("names"), last=msg.get("last")),
+                    "driver_totals": totals,
+                    "events_dropped": self.events_dropped}
 
         @s.handler("get_node_stats")
         async def get_node_stats(msg, conn):
@@ -2651,7 +2874,10 @@ class GcsServer:
                 out.append(ev)
                 if len(out) >= limit:
                     break
-            return {"ok": True, "events": out[::-1]}
+            return {"ok": True, "events": out[::-1],
+                    "dropped": self.events_dropped,
+                    "capacity": self.cluster_events.maxlen,
+                    "total_logged": sum(self._event_counts.values())}
 
         @s.handler("list_objects")
         async def list_objects(msg, conn):

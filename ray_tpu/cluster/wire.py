@@ -51,10 +51,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MAGIC = 0xBF
 # v2 adds the inline-result frames (TASK_DONE2 / TASK_DONE_BATCH2 and the
-# _LOC_INLINE location flag). Senders emit them only to peers that
-# advertised wire >= 2; everything else still goes out as v1 frames or
-# pickle, so v1/pickle-only peers interoperate per-message.
-WIRE_VERSION = 2
+# _LOC_INLINE location flag); v3 adds the PROFILE_STACKS stats frame.
+# Senders emit each frame only to peers that advertised a wire version
+# that can parse it; everything else still goes out as older frames or
+# pickle, so mixed-version peers interoperate per-message.
+WIRE_VERSION = 3
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -81,6 +82,10 @@ PG_REMOVE = 0x0F
 PG_STATUS = 0x10
 PG_OK = 0x11
 PG_STATUS_RESP = 0x12
+# Stats frame: a flight-recorder drain (folded stacks + counts) shipped to
+# the GCS profile-stacks table on the 2 s stats cadence. Framed so the
+# periodic observability traffic never re-enters pickle on busy links.
+PROFILE_STACKS = 0x13
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 _PG_STATES = ("PENDING", "CREATED", "RESCHEDULING", "REMOVED")
@@ -714,6 +719,39 @@ def _dec_pg_ok(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "removed": bool(removed), "rpc_id": rpc_id}
 
 
+def _enc_profile_stacks(msg, peer_wire: int = WIRE_VERSION
+                        ) -> Optional[List[bytes]]:
+    stacks = msg.get("stacks") or {}
+    if peer_wire < 3 or len(stacks) > 0xFFFF:
+        # Pre-v3 peer (can't parse 0x13) or an absurd drain: pickle
+        # carries it instead.
+        return None
+    out = [_head(PROFILE_STACKS, msg.get("rpc_id")),
+           _s(msg.get("component") or ""),
+           _U32.pack(int(msg.get("samples") or 0)),
+           _U16.pack(len(stacks))]
+    for stack, n in stacks.items():
+        if len(stack) > 0xFFF0:
+            # One pathological stack must not fail the whole drain.
+            stack = stack[-0xFF00:]
+        out.append(_s(stack))
+        out.append(_U32.pack(int(n)))
+    return out
+
+
+def _dec_profile_stacks(r: _Reader, rpc_id) -> Dict[str, Any]:
+    component = r.s()
+    samples = r.u32()
+    n = r.count(r.u16())
+    stacks = {}
+    for _ in range(n):
+        key = r.s()
+        stacks[key] = stacks.get(key, 0) + r.u32()
+    r.done()
+    return {"type": "add_profile_stacks", "component": component,
+            "samples": samples, "stacks": stacks, "rpc_id": rpc_id}
+
+
 def _enc_pg_status_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     groups = msg.get("groups", {})
     out = [_head(PG_STATUS_RESP, msg.get("rpc_id")),
@@ -768,6 +806,7 @@ _ENCODERS = {
     "create_placement_group": _enc_pg_create,
     "remove_placement_group": _enc_pg_remove,
     "list_placement_groups": _enc_pg_status,
+    "add_profile_stacks": _enc_profile_stacks,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -799,6 +838,7 @@ _DECODERS = {
     PG_STATUS: _dec_pg_status,
     PG_OK: _dec_pg_ok,
     PG_STATUS_RESP: _dec_pg_status_resp,
+    PROFILE_STACKS: _dec_profile_stacks,
 }
 
 

@@ -58,6 +58,14 @@ def main():
 
     def on_push(msg: Dict) -> None:
         mtype = msg.get("type")
+        if mtype == "set_trace_sample":
+            # Runtime-adjustable sampling (cli trace --sample): the
+            # controller rebroadcasts the GCS kv cell; nested submissions
+            # from task code sample at the new rate.
+            from ray_tpu._private import tracing
+
+            tracing.apply_kv_rate(msg.get("raw"))
+            return
         if mtype == "revoke_execute":
             tid = msg.get("task_id")
             with revoke_lock:
@@ -112,6 +120,12 @@ def main():
     controller.peer_wire = peer_wire
     core._controller((chost, int(cport))).peer_wire = peer_wire
 
+    # Continuous stack sampler: this worker's wall-clock profile, drained
+    # to the GCS profile-stacks table on the flush cadence below.
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.start("worker")
+
     # Periodic profile-span flush to the GCS (reference: profiling.cc's
     # batched AddProfileData timer).
     def flush_loop():
@@ -121,6 +135,16 @@ def main():
             _time.sleep(2.0)
             try:
                 core.flush_events()
+                rec = flight_recorder.get()
+                if rec is not None:
+                    stacks = rec.drain()
+                    if stacks:
+                        n = sum(stacks.values())
+                        core.gcs.send_oneway(
+                            {"type": "add_profile_stacks",
+                             "component": rec.component,
+                             "samples": n, "stacks": stacks})
+                        flight_recorder.flush_metrics(rec, n)
             except Exception:  # noqa: BLE001 - shutdown race
                 return
 

@@ -75,12 +75,28 @@ function laneView(events) {
   }
   return h;
 }
+function spark(vals) {
+  // Unicode block sparkline over the newest buckets.
+  const blocks = "▁▂▃▄▅▆▇█";
+  if (!vals || !vals.length) return "";
+  const lo = Math.min(...vals), hi = Math.max(...vals);
+  const span = hi - lo;
+  return vals.map(v => blocks[span > 0 ?
+    Math.round((v - lo) / span * (blocks.length - 1)) : 0]).join("");
+}
+function seriesValues(s) {
+  // One number per bucket: delta cells -> sum, gauges -> last,
+  // histograms -> event count.
+  return (s.points || []).map(([, c]) =>
+    s.kind === "gauge" ? c.last : (s.kind === "hist" ? c.count : c.sum));
+}
 async function refresh() {
   const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
-         timeline, events, traces, pgs] =
+         timeline, events, traces, pgs, timeseries] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
-       "memory","serve","timeline","events","traces","pgs"].map(
+       "memory","serve","timeline","events","traces","pgs",
+       "timeseries"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th>" +
@@ -149,6 +165,37 @@ async function refresh() {
          `<td class=num>${m.contained_children}</td>` +
          `<td>${m.in_directory}</td></tr>`;
   h += "</table>";
+  // time-series sparklines (GCS 10s rollups): throughput, phase load,
+  // node utilization, pg states — the trend view `cli top` renders live.
+  const tsSeries = Object.entries((timeseries || {}).series || {});
+  const bucketS = (timeseries || {}).bucket_s || 10;
+  h += `<h2>time series (${tsSeries.length} series, ${bucketS}s buckets)</h2>`;
+  if (tsSeries.length) {
+    h += "<table><tr><th>series</th><th>kind</th><th>latest</th>" +
+         "<th>trend</th></tr>";
+    const order = ["tasks_finished", "node_cpu_percent_mean",
+                   "node_mem_percent_mean", "nodes_alive",
+                   "objects_in_directory"];
+    tsSeries.sort((a, b) => {
+      const ia = order.indexOf(a[0]), ib = order.indexOf(b[0]);
+      return (ia < 0 ? 99 : ia) - (ib < 0 ? 99 : ib) ||
+             a[0].localeCompare(b[0]);
+    });
+    for (const [name, s] of tsSeries.slice(0, 24)) {
+      const vals = seriesValues(s);
+      const latest = vals.length ? vals[vals.length - 1] : 0;
+      const shown = name === "tasks_finished"
+        ? `${(latest / bucketS).toFixed(1)}/s` : latest.toFixed(1);
+      h += `<tr><td>${esc(name)}</td><td>${esc(s.kind)}</td>` +
+           `<td class=num>${shown}</td>` +
+           `<td style="font-size:14px;letter-spacing:1px">` +
+           `${spark(vals)}</td></tr>`;
+    }
+    h += "</table>";
+    const dropped = (timeseries || {}).events_dropped || 0;
+    if (dropped) h += `<div style="color:#f66">${dropped} cluster events ` +
+                      `dropped (ring full)</div>`;
+  } else h += "<i>no rollups yet (cluster mode only)</i>";
   // task/placement timeline lanes (chrome-trace events, one lane per
   // worker/actor — placement-kernel behavior visually inspectable)
   h += "<h2>timeline</h2>" + laneView(Array.isArray(timeline) ? timeline : []);
@@ -245,6 +292,16 @@ def _collect(endpoint: str):
         from ..metrics import collect_all
 
         return collect_all()
+    if endpoint == "timeseries":
+        # GCS time-series rollups (10s buckets): the sparkline panel's
+        # data. Local mode has no GCS store, so {}.
+        core = global_worker().core
+        if hasattr(core, "cluster_timeseries"):
+            try:
+                return core.cluster_timeseries(last=60)
+            except Exception:  # noqa: BLE001 - GCS restart window
+                return {}
+        return {}
     if endpoint == "pgs":
         # Placement groups (gang reservations): full table with lifecycle
         # state, per-bundle nodes, and pending reason.

@@ -177,11 +177,69 @@ def placement_group_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def flight_recorder_metrics() -> Dict[str, "Metric"]:
+    """``flight_recorder_*`` series for the continuous stack sampler:
+    sampler starts, folded stacks shipped, and the sampler's own cumulative
+    wall time (the overhead being bounded by the A/B smoke). Lazily
+    registered; idempotent."""
+    return {
+        "starts": get_or_create(
+            Count, "flight_recorder_starts", tag_keys=("component",),
+            description="flight-recorder sampler threads started"),
+        "samples": get_or_create(
+            Count, "flight_recorder_stacks_sampled",
+            tag_keys=("component",),
+            description="folded thread stacks shipped to the GCS "
+                        "profile-stacks table"),
+        "overhead_s": get_or_create(
+            Gauge, "flight_recorder_overhead_seconds",
+            tag_keys=("component",),
+            description="cumulative wall seconds spent inside the stack "
+                        "sampler itself"),
+    }
+
+
+def slo_metrics() -> Dict[str, "Metric"]:
+    """``slo_*`` series for the monitor's rule engine: the alert gauge
+    (1 = firing) Prometheus alerting keys on, rule evaluations, and the
+    last observed burn rate per rule. Lazily registered; idempotent."""
+    return {
+        "active": get_or_create(
+            Gauge, "slo_alert_active", tag_keys=("rule",),
+            description="1 while the SLO rule is firing, else 0"),
+        "evaluations": get_or_create(
+            Count, "slo_rule_evaluations", tag_keys=("rule",),
+            description="SLO rule evaluation passes"),
+        "burn": get_or_create(
+            Gauge, "slo_burn_rate", tag_keys=("rule",),
+            description="last observed error-budget burn rate "
+                        "(1.0 = burning exactly the budget)"),
+    }
+
+
 def collect_all() -> Dict[str, Dict]:
     """Snapshot every registered metric (the dashboard's /api/metrics)."""
     with _LOCK:
         metrics = list(_REGISTRY.items())
     return {name: m.collect() for name, m in metrics}
+
+
+def histogram_cells(name: str) -> Dict[Tuple, Dict]:
+    """Raw per-tags histogram cells of one registered Histogram:
+    {tags_tuple: {"buckets": {boundary_str: count}, "sum", "count"}}.
+    Cumulative — the driver stats flush diffs consecutive snapshots into
+    the per-bucket deltas the GCS time-series store merges."""
+    with _LOCK:
+        m = _REGISTRY.get(name)
+    if not isinstance(m, Histogram):
+        return {}
+    bounds = [str(b) for b in m.boundaries] + ["+inf"]
+    with m._lock:
+        return {
+            key: {"buckets": dict(zip(bounds, counts)),
+                  "sum": m._sums[key], "count": m._totals[key]}
+            for key, counts in m._counts.items()
+        }
 
 
 def reset_all() -> None:

@@ -1,24 +1,212 @@
-"""Monitor: head-node daemon driving the autoscaler
-(reference: python/ray/monitor.py Monitor :21).
+"""Monitor: head-node daemon driving the autoscaler and the SLO rule
+engine (reference: python/ray/monitor.py Monitor :21; the burn-rate
+discipline is the SRE multi-window alert — short AND long windows must
+both overspend the error budget before a rule fires, so a blip neither
+pages nor masks a slow leak).
 
 Polls the GCS for node membership/resources and unplaceable placement
 demands, feeds LoadMetrics, and calls StandardAutoscaler.update() each tick.
 The reference consumes the heartbeat pubsub stream; polling the same tables
-gives identical information on our asyncio GCS.
+gives identical information on our asyncio GCS. A slower cadence polls the
+GCS time-series rollups (``get_timeseries``) and evaluates the SLO rules:
+threshold floors/ceilings (warm throughput, per-phase p99) and burn-rate
+rules (event-log error rate), emitting ``slo_*`` cluster events and the
+``slo_alert_active`` Prometheus gauge on transitions.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
+from ._private.timeseries import (
+    merge_hist, quantile_from_hist, window_rate, window_sum,
+)
 from .autoscaler import LoadMetrics, StandardAutoscaler
 from .autoscaler.node_provider import NodeProvider
 from .cluster.protocol import RpcClient
+
+
+# --------------------------------------------------------------------------
+# SLO rules over the GCS time-series
+# --------------------------------------------------------------------------
+
+class SloRule:
+    """One declarative rule over the time-series rollups.
+
+    kind:
+      * ``floor``   — windowed rate of a delta series must stay >=
+        ``threshold`` (evaluated only once ``min_count`` events landed in
+        the window, so an idle cluster never pages on "0 tasks/s");
+      * ``ceiling`` — a windowed value must stay <= ``threshold``: the
+        q-``quantile`` of the window's merged histogram when ``quantile``
+        is set, else the newest gauge sample;
+      * ``burn``    — error-budget burn rate: the fraction
+        bad/(bad+total) over BOTH a short and a long window, divided by
+        ``budget``, must stay <= ``burn_threshold``.
+    """
+
+    def __init__(self, name: str, kind: str, series: str,
+                 threshold: float, window_s: float = 60.0,
+                 min_count: float = 0.0, quantile: Optional[float] = None,
+                 total_series: str = "", budget: float = 0.01,
+                 burn_threshold: float = 1.0,
+                 long_window_s: Optional[float] = None):
+        if kind not in ("floor", "ceiling", "burn"):
+            raise ValueError(f"unknown SLO rule kind {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.series = series
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.min_count = float(min_count)
+        self.quantile = quantile
+        self.total_series = total_series
+        self.budget = float(budget)
+        self.burn_threshold = float(burn_threshold)
+        self.long_window_s = float(long_window_s or window_s * 6)
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def default_slo_rules() -> List[SloRule]:
+    """The shipped rule set (each knob env-tunable): the ROADMAP's warm
+    throughput floor, p99 ceilings on the phases that dominate task
+    latency, and an event-log error-rate burn rule."""
+    return [
+        SloRule("warm_throughput", "floor", "tasks_finished",
+                threshold=_env_f("RAY_TPU_SLO_TPS_FLOOR", 100.0),
+                window_s=60.0,
+                min_count=_env_f("RAY_TPU_SLO_TPS_MIN_TASKS", 500.0)),
+        SloRule("worker_exec_p99", "ceiling", "trace_phase_ms:worker_exec",
+                threshold=_env_f("RAY_TPU_SLO_PHASE_P99_MS", 500.0),
+                window_s=120.0, quantile=0.99, min_count=20),
+        SloRule("driver_fetch_p99", "ceiling", "trace_phase_ms:driver_fetch",
+                threshold=_env_f("RAY_TPU_SLO_PHASE_P99_MS", 500.0),
+                window_s=120.0, quantile=0.99, min_count=20),
+        SloRule("task_error_burn", "burn", "events:task_failed",
+                threshold=0.0, total_series="tasks_finished",
+                budget=_env_f("RAY_TPU_SLO_ERROR_BUDGET", 0.01),
+                burn_threshold=_env_f("RAY_TPU_SLO_BURN_THRESHOLD", 2.0),
+                window_s=300.0, long_window_s=1800.0, min_count=50),
+    ]
+
+
+class SloEngine:
+    """Evaluates SLO rules against a ``get_timeseries`` payload and tracks
+    firing state. Pure over its inputs (tests drive it with synthetic
+    payloads and explicit ``now``); side effects are limited to the
+    ``slo_*`` metric gauges."""
+
+    def __init__(self, rules: Optional[Sequence[SloRule]] = None):
+        self.rules = list(rules) if rules is not None \
+            else default_slo_rules()
+        self.active: Dict[str, float] = {}  # rule name -> firing since
+
+    @staticmethod
+    def _points(payload: Dict[str, Any], name: str) -> list:
+        return (payload.get("series", {}).get(name) or {}).get("points", [])
+
+    def _eval_rule(self, rule: SloRule, payload: Dict[str, Any],
+                   now: float) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"rule": rule.name, "kind": rule.kind,
+                               "threshold": rule.threshold,
+                               "firing": False, "value": None}
+        pts = self._points(payload, rule.series)
+        since = now - rule.window_s
+        if rule.kind == "floor":
+            n = window_sum(pts, since)
+            if n < rule.min_count:
+                return out  # idle window: the floor doesn't apply
+            rate = window_rate(pts, since, now)
+            out["value"] = round(rate, 3)
+            out["firing"] = rate < rule.threshold
+            return out
+        if rule.kind == "ceiling":
+            if rule.quantile is not None:
+                merged = merge_hist(
+                    c for t, c in pts if t >= since)
+                if merged["count"] < rule.min_count:
+                    return out
+                q = quantile_from_hist(merged, rule.quantile)
+                if q is None:
+                    return out
+                out["value"] = q
+                out["firing"] = q > rule.threshold
+                return out
+            gauge = [c for t, c in pts if t >= since]
+            if not gauge:
+                return out
+            out["value"] = gauge[-1].get("last")
+            out["firing"] = (out["value"] or 0.0) > rule.threshold
+            return out
+        # burn: bad fraction vs budget over short AND long windows.
+        total_pts = self._points(payload, rule.total_series)
+        burns = []
+        for win in (rule.window_s, rule.long_window_s):
+            w_since = now - win
+            bad = window_sum(pts, w_since)
+            total = window_sum(total_pts, w_since) + bad
+            if total < rule.min_count:
+                out["value"] = 0.0
+                return out  # too little traffic to burn meaningfully
+            burns.append((bad / total) / max(rule.budget, 1e-9))
+        out["value"] = round(burns[0], 3)
+        out["burn_long"] = round(burns[1], 3)
+        out["firing"] = all(b > rule.burn_threshold for b in burns)
+        return out
+
+    def evaluate(self, payload: Dict[str, Any],
+                 now: Optional[float] = None) -> Dict[str, Any]:
+        """One pass over every rule. Returns {"results": [...],
+        "fired": [names], "resolved": [names]} — the transitions the
+        caller turns into ``slo_*`` cluster events."""
+        if now is None:
+            now = time.time()
+        results, fired, resolved = [], [], []
+        metrics = self._metrics()
+        for rule in self.rules:
+            try:
+                res = self._eval_rule(rule, payload, now)
+            except Exception as e:  # noqa: BLE001 - one bad rule != outage
+                res = {"rule": rule.name, "kind": rule.kind,
+                       "firing": False, "value": None,
+                       "error": f"{type(e).__name__}: {e}"}
+            results.append(res)
+            was = rule.name in self.active
+            if res["firing"] and not was:
+                self.active[rule.name] = now
+                fired.append(rule.name)
+            elif not res["firing"] and was:
+                del self.active[rule.name]
+                resolved.append(rule.name)
+            if metrics is not None:
+                tags = {"rule": rule.name}
+                metrics["evaluations"].record(1.0, tags=tags)
+                metrics["active"].record(
+                    1.0 if res["firing"] else 0.0, tags=tags)
+                if rule.kind == "burn" and res.get("value") is not None:
+                    metrics["burn"].record(float(res["value"]), tags=tags)
+        return {"results": results, "fired": fired, "resolved": resolved}
+
+    @staticmethod
+    def _metrics():
+        try:
+            from .metrics import slo_metrics
+
+            return slo_metrics()
+        except Exception:  # noqa: BLE001 - metrics must never fail rules
+            return None
 
 
 class Monitor:
@@ -39,6 +227,12 @@ class Monitor:
         self._pg_pending_since: Dict[str, float] = {}
         self._pg_report_last = 0.0
         self.pg_table: Dict[str, Dict[str, Any]] = {}
+        # SLO rule engine over the GCS time-series rollups; evaluated on
+        # its own (slower) cadence since the rollup buckets are 10 s wide.
+        self.slo_engine = SloEngine()
+        self.slo_results: List[Dict[str, Any]] = []
+        self._slo_last = 0.0
+        self.slo_interval_s = 10.0
 
     def poll_once(self) -> None:
         nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
@@ -98,10 +292,40 @@ class Monitor:
             }
         return out
 
+    def poll_slo_once(self) -> None:
+        """Evaluate the SLO rules against the latest rollups; emit
+        ``slo_fired``/``slo_resolved`` cluster events on transitions (the
+        gauge side lives in the engine)."""
+        try:
+            payload = self.gcs.call({"type": "get_timeseries", "last": 200})
+        except (KeyError, ConnectionError, OSError):
+            return
+        verdict = self.slo_engine.evaluate(payload)
+        self.slo_results = verdict["results"]
+        by_rule = {r["rule"]: r for r in verdict["results"]}
+        for kind_key, names in (("slo_fired", verdict["fired"]),
+                                ("slo_resolved", verdict["resolved"])):
+            for name in names:
+                res = by_rule.get(name, {})
+                if kind_key == "slo_fired":
+                    logger.warning(
+                        "SLO rule %s firing: value=%s threshold=%s",
+                        name, res.get("value"), res.get("threshold"))
+                try:
+                    self.gcs.send_oneway({
+                        "type": "log_event", "kind": kind_key,
+                        "rule": name, "value": res.get("value"),
+                        "threshold": res.get("threshold")})
+                except (ConnectionError, OSError):
+                    pass
+
     def update(self) -> None:
         self.poll_once()
         self.autoscaler.update()
         self.num_updates += 1
+        if time.monotonic() - self._slo_last > self.slo_interval_s:
+            self._slo_last = time.monotonic()
+            self.poll_slo_once()
         stuck = self.stuck_placement_groups()
         if stuck and time.monotonic() - self._pg_report_last > 30.0:
             self._pg_report_last = time.monotonic()

@@ -358,14 +358,190 @@ def cmd_memory(args) -> None:
 def cmd_trace(args) -> None:
     """Per-task straggler report: top-k slowest sampled tasks with latency
     attributed to the 7 control-plane phases (needs tracing enabled —
-    default 1/64 sampling, RAY_TPU_TRACE_SAMPLE)."""
-    from ray_tpu._private.tracing import straggler_report
+    default 1/64 sampling). ``--sample N`` broadcasts a new 1-in-N rate
+    through the GCS kv (0 disables, -1 reverts to env/default): every
+    driver/node picks it up on its next stats poll, no restarts."""
+    from ray_tpu._private.tracing import TRACE_SAMPLE_KV_KEY, straggler_report
 
     gcs = _gcs_client(args.address)
     try:
+        if args.sample is not None:
+            if args.sample < 0:
+                gcs.call({"type": "kv_put", "key": TRACE_SAMPLE_KV_KEY,
+                          "value": None})
+                print("trace sampling reverted to env/default "
+                      "(override cleared)")
+            else:
+                gcs.call({"type": "kv_put", "key": TRACE_SAMPLE_KV_KEY,
+                          "value": str(args.sample).encode()})
+                print(f"trace sampling set to 1/{args.sample}"
+                      if args.sample else "trace sampling disabled")
+            print("(applies cluster-wide within ~2s, the stats-poll "
+                  "cadence)")
+            return
         spans = gcs.call({"type": "get_trace_data",
                           "limit": args.limit})["spans"]
         print(straggler_report(spans, top_k=args.top))
+    finally:
+        gcs.close()
+
+
+def cmd_profile(args) -> None:
+    """Flight-recorder report: top-N frames by self-time from the GCS
+    profile-stacks table. With ``--seconds N`` the table is snapshot-
+    diffed around a live window (profile what's running NOW); 0 uses the
+    cumulative counts. Also writes the window as a collapsed-stack file
+    flamegraph tools consume directly (flamegraph.pl / speedscope)."""
+    from ray_tpu._private.flight_recorder import self_time_table
+
+    component = {"head": "gcs"}.get(args.component, args.component)
+    gcs = _gcs_client(args.address)
+
+    def snap() -> Dict[str, Dict]:
+        msg: Dict = {"type": "get_profile_stacks"}
+        if component != "all":
+            msg["component"] = component
+        return gcs.call(msg)["components"]
+
+    try:
+        before = snap() if args.seconds > 0 else {}
+        if args.seconds > 0:
+            print(f"recording {args.seconds:.0f}s window "
+                  f"(component={args.component})...")
+            time.sleep(args.seconds)
+        after = snap()
+    finally:
+        gcs.close()
+    # Window = after - before, merged across the selected components.
+    window: Dict[str, int] = {}
+    for comp, info in after.items():
+        base = before.get(comp, {}).get("stacks", {})
+        for stack, n in info["stacks"].items():
+            d = n - base.get(stack, 0)
+            if d > 0:
+                window[stack] = window.get(stack, 0) + d
+    total = sum(window.values())
+    if not total:
+        print("no stack samples in the window — is the flight recorder "
+              "on (RAY_TPU_FLIGHT_RECORDER) and the cluster busy?")
+        return
+    comps = ",".join(sorted(after)) or args.component
+    print(f"{total} stack samples ({comps}); top {args.top} frames "
+          f"by self-time:")
+    print(f"{'SELF%':>7} {'SELF':>8} {'CUM':>8}  FRAME")
+    for frame, self_n, cum_n, pct in self_time_table(window, top=args.top):
+        print(f"{pct:>6.1f}% {self_n:>8} {cum_n:>8}  {frame}")
+    out_path = args.out or f"/tmp/ray_tpu_profile_{args.component}.folded"
+    with open(out_path, "w") as f:
+        for stack, n in sorted(window.items(), key=lambda kv: -kv[1]):
+            f.write(f"{stack} {n}\n")
+    print(f"collapsed stacks written to {out_path} "
+          f"(feed to flamegraph.pl / speedscope)")
+
+
+def _render_top_frame(gcs) -> str:
+    """One `cli top` frame: live cluster view from the time-series
+    rollups + handler stats."""
+    from ray_tpu._private.timeseries import sparkline, window_rate
+
+    ts = gcs.call({"type": "get_timeseries", "last": 60})
+    nodes = gcs.call({"type": "list_nodes"})["nodes"]
+    handlers = gcs.call({"type": "debug_stats"})["handlers"]
+    series = ts["series"]
+    bucket_s = ts.get("bucket_s", 10)
+    now = time.time()
+    lines = [f"ray_tpu top — {time.strftime('%H:%M:%S')}  "
+             f"nodes {sum(n['Alive'] for n in nodes)}/{len(nodes)} alive  "
+             f"bucket {bucket_s:.0f}s"]
+
+    def pts(name):
+        return (series.get(name) or {}).get("points", [])
+
+    def rates(name):
+        return [c["sum"] / bucket_s for _, c in pts(name)]
+
+    tp = pts("tasks_finished")
+    lines.append(
+        f"tasks/s    {window_rate(tp, now - 60, now):>9.1f} (1m)  "
+        f"{window_rate(tp, now - 300, now):>9.1f} (5m)   "
+        f"{sparkline(rates('tasks_finished'))}")
+    # Per-phase µs/task over the last minute (the 7-phase profiler view,
+    # trended): seconds-delta / count-delta.
+    phase_rows = []
+    for name in sorted(series):
+        if not name.startswith("phase_seconds:"):
+            continue
+        phase = name[len("phase_seconds:"):]
+        sec = sum(c["sum"] for t, c in pts(name) if t >= now - 60)
+        cnt = sum(c["sum"] for t, c in
+                  pts(f"phase_count:{phase}") if t >= now - 60)
+        if cnt > 0:
+            phase_rows.append((phase, sec / cnt * 1e6, int(cnt)))
+    if phase_rows:
+        lines.append(f"  {'PHASE':<18} {'US/TASK':>10} {'ITEMS(1m)':>10}")
+        for phase, us, cnt in phase_rows:
+            lines.append(f"  {phase:<18} {us:>10.1f} {cnt:>10}")
+    # Result-path mix: how results reached their owners (driver totals).
+    totals = ts.get("driver_totals") or {}
+    mix = {k[len("result:"):]: int(v) for k, v in totals.items()
+           if k.startswith("result:")}
+    if mix:
+        total_n = sum(mix.values()) or 1
+        lines.append("result path " + "  ".join(
+            f"{k}={v} ({100 * v / total_n:.0f}%)"
+            for k, v in sorted(mix.items(), key=lambda kv: -kv[1])))
+    # Gauges worth trending.
+    for label, name in (("cpu%", "node_cpu_percent_mean"),
+                        ("mem%", "node_mem_percent_mean"),
+                        ("objects", "objects_in_directory")):
+        p = pts(name)
+        if p:
+            lines.append(f"{label:<10} {p[-1][1]['last']:>10.1f}   "
+                         f"{sparkline([c['last'] for _, c in p])}")
+    pg_states = {n[len('pg_state:'):]: pts(n)[-1][1]["last"]
+                 for n in series if n.startswith("pg_state:") and pts(n)}
+    if pg_states:
+        lines.append("pgs        " + "  ".join(
+            f"{k.lower()}={int(v)}" for k, v in sorted(pg_states.items())))
+    dropped = ts.get("events_dropped", 0)
+    if dropped:
+        lines.append(f"event log  {dropped} events dropped (ring full — "
+                     f"raise RAY_TPU_EVENT_LOG_SIZE)")
+    # Firing SLO rules (slo_fired without a later slo_resolved).
+    events = gcs.call({"type": "get_events", "limit": 200})["events"]
+    firing: Dict[str, Dict] = {}
+    for ev in events:
+        if ev.get("kind") == "slo_fired":
+            firing[ev.get("rule", "?")] = ev
+        elif ev.get("kind") == "slo_resolved":
+            firing.pop(ev.get("rule", "?"), None)
+    for rule, ev in firing.items():
+        lines.append(f"SLO FIRING {rule}: value={ev.get('value')} "
+                     f"threshold={ev.get('threshold')}")
+    relay = {k: handlers[k]["count"]
+             for k in ("relay:opaque", "relay:pickled") if k in handlers}
+    if relay:
+        lines.append(f"relay      {relay}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> None:
+    """Live cluster view (reference: `ray top` never shipped; this is
+    htop-for-the-control-plane over the GCS time-series): tasks/s with
+    sparkline, per-phase latency, result-path mix, pg states, SLO alerts.
+    Refreshes in place; ``--once`` prints a single frame (scripts/CI)."""
+    gcs = _gcs_client(args.address)
+    try:
+        if args.once:
+            print(_render_top_frame(gcs))
+            return
+        while True:
+            frame = _render_top_frame(gcs)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
     finally:
         gcs.close()
 
@@ -378,9 +554,14 @@ def cmd_events(args) -> None:
         msg = {"type": "get_events", "limit": args.limit}
         if args.kind:
             msg["kind"] = args.kind
-        events = gcs.call(msg)["events"]
+        resp = gcs.call(msg)
+        events = resp["events"]
+        dropped = resp.get("dropped", 0)
         print(f"{len(events)} events"
-              + (f" (kind={args.kind})" if args.kind else ""))
+              + (f" (kind={args.kind})" if args.kind else "")
+              + (f"; {dropped} dropped from the "
+                 f"{resp.get('capacity', '?')}-slot ring "
+                 f"(raise RAY_TPU_EVENT_LOG_SIZE)" if dropped else ""))
         for ev in events:
             stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
             detail = " ".join(f"{k}={v}" for k, v in ev.items()
@@ -646,7 +827,31 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--top", type=int, default=10)
     sp.add_argument("--limit", type=int, default=50_000,
                     help="newest spans to fetch from the GCS trace table")
+    sp.add_argument("--sample", type=int, default=None,
+                    help="broadcast a new 1-in-N sampling rate via the "
+                         "GCS kv (0=off, -1=revert to env/default)")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("profile", help="flight-recorder self-time report "
+                                        "(+ collapsed-stack file)")
+    sp.add_argument("--address")
+    sp.add_argument("--component", default="all",
+                    choices=["all", "head", "gcs", "controller", "worker",
+                             "driver"])
+    sp.add_argument("--seconds", type=float, default=5.0,
+                    help="live window to snapshot-diff (0 = cumulative)")
+    sp.add_argument("--top", type=int, default=25)
+    sp.add_argument("--out", help="collapsed-stack output path "
+                                  "(default /tmp/ray_tpu_profile_*.folded)")
+    sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("top", help="live cluster view over the GCS "
+                                    "time-series rollups")
+    sp.add_argument("--address")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("pgs", help="placement-group table (gang "
                                     "reservations and lifecycle state)")

@@ -89,7 +89,7 @@ def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
     return out
 
 
-def one_run(serial_n: int, batch_k: int) -> dict:
+def one_run(serial_n: int, batch_k: int, record_ts: bool = False) -> dict:
     import ray_tpu
     from ray_tpu.cluster.testing import Cluster
 
@@ -128,12 +128,27 @@ def one_run(serial_n: int, batch_k: int) -> dict:
         ray_tpu.get([noop.remote() for _ in range(batch_k)])
         dt_warm = time.perf_counter() - t0
         phases = _phase_delta_ms_per_1k(ph0, _phase_snapshot(core))
-        return {"p50_ms": round(pct(.5), 3), "p90_ms": round(pct(.9), 3),
-                "p99_ms": round(pct(.99), 3),
-                "min_ms": round(lats[0] * 1e3, 3),
-                "batch_tasks_per_sec": round(batch_k / dt, 1),
-                "batch_warm_tasks_per_sec": round(batch_k / dt_warm, 1),
-                "phases_ms_per_1k": phases}
+        out = {"p50_ms": round(pct(.5), 3), "p90_ms": round(pct(.9), 3),
+               "p99_ms": round(pct(.99), 3),
+               "min_ms": round(lats[0] * 1e3, 3),
+               "batch_tasks_per_sec": round(batch_k / dt, 1),
+               "batch_warm_tasks_per_sec": round(batch_k / dt_warm, 1),
+               "phases_ms_per_1k": phases}
+        if record_ts:
+            # Time-series snapshot of the run (--record): the GCS rollup
+            # buckets behind the phase tables, persisted so a regression
+            # hunt can see how the run TRENDED, not just its totals. Wait
+            # one driver-stats flush so the driver-side series land.
+            time.sleep(2.5)
+            try:
+                ts = core.cluster_timeseries(last=120)
+                out["timeseries"] = {"bucket_s": ts.get("bucket_s"),
+                                     "series": ts.get("series", {}),
+                                     "driver_totals":
+                                         ts.get("driver_totals", {})}
+            except Exception as e:  # noqa: BLE001 - snapshot is optional
+                out["timeseries"] = {"error": repr(e)}
+        return out
     finally:
         ray_tpu.shutdown()
         c.shutdown()
@@ -358,6 +373,9 @@ def main():
                     help="annotation recorded with the history entry")
     ap.add_argument("--no-record", action="store_true",
                     help="don't append to CLUSTER_LAT.json")
+    ap.add_argument("--record", action="store_true",
+                    help="persist the LAST run's GCS time-series snapshot "
+                         "next to its phase tables in CLUSTER_LAT.json")
     args = ap.parse_args()
 
     if args.traces:
@@ -366,7 +384,9 @@ def main():
 
     runs = []
     for i in range(args.runs):
-        r = one_run(args.serial, args.batch)
+        r = one_run(args.serial, args.batch,
+                    record_ts=args.record and i == args.runs - 1)
+        ts_snap = r.pop("timeseries", None)
         runs.append(r)
         print(f"# run {i + 1}/{args.runs}: {r}", file=sys.stderr)
 
@@ -413,6 +433,8 @@ def main():
              "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
              "phases_ms_per_1k": r["phases_ms_per_1k"]}
             for r in runs]
+    if args.record and runs and ts_snap is not None:
+        out["timeseries"] = ts_snap
     if args.sim_nodes:
         rows = []
         for n in (int(x) for x in args.sim_nodes.split(",") if x):

@@ -1,0 +1,428 @@
+"""Flight recorder, time-series rollups, and SLO rules (ISSUE 6).
+
+Unit layer: TimeSeriesStore bucket alignment / retention / histogram merge,
+FlightRecorder lifecycle + folding, SLO engine threshold + burn-rate logic,
+event-log drop accounting, the PROFILE_STACKS wire frame, and Prometheus
+exposition of the new flight_recorder_* / slo_* series. Cluster E2E lives
+in test_observability.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import flight_recorder, timeseries, tracing
+from ray_tpu._private.flight_recorder import FlightRecorder, self_time_table
+from ray_tpu._private.timeseries import (
+    TimeSeriesStore, merge_hist, quantile_from_hist, sparkline,
+    window_rate, window_sum,
+)
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore
+# ---------------------------------------------------------------------------
+
+class TestTimeSeriesStore:
+    def test_bucket_alignment(self):
+        """Samples land in wall-clock-aligned buckets regardless of where
+        inside the bucket they arrive."""
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=100)
+        s.add_delta("x", 1, ts=103.2)
+        s.add_delta("x", 2, ts=107.9)   # same bucket
+        s.add_delta("x", 4, ts=110.0)   # next bucket boundary, exactly
+        pts = s.series("x")
+        assert [(t, c["sum"]) for t, c in pts] == [(100, 3.0), (110, 4.0)]
+
+    def test_late_sample_folds_into_newest_bucket(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=100)
+        s.add_delta("x", 1, ts=120)
+        s.add_delta("x", 5, ts=111)  # clock skew: must not reorder the ring
+        pts = s.series("x")
+        assert len(pts) == 1 and pts[0][1]["sum"] == 6.0
+
+    def test_retention_eviction(self):
+        """The per-series ring keeps exactly retention_buckets buckets."""
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=3)
+        for i in range(6):
+            s.add_delta("x", i + 1, ts=100 + 10 * i)
+        pts = s.series("x")
+        assert [t for t, _ in pts] == [130, 140, 150]
+        assert [c["sum"] for _, c in pts] == [4.0, 5.0, 6.0]
+
+    def test_gauge_cell_stats(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        for v in (5.0, 1.0, 3.0):
+            s.add_gauge("g", v, ts=100)
+        (t, c), = s.series("g")
+        assert (c["last"], c["min"], c["max"], c["n"]) == (3.0, 1.0, 5.0, 3)
+        assert c["sum"] == pytest.approx(9.0)
+
+    def test_histogram_merge_within_bucket(self):
+        """Two sources flushing deltas into the same bucket combine into
+        one distribution; quantiles read the merged counts."""
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        s.add_hist("h", {"1": 8, "5": 1}, total=13.0, count=9, ts=100)
+        s.add_hist("h", {"5": 1, "100": 90}, total=910.0, count=91, ts=105)
+        (t, c), = s.series("h")
+        assert c["buckets"] == {"1": 8, "5": 2, "100": 90}
+        assert c["count"] == 100
+        assert quantile_from_hist(c, 0.99) == 100.0
+        assert quantile_from_hist(c, 0.05) == 1.0
+
+    def test_merge_hist_across_buckets_and_quantile(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        s.add_hist("h", {"1": 99}, total=99.0, count=99, ts=100)
+        s.add_hist("h", {"1000": 1}, total=1000.0, count=1, ts=110)
+        merged = merge_hist(c for _, c in s.series("h"))
+        assert merged["count"] == 100
+        assert quantile_from_hist(merged, 0.5) == 1.0
+        assert quantile_from_hist(merged, 0.999) == 1000.0
+
+    def test_quantile_inf_clamps_to_largest_finite(self):
+        assert quantile_from_hist(
+            {"buckets": {"1": 1, "+inf": 99}, "count": 100}, 0.99) == 1.0
+        assert quantile_from_hist({"buckets": {}, "count": 0}, 0.5) is None
+
+    def test_kind_conflict_raises(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        s.add_delta("x", 1, ts=100)
+        with pytest.raises(ValueError):
+            s.add_gauge("x", 1, ts=100)
+
+    def test_window_helpers(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        s.add_delta("x", 30, ts=100)
+        s.add_delta("x", 60, ts=110)
+        pts = s.series("x")
+        assert window_sum(pts, 110) == 60.0
+        assert window_rate(pts, 60, now=120) == pytest.approx(1.5)
+
+    def test_snapshot_filter_and_last(self):
+        s = TimeSeriesStore(bucket_s=10, retention_buckets=10)
+        for i in range(4):
+            s.add_delta("a", 1, ts=100 + 10 * i)
+        s.add_gauge("b", 2, ts=100)
+        snap = s.snapshot(names=["a"], last=2)
+        assert list(snap) == ["a"]
+        assert snap["a"]["kind"] == "delta"
+        assert len(snap["a"]["points"]) == 2
+
+    def test_sparkline(self):
+        assert sparkline([]) == ""
+        assert sparkline([1, 1, 1]) == "▁▁▁"
+        line = sparkline([0, 5, 10])
+        assert line[0] == "▁" and line[-1] == "█"
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_samples_and_folds_running_code(self):
+        rec = FlightRecorder("test", hz=200)
+        try:
+            rec.start()
+            stop = time.monotonic() + 1.0
+            evt = threading.Event()
+
+            def busy_named_frame():
+                while time.monotonic() < stop and not evt.is_set():
+                    sum(range(500))
+
+            t = threading.Thread(target=busy_named_frame)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            hit = False
+            while time.monotonic() < deadline and not hit:
+                time.sleep(0.05)
+                hit = any("busy_named_frame" in s
+                          for s in rec.snapshot())
+            evt.set()
+            t.join()
+            assert hit, rec.snapshot()
+            counts = rec.drain()
+            # Folded form: outer;...;leaf with file.py:func elements.
+            stack = next(s for s in counts if "busy_named_frame" in s)
+            leaf = stack.rsplit(";", 1)[-1]
+            assert leaf.endswith("busy_named_frame")
+            assert ":" in leaf
+            # drain() swapped the table out.
+            assert not any("busy_named_frame" in s for s in rec.snapshot())
+        finally:
+            rec.stop()
+
+    def test_start_stop_idempotent_and_thread_cleanup(self):
+        rec = FlightRecorder("test", hz=100)
+        assert rec.start() is True
+        assert rec.start() is False   # second start: no new thread
+        names = [t.name for t in threading.enumerate()]
+        assert names.count("flight-recorder") == 1
+        rec.stop()
+        rec.stop()                    # idempotent
+        assert not rec.running
+        assert "flight-recorder" not in \
+            [t.name for t in threading.enumerate()]
+        # restartable after stop
+        assert rec.start() is True
+        rec.stop()
+
+    def test_module_singleton_shares_first_component(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_FLIGHT_RECORDER", "1")
+        flight_recorder.stop()
+        try:
+            a = flight_recorder.start("gcs")
+            b = flight_recorder.start("controller")  # colocated-head case
+            assert a is b and b.component == "gcs"
+        finally:
+            flight_recorder.stop()
+        assert flight_recorder.get() is None
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_FLIGHT_RECORDER", "0")
+        flight_recorder.stop()
+        assert flight_recorder.start("worker") is None
+        assert flight_recorder.get() is None
+
+    def test_local_runtime_shutdown_stops_sampler(self):
+        """init()/shutdown() cycles must start and stop the sampler —
+        no thread leaks across cycles (sampler start/stop rides the
+        runtime lifecycle)."""
+        import ray_tpu
+
+        for _ in range(2):
+            ray_tpu.init(num_cpus=2)
+            assert any(t.name == "flight-recorder"
+                       for t in threading.enumerate())
+            ray_tpu.shutdown()
+            assert not any(t.name == "flight-recorder"
+                           for t in threading.enumerate())
+
+    def test_self_time_table(self):
+        counts = {
+            "a.py:main;b.py:hot": 70,
+            "a.py:main;b.py:hot;c.py:inner": 20,
+            "a.py:main": 10,
+        }
+        rows = self_time_table(counts, top=10)
+        by_frame = {r[0]: r for r in rows}
+        # self: hot=70, inner=20, main=10; cum: main=100, hot=90.
+        assert by_frame["b.py:hot"][1] == 70
+        assert by_frame["b.py:hot"][2] == 90
+        assert by_frame["a.py:main"][2] == 100
+        assert by_frame["b.py:hot"][3] == pytest.approx(70.0)
+        assert rows[0][0] == "b.py:hot"  # self-descending
+
+
+# ---------------------------------------------------------------------------
+# PROFILE_STACKS wire frame
+# ---------------------------------------------------------------------------
+
+def test_profile_stacks_wire_roundtrip():
+    from ray_tpu.cluster import wire
+
+    msg = {"type": "add_profile_stacks", "component": "worker",
+           "samples": 12,
+           "stacks": {"a.py:f;b.py:g": 7, "x.py:h": 5}}
+    bufs = wire.encode(msg, peer_wire=wire.WIRE_VERSION)
+    assert bufs is not None
+    dec = wire.decode(b"".join(bufs))
+    assert dec["type"] == "add_profile_stacks"
+    assert dec["component"] == "worker"
+    assert dec["samples"] == 12
+    assert dec["stacks"] == msg["stacks"]
+    # Pre-v3 peers can't parse 0x13: pickle must carry it instead.
+    assert wire.encode(msg, peer_wire=2) is None
+
+
+# ---------------------------------------------------------------------------
+# event-log drop accounting (GCS)
+# ---------------------------------------------------------------------------
+
+def test_event_log_drop_accounting():
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster.gcs import GcsServer
+
+    cfg = Config()
+    cfg.event_log_size = 5
+    gcs = GcsServer(cfg)
+    for i in range(8):
+        gcs.record_event("unit_test_evt", i=i)
+    assert gcs.cluster_events.maxlen == 5
+    assert len(gcs.cluster_events) == 5
+    assert gcs.events_dropped == 3
+    assert gcs._event_counts["unit_test_evt"] == 8
+    # The ring kept the NEWEST events.
+    assert [e["i"] for e in gcs.cluster_events] == [3, 4, 5, 6, 7]
+
+
+def test_event_log_size_env_override(monkeypatch):
+    from ray_tpu._private.config import Config
+
+    monkeypatch.setenv("RAY_TPU_EVENT_LOG_SIZE", "123")
+    assert Config().event_log_size == 123
+
+
+# ---------------------------------------------------------------------------
+# trace-sample runtime override
+# ---------------------------------------------------------------------------
+
+class TestTraceSampleOverride:
+    def teardown_method(self):
+        tracing.set_rate_override(None)
+
+    def test_override_beats_env(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "64")
+        tracing.set_rate_override(4)
+        assert tracing.sample_rate() == 4
+        tracing.set_rate_override(None)
+        assert tracing.sample_rate() == 64
+
+    def test_apply_kv_rate(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "64")
+        tracing.apply_kv_rate(b"8")
+        assert tracing.sample_rate() == 8
+        tracing.apply_kv_rate(b"0")
+        assert tracing.sample_rate() == 0          # disabled
+        tracing.apply_kv_rate(b"garbage")
+        assert tracing.sample_rate() == 64         # cleared -> env
+        tracing.apply_kv_rate(b"4")
+        tracing.apply_kv_rate(None)                # deleted kv cell
+        assert tracing.sample_rate() == 64
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+def _delta_series(per_bucket, end_ts, bucket_s=10):
+    """Helper: points for a delta series whose newest bucket ends at
+    end_ts."""
+    n = len(per_bucket)
+    return {"kind": "delta",
+            "points": [[end_ts - (n - i) * bucket_s, {"sum": float(v)}]
+                       for i, v in enumerate(per_bucket)]}
+
+
+class TestSloEngine:
+    def make_engine(self, rules):
+        from ray_tpu.monitor import SloEngine
+
+        return SloEngine(rules=rules)
+
+    def test_floor_fires_only_under_load(self):
+        from ray_tpu.monitor import SloRule
+
+        rule = SloRule("tps", "floor", "tasks_finished",
+                       threshold=100.0, window_s=60.0, min_count=500)
+        eng = self.make_engine([rule])
+        now = 1000.0
+        # Idle: 10 tasks in the window — the floor must NOT page.
+        idle = {"series": {"tasks_finished":
+                           _delta_series([10], now)}}
+        v = eng.evaluate(idle, now=now)
+        assert not v["results"][0]["firing"] and not v["fired"]
+        # Loaded but slow: 600 tasks over 60 s = 10/s < 100/s floor.
+        slow = {"series": {"tasks_finished":
+                           _delta_series([100] * 6, now)}}
+        v = eng.evaluate(slow, now=now)
+        assert v["results"][0]["firing"] and v["fired"] == ["tps"]
+        # Fast: 12k tasks over the window.
+        fast = {"series": {"tasks_finished":
+                           _delta_series([2000] * 6, now)}}
+        v = eng.evaluate(fast, now=now)
+        assert not v["results"][0]["firing"]
+        assert v["resolved"] == ["tps"]
+
+    def test_ceiling_quantile(self):
+        from ray_tpu.monitor import SloRule
+
+        rule = SloRule("p99", "ceiling", "trace_phase_ms:worker_exec",
+                       threshold=100.0, window_s=60.0, quantile=0.99,
+                       min_count=10)
+        eng = self.make_engine([rule])
+        now = 1000.0
+        good = {"series": {"trace_phase_ms:worker_exec": {
+            "kind": "hist",
+            "points": [[now - 10, {"buckets": {"10": 100}, "sum": 500.0,
+                                   "count": 100}]]}}}
+        assert not eng.evaluate(good, now=now)["results"][0]["firing"]
+        bad = {"series": {"trace_phase_ms:worker_exec": {
+            "kind": "hist",
+            "points": [[now - 10, {"buckets": {"10": 90, "500": 10},
+                                   "sum": 5000.0, "count": 100}]]}}}
+        res = eng.evaluate(bad, now=now)["results"][0]
+        assert res["firing"] and res["value"] == 500.0
+
+    def test_burn_needs_both_windows(self):
+        from ray_tpu.monitor import SloRule
+
+        rule = SloRule("errs", "burn", "events:task_failed",
+                       threshold=0.0, total_series="tasks_finished",
+                       budget=0.01, burn_threshold=2.0,
+                       window_s=60.0, long_window_s=300.0, min_count=50)
+        eng = self.make_engine([rule])
+        now = 10_000.0
+        # 10% failures in the short window only; long window healthy ->
+        # a blip, not a page.
+        blip = {"series": {
+            "events:task_failed": _delta_series(
+                [0] * 24 + [100], now),
+            "tasks_finished": _delta_series([1000] * 25, now)}}
+        assert not eng.evaluate(blip, now=now)["results"][0]["firing"]
+        # Sustained 10% failures against a 1% budget: burn 10x in both
+        # windows -> fires.
+        sustained = {"series": {
+            "events:task_failed": _delta_series([100] * 30, now),
+            "tasks_finished": _delta_series([900] * 30, now)}}
+        res = eng.evaluate(sustained, now=now)["results"][0]
+        assert res["firing"]
+        assert res["value"] == pytest.approx(10.0, rel=0.01)
+
+    def test_default_rules_construct_and_run_on_empty(self):
+        from ray_tpu.monitor import SloEngine
+
+        eng = SloEngine()
+        v = eng.evaluate({"series": {}}, now=1000.0)
+        assert len(v["results"]) >= 3
+        assert not v["fired"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition of the new series
+# ---------------------------------------------------------------------------
+
+def test_prometheus_renders_flight_recorder_and_slo_series():
+    from ray_tpu import metrics
+    from ray_tpu.metrics import flight_recorder_metrics, slo_metrics
+
+    fr = flight_recorder_metrics()
+    fr["samples"].record(42.0, tags={"component": "gcs"})
+    fr["overhead_s"].record(0.5, tags={"component": "gcs"})
+    slo = slo_metrics()
+    slo["active"].record(1.0, tags={"rule": "warm_throughput"})
+    slo["burn"].record(3.5, tags={"rule": "task_error_burn"})
+    text = metrics.render_prometheus()
+    assert "# TYPE flight_recorder_stacks_sampled_total counter" in text
+    assert 'flight_recorder_stacks_sampled_total{component="gcs"} 42' \
+        in text
+    assert "# TYPE flight_recorder_overhead_seconds gauge" in text
+    assert 'slo_alert_active{rule="warm_throughput"} 1' in text
+    assert 'slo_burn_rate{rule="task_error_burn"} 3.5' in text
+
+
+def test_histogram_cells_accessor():
+    from ray_tpu.metrics import Histogram, get_or_create, histogram_cells
+
+    h = get_or_create(Histogram, "test_hist_cells", tag_keys=("phase",),
+                      boundaries=[1, 10])
+    h.record(0.5, tags={"phase": "x"})
+    h.record(5.0, tags={"phase": "x"})
+    cells = histogram_cells("test_hist_cells")
+    key = (("phase", "x"),)
+    assert cells[key]["count"] == 2
+    assert cells[key]["buckets"] == {"1": 1, "10": 1, "+inf": 0}
+    assert cells[key]["sum"] == pytest.approx(5.5)
+    assert histogram_cells("no_such_metric") == {}

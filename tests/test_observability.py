@@ -260,3 +260,174 @@ def test_dashboard_timeline_lanes(local_ray):
         assert "laneView" in html and "timeline" in html
     finally:
         dash.stop()
+
+
+@pytest.mark.cluster
+def test_flight_recorder_timeseries_cluster_pipeline(tmp_path):
+    """ISSUE 6 E2E: recorder drains from every component reach the GCS
+    profile-stacks table, the time-series rollups trend the run,
+    /api/timeseries + the sparkline panel serve them, and `cli profile` /
+    `cli top --once` render the data (profile also writes the
+    collapsed-stack file)."""
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.scripts import cli
+
+    cluster = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    try:
+        # A separate worker NODE so the "controller" component reports too
+        # (the head's colocated controller shares the gcs sampler).
+        cluster.add_node(resources={"CPU": 2}, num_workers=1)
+        ray_tpu.init(address=cluster.address)
+        from ray_tpu._private.worker import global_worker
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get([sq.remote(i) for i in range(300)],
+                           timeout=120) == [i * i for i in range(300)]
+        core = global_worker().core
+
+        # Stacks from all four components land within a few 2 s flushes.
+        deadline = time.time() + 30
+        comps = {}
+        while time.time() < deadline:
+            comps = core.cluster_profile_stacks()
+            if {"gcs", "worker", "driver", "controller"} <= set(comps):
+                break
+            time.sleep(0.5)
+        assert {"gcs", "worker", "driver", "controller"} <= set(comps), \
+            sorted(comps)
+        # Acceptance: self-time attributes to NAMED file:function frames.
+        for comp, info in comps.items():
+            named = sum(n for s, n in info["stacks"].items()
+                        if ":" in s.rsplit(";", 1)[-1])
+            total = sum(info["stacks"].values())
+            assert total > 0, comp
+            assert named / total >= 0.8, (comp, info["stacks"])
+
+        # Time-series rollups: task throughput + phase series present.
+        deadline = time.time() + 20
+        ts = {}
+        while time.time() < deadline:
+            ts = core.cluster_timeseries(last=60)
+            if "tasks_finished" in ts.get("series", {}):
+                break
+            time.sleep(0.5)
+        series = ts["series"]
+        assert "tasks_finished" in series, sorted(series)
+        done = sum(c["sum"] for _, c in
+                   series["tasks_finished"]["points"])
+        assert done >= 300, series["tasks_finished"]
+        assert any(n.startswith("phase_seconds:") for n in series)
+        assert ts["bucket_s"] == 10.0
+
+        # Dashboard endpoint + sparkline panel.
+        dash = start_dashboard()
+        try:
+            with urllib.request.urlopen(f"{dash.url}/api/timeseries",
+                                        timeout=10) as r:
+                api = json.loads(r.read())
+            assert "tasks_finished" in api["series"]
+            html = urllib.request.urlopen(
+                dash.url, timeout=10).read().decode()
+            assert "time series" in html and "spark" in html
+        finally:
+            dash.stop()
+
+        # CLI: top --once renders one frame; profile writes the collapsed
+        # file flamegraph tools consume.
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli.main(["top", "--address", cluster.address, "--once"])
+        out = buf.getvalue()
+        assert "tasks/s" in out and "PHASE" in out
+
+        folded = tmp_path / "prof.folded"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli.main(["profile", "--address", cluster.address,
+                      "--seconds", "0", "--top", "5",
+                      "--out", str(folded)])
+        out = buf.getvalue()
+        assert "by self-time" in out and "SELF%" in out
+        lines = folded.read_text().splitlines()
+        assert lines
+        stack, count = lines[0].rsplit(" ", 1)
+        assert int(count) > 0 and ":" in stack
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.cluster
+def test_trace_sample_kv_broadcast(monkeypatch):
+    """`cli trace --sample N` adjusts the sampling rate on a LIVE cluster:
+    the kv cell reaches the driver's stats poll (and the controllers'
+    heartbeat poll) without any process restarts."""
+    from ray_tpu._private import tracing
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.scripts import cli
+
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "64")
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        ray_tpu.init(address=cluster.address)
+        assert tracing.sample_rate() == 64
+        cli.main(["trace", "--address", cluster.address, "--sample", "4"])
+        deadline = time.time() + 15
+        while time.time() < deadline and tracing.sample_rate() != 4:
+            time.sleep(0.2)
+        assert tracing.sample_rate() == 4
+        # -1 reverts to env/default.
+        cli.main(["trace", "--address", cluster.address, "--sample", "-1"])
+        deadline = time.time() + 15
+        while time.time() < deadline and tracing.rate_override() is not None:
+            time.sleep(0.2)
+        assert tracing.sample_rate() == 64
+    finally:
+        tracing.set_rate_override(None)
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.cluster
+def test_events_dropped_surfaced_in_get_events(monkeypatch):
+    """A tiny event ring overflows during normal cluster lifecycle; the
+    drop count must be visible in the get_events response `cli events`
+    prints (satellite: no more silent overwrites)."""
+    from ray_tpu.cluster.testing import Cluster
+
+    monkeypatch.setenv("RAY_TPU_EVENT_LOG_SIZE", "4")
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1)
+    try:
+        ray_tpu.init(address=cluster.address)
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core
+        # Remote lifecycle reports land in the same ring the GCS's own
+        # events use; 8 of them (+ node_up) overflow a 4-slot ring.
+        for i in range(8):
+            core.gcs.send_oneway({"type": "log_event",
+                                  "kind": "overflow_probe", "i": i})
+        deadline = time.time() + 10
+        resp = {}
+        while time.time() < deadline:
+            resp = core.gcs.call({"type": "get_events", "limit": 100})
+            if resp.get("dropped"):
+                break
+            time.sleep(0.2)
+        assert resp["capacity"] == 4
+        assert len(resp["events"]) <= 4
+        assert resp["dropped"] > 0
+        assert resp["total_logged"] == resp["dropped"] + 4
+        # The ring keeps the NEWEST events.
+        assert resp["events"][-1]["kind"] == "overflow_probe"
+        assert resp["events"][-1]["i"] == 7
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
