@@ -376,6 +376,38 @@ def open_publisher(name: str) -> Optional[RingPublisher]:
         return None
 
 
+def scan_stale_rings() -> int:
+    """Non-destructive twin of :func:`sweep_stale_rings`: count rings whose
+    owner's liveness flock has lapsed (dead owner, ~1 MiB tmpfs leaked
+    each) WITHOUT unlinking anything. The consistency auditor reports the
+    count (an ``audit_stale_ring`` finding); the janitor sweep on the next
+    controller start — or an operator running it by hand — reclaims them."""
+    stale = 0
+    try:
+        names = os.listdir(_ring_dir())
+    except OSError:
+        return 0
+    for fn in names:
+        if not fn.startswith("rtcr-") or fn.endswith(".lock"):
+            continue
+        lock_path = ring_path(fn) + ".lock"
+        try:
+            lfd = os.open(lock_path, os.O_RDWR)
+        except OSError:
+            stale += 1  # no liveness lock at all: pre-lock leftover
+            continue
+        try:
+            try:
+                fcntl.flock(lfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                continue  # owner alive
+            fcntl.flock(lfd, fcntl.LOCK_UN)
+            stale += 1
+        finally:
+            os.close(lfd)
+    return stale
+
+
 def sweep_stale_rings() -> int:
     """Janitor: unlink rings whose owner died without close() (SIGKILLed
     worker, crashed driver) — each leaks ~1 MiB of tmpfs otherwise. An

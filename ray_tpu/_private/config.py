@@ -85,6 +85,10 @@ class Config:
     timeseries_bucket_s: int = 10
     timeseries_retention_buckets: int = 360
     timeseries_tick_s: float = 2.0
+    # Consistency auditor: seconds between periodic GCS reconciliation
+    # passes (directory vs controller arenas/spill dirs/rings/task table).
+    # <= 0 disables the loop; `cli doctor` still audits on demand.
+    audit_interval_s: float = 30.0
     # --- raw overrides applied last ---
     _overrides: Dict[str, Any] = field(default_factory=dict)
 

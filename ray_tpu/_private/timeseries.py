@@ -176,6 +176,18 @@ def window_rate(points: Sequence[Sequence], since: float,
     return window_sum(points, since) / span
 
 
+def latest_value(points: Sequence[Sequence],
+                 key: str = "last") -> Optional[float]:
+    """Newest bucket's cell value (``last`` for gauges, pass ``sum`` for
+    delta cells); None when the series is empty. The one-liner every
+    'current value of this gauge series' consumer (`cli top` rows,
+    `cli doctor` snapshots) kept re-writing."""
+    if not points:
+        return None
+    cell = points[-1][1]
+    return cell.get(key)
+
+
 def merge_hist(cells: Iterable[Dict]) -> Dict:
     """Additively merge hist cells (e.g. every bucket of a window) into one
     {buckets, sum, count} distribution."""

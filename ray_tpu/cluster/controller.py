@@ -341,6 +341,11 @@ class NodeController:
                     last_report = now
                     stats = sampler.sample([os.getpid(), *self.workers])
                     stats["store"] = self.store.stats()
+                    # Consistency-audit inventory: what this node actually
+                    # holds (arena + overflow + spill dir + ring health),
+                    # cross-checked against the GCS object directory by
+                    # the reconciliation pass / `cli doctor`.
+                    stats["audit"] = self._audit_inventory()
                     # Handler stats ride along so the GCS's time-series
                     # rollups see controller-side counters too.
                     stats["handler_stats"] = {
@@ -385,6 +390,35 @@ class NodeController:
                                     pass
             except ConnectionError:
                 return
+
+    def _audit_inventory(self) -> Optional[Dict[str, Any]]:
+        """One inventory snapshot for the GCS consistency auditor: every
+        object id this node can serve (arena, overflow dict, spill dir)
+        plus completion-ring liveness. Bounded: an arena past 65536
+        objects reports ``arena_complete=False`` and the auditor skips
+        absence-based checks for it (presence-based ones still work).
+        RAY_TPU_AUDIT_INTERVAL_S<=0 disables the whole subsystem (the
+        GCS reconciliation loop and this inventory) — the A/B arm."""
+        if float(getattr(self.config, "audit_interval_s", 30.0)) <= 0:
+            return None
+        try:
+            base = self.store.base if self._spilling else self.store
+            arena = base.list_ids()
+            audit: Dict[str, Any] = {
+                "ts": time.time(),
+                "arena": arena,
+                "arena_complete": len(arena) < (1 << 16),
+                "overflow": list(self._overflow),
+                "inline_cached": len(self._inline),
+            }
+            if self._spilling:
+                audit["spilled"] = self.store.spill.ids()
+            from .._native import completion_ring as _cring
+
+            audit["stale_rings"] = _cring.scan_stale_rings()
+            return audit
+        except Exception:  # noqa: BLE001 - the audit must never cost a beat
+            return None
 
     def _borrow_call_refs(self, msg: Dict) -> None:
         if not self.config.ref_counting_enabled:

@@ -1951,12 +1951,55 @@ class ClusterCoreWorker:
     def cluster_events(self, limit: Optional[int] = None,
                        kind: Optional[str] = None):
         """Structured lifecycle events from the GCS cluster event log."""
+        return self.cluster_events_page(limit=limit, kind=kind)["events"]
+
+    def cluster_events_page(self, limit: Optional[int] = None,
+                            kind: Optional[str] = None,
+                            after_seq: Optional[int] = None
+                            ) -> Dict[str, Any]:
+        """Full event-log response (events + drop accounting + seq
+        cursors). ``after_seq`` makes it a tail read: only events newer
+        than the cursor come back (`cli events --follow`)."""
         msg: Dict[str, Any] = {"type": "get_events"}
         if limit:
             msg["limit"] = int(limit)
         if kind:
             msg["kind"] = kind
-        return self.gcs.call(msg)["events"]
+        if after_seq is not None:
+            msg["after_seq"] = int(after_seq)
+        return self.gcs.call(msg)
+
+    # ------------------------------------------------------ state API v2
+    def list_tasks(self, state: Optional[str] = None,
+                   kind: Optional[str] = None,
+                   node_id: Optional[str] = None,
+                   reason: Optional[str] = None,
+                   name_contains: Optional[str] = None,
+                   limit: int = 1000, offset: int = 0) -> Dict[str, Any]:
+        """Bounded/filterable/paginated query over the GCS task table:
+        {tasks, total, truncated}."""
+        msg: Dict[str, Any] = {"type": "list_tasks",
+                               "limit": int(limit), "offset": int(offset)}
+        for key, val in (("state", state), ("kind", kind),
+                         ("node_id", node_id), ("reason", reason),
+                         ("name_contains", name_contains)):
+            if val:
+                msg[key] = val
+        return self.gcs.call(msg)
+
+    def task_summary(self) -> Dict[str, Any]:
+        """Per-state/kind/pending-reason counts over the GCS task table."""
+        return self.gcs.call({"type": "task_summary"})
+
+    def get_task(self, task_id: str) -> Dict[str, Any]:
+        """One task's full record by id (hex prefix accepted)."""
+        return self.gcs.call({"type": "get_task", "task_id": task_id})
+
+    def run_audit(self, verify: bool = True,
+                  timeout: float = 120.0) -> Dict[str, Any]:
+        """On-demand GCS consistency audit: {findings, summary}."""
+        return self.gcs.call({"type": "run_audit", "verify": verify},
+                             timeout=timeout)
 
     def shutdown(self):
         self._flush_submits()

@@ -22,6 +22,13 @@ from .._private.config import Config
 from .._private.resources import NUM_PREDEFINED, ResourceSet, dense_matrix
 from .protocol import Connection, RpcServer
 
+# The pending reasons trended as per-tick gauges. A literal (not an import)
+# on purpose: scheduler.kernel imports jax, which must never load on the
+# GCS event loop's rollup tick — tests pin this equal to
+# kernel.REASON_NAMES[1:].
+_REASON_GAUGE_NAMES = ("waiting-for-deps", "waiting-for-capacity",
+                       "infeasible", "waiting-for-pg", "quota-throttled")
+
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
@@ -70,8 +77,10 @@ class GcsServer:
         self.kv: Dict[str, bytes] = {}
         self.subscribers: Dict[str, Set[Connection]] = {}
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = {}
-        # placement queue: (demand ResourceSet, locality node_id|None, future)
-        self._pending_place: List[Tuple[ResourceSet, Optional[str], asyncio.Future]] = []
+        # placement queue: (demand ResourceSet, locality node_id|None,
+        # future, task record|None — the record lets an unplaced tick
+        # land its pending-reason on the task table)
+        self._pending_place: List[Tuple] = []
         # Dep-free task records queued straight for the placement loop —
         # the hot-path lane with NO per-task coroutine/future (the
         # create_task+future machinery alone cost ~50-70us/task at 5k-task
@@ -97,6 +106,11 @@ class GcsServer:
         self.cluster_events: Any = _deque(
             maxlen=max(int(getattr(config, "event_log_size", 20_000)), 1))
         self.events_dropped = 0
+        # Monotonic per-event sequence: the cursor `cli events --follow`
+        # tails from (a follower holding seq S asks for seq > S; a gap
+        # between S and the ring's oldest surviving seq means eviction
+        # outran the poll — surfaced, never silent).
+        self._event_seq = 0
         # Cumulative event count per kind (feeds the time-series rollups
         # and the SLO error-rate rule without scanning the ring).
         self._event_counts: Dict[str, int] = {}
@@ -176,6 +190,19 @@ class GcsServer:
         self._early_task_done_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
         self.node_stats: Dict[str, Dict[str, Any]] = {}  # reporter data
+        # ---- consistency auditor (the invariant-checking substrate the
+        # head-sharding refactor needs before state leaves this process).
+        # _node_audit: node_id -> deque of the last 2 inventory snapshots
+        # the controller piggybacked on node_stats ({ts, arena, overflow,
+        # spilled, rings, stale_rings}). Two observations straddle the
+        # one-way registration window: an arena object absent from the
+        # directory across BOTH snapshots is leaked, not in flight.
+        self._node_audit: Dict[str, Any] = {}
+        # Dedupe for audit_* events: a standing fault is reported once,
+        # not once per periodic pass (bounded; evicted oldest-first).
+        self._audit_seen: Set[Tuple] = set()
+        self._audit_seen_order: Any = _deque()
+        self._last_audit: Dict[str, Any] = {}
         # ---- Placement groups (all-or-nothing gang scheduling). Each
         # record: pg_id, bundles, strategy, state (PENDING -> CREATED ->
         # REMOVED / RESCHEDULING), per-bundle node ids, pending reason
@@ -217,6 +244,7 @@ class GcsServer:
         Values must stay JSON-serializable (the dashboard serves them).
         A full ring evicts the oldest event — counted, not silent."""
         self._event_counts[kind] = self._event_counts.get(kind, 0) + 1
+        self._event_seq += 1
         if len(self.cluster_events) == self.cluster_events.maxlen:
             self.events_dropped += 1
             try:
@@ -229,7 +257,7 @@ class GcsServer:
             except Exception:  # noqa: BLE001 - metrics never fail control
                 pass
         self.cluster_events.append(
-            {"ts": time.time(), "kind": kind, **data})
+            {"ts": time.time(), "kind": kind, "seq": self._event_seq, **data})
 
     def _trace_span(self, trace, task_id, phase: str,
                     start_mono: float, end_mono: float) -> None:
@@ -319,6 +347,16 @@ class GcsServer:
         self._tasks.append(asyncio.create_task(self._pg_loop()))
         self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
         self._tasks.append(asyncio.create_task(self._stats_loop()))
+        self._tasks.append(asyncio.create_task(self._audit_loop()))
+        # Warm the scheduler import off-loop: the pending-reason classifier
+        # routes through scheduler.reference, whose module chain imports
+        # jax — that must never load inline on the event loop's first
+        # unplaced tick.
+        import threading as _threading
+
+        _threading.Thread(
+            target=lambda: __import__("ray_tpu.scheduler.reference"),
+            daemon=True, name="reason-import-warm").start()
         if getattr(self.config, "flight_recorder", True):
             from .._private import flight_recorder
 
@@ -504,6 +542,28 @@ class GcsServer:
                 self.timeseries.add_gauge(f"pg_state:{state}", n)
         self.timeseries.add_gauge("objects_in_directory", len(self.objects))
         self.timeseries.add_gauge("tasks_in_table", len(self.task_table))
+        # Pending-by-reason gauges (the demand-attribution stream the
+        # policy work in ROADMAP item 4 consumes): every reason emits a
+        # point each tick — zeros included, so `cli top` and the SLO
+        # engine see recoveries, not just onsets.
+        reasons: Dict[str, int] = {}
+        pending = 0
+        for rec in self.task_table.values():
+            if rec["state"] != "PENDING":
+                continue
+            pending += 1
+            name = rec.get("pending_reason") or "unclassified"
+            reasons[name] = reasons.get(name, 0) + 1
+        self.timeseries.add_gauge("tasks_pending", pending)
+        for name in _REASON_GAUGE_NAMES:
+            self.timeseries.add_gauge(f"pending_reason:{name}",
+                                      reasons.get(name, 0))
+        if reasons.get("unclassified"):
+            self.timeseries.add_gauge("pending_reason:unclassified",
+                                      reasons["unclassified"])
+        if self._last_audit:
+            self.timeseries.add_gauge("audit_findings",
+                                      self._last_audit.get("total", 0))
 
     async def _stats_loop(self):
         """Periodic observability tick: drain this process's stack sampler
@@ -525,6 +585,218 @@ class GcsServer:
                             rec, sum(stacks.values()))
                 self._roll_timeseries_tick()
             except Exception:  # noqa: BLE001 - observability never kills GCS
+                import traceback
+
+                traceback.print_exc()
+
+    # ----------------------------------------------- consistency auditor
+    # Every finding kind the reconciliation pass can emit (the Prometheus
+    # gauge's tag domain — zeros are exported so recoveries are visible).
+    _AUDIT_KINDS = ("leaked_object", "stale_location", "phantom_location",
+                    "stale_spill", "orphaned_task", "lineage_orphan",
+                    "inline_divergence", "stale_ring")
+
+    def note_node_audit(self, node_id: str, audit: Dict[str, Any]) -> None:
+        """One controller inventory snapshot (rode node_stats). The last
+        TWO snapshots are kept per node: an arena object must be observed
+        across both — straddling the one-way registration window — before
+        the audit may call it leaked, and a directory location must predate
+        the older snapshot before it may be called stale."""
+        from collections import deque as _deque
+
+        ring = self._node_audit.get(node_id)
+        if ring is None:
+            ring = self._node_audit[node_id] = _deque(maxlen=2)
+        ring.append(audit)
+
+    async def run_audit(self, verify: bool = True) -> Dict[str, Any]:
+        """One cross-process reconciliation pass: the GCS's view of
+        objects/tasks checked against what controllers, owners, and spill
+        dirs actually hold. Emits ``audit_*`` cluster events (new findings
+        only — a standing fault is one event, not one per pass), Prometheus
+        gauges, and a time-series point; `cli doctor` calls it on demand
+        and bundles the result. ``verify=True`` confirms inventory-derived
+        location suspects with a live ``has_object`` probe before flagging
+        (which also self-heals: the controller retracts its own stale
+        directory entry on a miss). This is the invariant substrate the
+        owner-sharded-state refactor (ROADMAP 1-2) must keep green."""
+        t0 = time.monotonic()
+        now = time.time()
+        grace = 1.0
+        findings: List[Dict[str, Any]] = []
+
+        def flag(kind: str, **data) -> None:
+            findings.append({"kind": kind, **data})
+
+        # --- directory invariants: locations must name live nodes.
+        for oid, entry in list(self.objects.items()):
+            for nid in sorted(entry["locations"]):
+                node = self.nodes.get(nid)
+                if node is None or not node.alive:
+                    flag("phantom_location", object_id=oid.hex(),
+                         node_id=nid, where="arena")
+            for nid in sorted(self._spilled_set(entry)):
+                node = self.nodes.get(nid)
+                if node is None or not node.alive:
+                    flag("phantom_location", object_id=oid.hex(),
+                         node_id=nid, where="spill")
+
+        # --- inventory cross-checks (controller arenas + spill dirs +
+        # owner completion rings, via the audit block riding node_stats).
+        suspects: Dict[str, List[bytes]] = {}
+        nodes_checked = 0
+        for nid, ring in list(self._node_audit.items()):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive or len(ring) < 2:
+                continue
+            nodes_checked += 1
+            prev, cur = ring[0], ring[-1]
+            inv_prev = set(prev.get("arena") or ()) \
+                | set(prev.get("overflow") or ())
+            inv_cur = set(cur.get("arena") or ()) \
+                | set(cur.get("overflow") or ())
+            if cur.get("arena_complete", True) \
+                    and prev.get("arena_complete", True):
+                # Leaked: held across BOTH snapshots yet unknown to the
+                # directory, the free tombstones, lineage, and the error
+                # table — nobody can ever reach or reclaim it.
+                for oid in inv_prev & inv_cur:
+                    if (oid in self.objects or oid in self._freed
+                            or oid in self.error_objects
+                            or oid in self.lineage):
+                        continue
+                    flag("leaked_object", object_id=oid.hex(), node_id=nid)
+                # Stale: the directory advertises an arena copy on this
+                # node, the entry predates the OLDER snapshot, and neither
+                # snapshot saw it. Verified below before flagging.
+                for oid, entry in list(self.objects.items()):
+                    if nid not in entry["locations"] \
+                            or entry.get("inline") is not None:
+                        continue
+                    if entry.get("ts", now) + grace > prev.get("ts", 0.0):
+                        continue  # registered too recently to judge
+                    if oid in inv_cur or oid in inv_prev:
+                        continue
+                    suspects.setdefault(nid, []).append(oid)
+            sp_prev, sp_cur = prev.get("spilled"), cur.get("spilled")
+            if sp_prev is not None and sp_cur is not None:
+                sp_seen = set(sp_prev) | set(sp_cur)
+                for oid, entry in list(self.objects.items()):
+                    if nid not in self._spilled_set(entry):
+                        continue
+                    if entry.get("ts", now) + grace > prev.get("ts", 0.0):
+                        continue
+                    if oid not in sp_seen:
+                        flag("stale_spill", object_id=oid.hex(),
+                             node_id=nid)
+            if int(cur.get("stale_rings") or 0) > 0:
+                # Completion rings whose owner's liveness flock lapsed:
+                # dead owners leaking tmpfs until the next sweep.
+                flag("stale_ring", node_id=nid,
+                     count=int(cur["stale_rings"]))
+
+        for nid, oids in suspects.items():
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            held: Optional[Dict[bytes, bool]] = None
+            if verify:
+                held = await asyncio.to_thread(
+                    self._probe_node_holds, tuple(node.address), oids[:256])
+            for oid in oids[:256]:
+                if held is None or not held.get(oid, True):
+                    flag("stale_location", object_id=oid.hex(), node_id=nid)
+
+        # --- task-table invariants.
+        for oid, tid in list(self.lineage.items()):
+            if tid not in self.task_table:
+                flag("lineage_orphan", object_id=oid.hex(),
+                     task_id=tid.hex())
+        for tid, rec in list(self.task_table.items()):
+            if rec["state"] != "DISPATCHED":
+                continue
+            node = self.nodes.get(rec["node_id"] or "")
+            if node is None or not node.alive:
+                flag("orphaned_task", task_id=tid.hex(),
+                     node_id=str(rec["node_id"]),
+                     detail="DISPATCHED to a dead/unknown node")
+
+        # --- inline-budget accounting must reconcile exactly.
+        actual = sum(len(e["inline"]) for e in self.objects.values()
+                     if e.get("inline") is not None)
+        if actual != self._inline_total:
+            flag("inline_divergence", tracked=int(self._inline_total),
+                 actual=int(actual))
+
+        by_kind: Dict[str, int] = {}
+        for f in findings:
+            by_kind[f["kind"]] = by_kind.get(f["kind"], 0) + 1
+            key = (f["kind"], f.get("object_id") or f.get("task_id"),
+                   f.get("node_id"))
+            if key not in self._audit_seen:
+                self._audit_seen.add(key)
+                self._audit_seen_order.append(key)
+                while len(self._audit_seen_order) > 10_000:
+                    self._audit_seen.discard(
+                        self._audit_seen_order.popleft())
+                self.record_event(
+                    f"audit_{f['kind']}",
+                    **{k: v for k, v in f.items() if k != "kind"})
+        dur = time.monotonic() - t0
+        summary = {"ts": now, "duration_s": round(dur, 4),
+                   "total": len(findings), "by_kind": by_kind,
+                   "nodes_checked": nodes_checked,
+                   "objects_checked": len(self.objects),
+                   "tasks_checked": len(self.task_table),
+                   "verified": bool(verify)}
+        self._last_audit = summary
+        try:
+            from ..metrics import audit_metrics
+
+            m = audit_metrics()
+            m["runs"].record(1.0)
+            m["duration"].record(dur)
+            for kind in self._AUDIT_KINDS:
+                m["findings"].record(float(by_kind.get(kind, 0)),
+                                     tags={"kind": kind})
+        except Exception:  # noqa: BLE001 - metrics never fail the audit
+            pass
+        self.timeseries.add_gauge("audit_findings", float(len(findings)))
+        return {"findings": findings, "summary": summary}
+
+    def _probe_node_holds(self, addr, oids) -> Dict[bytes, bool]:
+        """Thread-side: ask one controller which of ``oids`` it actually
+        holds. Unreachable nodes answer True (don't flag what can't be
+        confirmed — the phantom-location check covers dead nodes)."""
+        from .protocol import RpcClient
+
+        out: Dict[bytes, bool] = {}
+        try:
+            cli = RpcClient(addr[0], int(addr[1]))
+        except Exception:  # noqa: BLE001
+            return out
+        try:
+            for oid in oids:
+                try:
+                    out[oid] = bool(cli.call(
+                        {"type": "has_object", "object_id": oid},
+                        timeout=5.0).get("has"))
+                except Exception:  # noqa: BLE001
+                    out[oid] = True
+        finally:
+            cli.close()
+        return out
+
+    async def _audit_loop(self) -> None:
+        """Periodic reconciliation (RAY_TPU_AUDIT_INTERVAL_S; <=0 off)."""
+        interval = float(getattr(self.config, "audit_interval_s", 30.0))
+        if interval <= 0:
+            return
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.run_audit(verify=True)
+            except Exception:  # noqa: BLE001 - the auditor never kills GCS
                 import traceback
 
                 traceback.print_exc()
@@ -558,6 +830,10 @@ class GcsServer:
             "retries_left": retries, "state": "PENDING",
             "node_id": None, "cancelled": False,
             "return_ids": list(payload.get("return_ids", [])),
+            # State API v2 fields: lifecycle wall-clock stamps + the
+            # pending-reason attribution the placement pass maintains.
+            "ts_submit": time.time(), "ts_dispatch": 0.0, "ts_finish": 0.0,
+            "pending_reason": "",
         }
         self.task_table[task_id] = rec
         if payload.get("trace") is not None:
@@ -611,6 +887,10 @@ class GcsServer:
         Returns False when a dep failed terminally (error propagated)."""
         for oid in rec["payload"].get("deps", []):
             while not self._dep_alive(oid):
+                # Explainability: the record is held OUT of the placement
+                # queue here, so the per-tick classifier never sees it —
+                # attribute the wait directly (cleared on dispatch).
+                rec["pending_reason"] = "waiting-for-deps"
                 if rec["cancelled"]:
                     self._fail_record(rec, self._cancel_error(rec))
                     return False
@@ -643,7 +923,7 @@ class GcsServer:
                     return
                 fut = asyncio.get_event_loop().create_future()
                 self._pending_place.append(
-                    (demand, rec["payload"].get("locality"), fut))
+                    (demand, rec["payload"].get("locality"), fut, rec))
                 self._place_event.set()
                 nid = await fut
                 if nid is None:
@@ -661,6 +941,8 @@ class GcsServer:
                 rec["node_id"] = nid
                 rec["state"] = "DISPATCHED"
                 rec["direct_dispatch"] = False  # this dispatch holds a share
+                rec["ts_dispatch"] = time.time()
+                rec["pending_reason"] = ""
                 self._trace_placed(rec)
                 if await self._dispatch_to_node(nid, rec):
                     return
@@ -740,6 +1022,8 @@ class GcsServer:
         rec["node_id"] = nid
         rec["state"] = "DISPATCHED"
         rec["direct_dispatch"] = False
+        rec["ts_dispatch"] = time.time()
+        rec["pending_reason"] = ""
         self._trace_placed(rec)
         self._queue_assign(nid, rec["payload"])
 
@@ -835,6 +1119,8 @@ class GcsServer:
                      blob: Optional[bytes] = None) -> None:
         """Terminal failure: serve the error straight from the directory."""
         rec["state"] = "FAILED"
+        rec["ts_finish"] = time.time()
+        rec["pending_reason"] = ""
         self._unpin_deps(rec)
         if blob is None:
             blob = b"E" + pickle.dumps(err)
@@ -850,6 +1136,8 @@ class GcsServer:
         if rec is None:
             return
         rec["state"] = "FINISHED"
+        rec["ts_finish"] = time.time()
+        rec["pending_reason"] = ""
         if rec["kind"] == "actor":
             # The creation record doubles as restart lineage; it is dropped
             # when the actor goes terminally DEAD, not by the eviction cap —
@@ -1117,6 +1405,7 @@ class GcsServer:
         self.record_event("node_down", node_id=node.node_id)
         self._node_conns.pop(node.node_id, None)
         self.node_stats.pop(node.node_id, None)  # reporter data dies with it
+        self._node_audit.pop(node.node_id, None)  # stale inventories too
         for oid, entry in list(self.objects.items()):
             entry["locations"].discard(node.node_id)
             self._spilled_set(entry).discard(node.node_id)
@@ -1220,14 +1509,16 @@ class GcsServer:
                 if rec["cancelled"] or rec["state"] != "PENDING":
                     continue
                 entries.append((ResourceSet.from_dict(rec["resources"]),
-                                rec["payload"].get("locality"), rec))
+                                rec["payload"].get("locality"), rec, rec))
             if not entries:
                 continue
             t_place0 = time.monotonic()
             alive = [nid for nid in self._node_order
                      if self.nodes[nid].alive]
             if not alive:
-                for _, _, sink in entries:
+                self._classify_unplaced([(d, rec) for d, _, _, rec
+                                         in entries])
+                for _, _, sink, _ in entries:
                     self._grant(sink, None)
                 continue
             if len(entries) * len(alive) <= 1024:
@@ -1245,7 +1536,8 @@ class GcsServer:
         dicts, locality honored when feasible, with the same queue-at-node
         fallback as the matrix path (totals-feasible node with the most —
         possibly negative — headroom)."""
-        for dset, loc, sink in entries:
+        deferred = []
+        for dset, loc, sink, rec in entries:
             if self._sink_stale(sink):
                 continue
             d = dset.to_dict()
@@ -1288,27 +1580,30 @@ class GcsServer:
                     if best is None or score > best:
                         best, pick = score, nid
             if pick is None:
+                deferred.append((dset, rec))
                 self._grant(sink, None)
             else:
                 self._acquire(pick, dset)
                 self._grant(sink, pick)
+        self._classify_unplaced(deferred)
 
     async def _place_tick_matrix(self, batch) -> None:
         """Large-tick placement: one dense matrix, one kernel/numpy call."""
         # Custom resources (e.g. accelerator tags) join the dense matrix
         # as extra columns for this tick.
         custom_names = tuple(sorted(
-            {name for d, _, _ in batch for name in d.custom}
+            {name for d, _, _, _ in batch for name in d.custom}
         ))
         avail, totals, order = self._avail_matrix(custom_names)
         if not order:
-            for _, _, sink in batch:
+            self._classify_unplaced([(d, rec) for d, _, _, rec in batch])
+            for _, _, sink, _ in batch:
                 self._grant(sink, None)
             return
         index_of = {nid: i for i, nid in enumerate(order)}
-        demand = dense_matrix([d for d, _, _ in batch], custom_names)
+        demand = dense_matrix([d for d, _, _, _ in batch], custom_names)
         locality = np.array(
-            [index_of.get(loc, -1) if loc else -1 for _, loc, _ in batch],
+            [index_of.get(loc, -1) if loc else -1 for _, loc, _, _ in batch],
             dtype=np.int32,
         )
         # Kernel ticks run off the event loop: a compile (new bucket
@@ -1336,7 +1631,8 @@ class GcsServer:
         # placements away from deep queues. Only totals-infeasible
         # tasks remain deferred (they feed the autoscaler demand).
         headroom = avail.astype(np.int64).copy()
-        for (dset, _, sink), node_idx in zip(batch, placement):
+        deferred = []
+        for (dset, _, sink, rec), node_idx in zip(batch, placement):
             if self._sink_stale(sink):
                 continue
             if node_idx < 0:
@@ -1357,11 +1653,91 @@ class GcsServer:
                     node_idx = int(np.argmax(scores))
                     headroom[node_idx] -= d
                 else:
+                    deferred.append((dset, rec))
                     self._grant(sink, None)  # infeasible; slow path retries
                     continue
             nid = order[int(node_idx)]
             self._acquire(nid, dset)
             self._grant(sink, nid)
+        self._classify_unplaced(deferred)
+
+    # ------------------------------------------ scheduling explainability
+    def _pg_waiting_for(self, dset: ResourceSet) -> bool:
+        """Is this demand a member of a placement group that is not (yet)
+        CREATED? Group-scoped resource names carry the pg id as their last
+        ``_``-separated token (``CPU_group_<i>_<pgid>``)."""
+        for name in dset.custom:
+            if "_group_" not in name:
+                continue
+            try:
+                pg_id = bytes.fromhex(name.rsplit("_", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            rec = self.placement_groups.get(pg_id)
+            if rec is not None and rec["state"] in ("PENDING",
+                                                    "RESCHEDULING"):
+                return True
+        return False
+
+    def _classify_unplaced(self, deferred) -> None:
+        """Attribute every demand a placement tick left unplaced to one
+        pending reason (waiting-for-deps / waiting-for-capacity /
+        infeasible / waiting-for-pg / quota-throttled) — the generalization
+        of the pg table's infeasible-vs-waiting split to all tasks.
+
+        ``deferred`` is [(ResourceSet, task record|None)]. The reason lands
+        on the task record (state API / `cli task`) and as per-reason
+        deltas in the time-series store. Served by the scalar reference —
+        unplaced sets are small off the pathological path, and the jit
+        pass (RAY_TPU_REASON_KERNEL=1) is pinned bit-identical by the
+        property tests, exactly like gang admission. Re-classification of
+        a record that already holds a fresh reason is throttled: an
+        infeasible task retries every ~20 ms and its verdict rarely
+        changes."""
+        if not deferred:
+            return
+        now_mono = time.monotonic()
+        work = [(d, rec) for d, rec in deferred
+                if rec is None or not rec.get("pending_reason")
+                or now_mono - rec.get("_reason_mono", 0.0) > 1.0]
+        if not work:
+            return
+        import os as _os
+
+        names = ("placed",) + _REASON_GAUGE_NAMES
+        custom_names = tuple(sorted(
+            {name for d, _ in work for name in d.custom}))
+        _, totals, _ = self._avail_matrix(custom_names)
+        demand = dense_matrix([d for d, _ in work], custom_names)
+        T = demand.shape[0]
+        placement = np.full(T, -1, np.int32)
+        waiting_deps = np.zeros(T, bool)  # queue entries staged deps already
+        waiting_pg = np.array([self._pg_waiting_for(d) for d, _ in work],
+                              dtype=bool)
+        # Reserved for the ROADMAP-4 policy passes (per-job quotas /
+        # weights): nothing throttles today, so the mask is all-False —
+        # the classifier spec and its property tests already cover it.
+        quota = np.zeros(T, bool)
+        if _os.environ.get("RAY_TPU_REASON_KERNEL", "") not in ("", "0"):
+            from ..scheduler.kernel import classify_pending_host
+
+            codes = classify_pending_host(
+                demand, placement, totals, waiting_deps, waiting_pg, quota)
+        else:
+            from ..scheduler import reference as _ref
+
+            codes = _ref.classify_pending_reference(
+                demand, placement, totals, waiting_deps, waiting_pg, quota)
+        counts: Dict[str, int] = {}
+        for (dset, rec), code in zip(work, codes):
+            name = names[int(code)]
+            counts[name] = counts.get(name, 0) + 1
+            if rec is not None and rec["state"] == "PENDING":
+                rec["pending_reason"] = name
+                rec["_reason_mono"] = now_mono
+        for name, n in counts.items():
+            self._stat_add(f"reason:{name}", 0.0, n)
+            self.timeseries.add_delta(f"reason_classified:{name}", n)
 
     # -------- placement backend selection (self-tuning crossover) --------
     # Round-3 verdict: the numpy-vs-kernel crossover was a hardcoded T<64,
@@ -1968,7 +2344,8 @@ class GcsServer:
                 try:
                     while True:
                         fut = asyncio.get_event_loop().create_future()
-                        self._pending_place.append((demand, locality, fut))
+                        self._pending_place.append(
+                            (demand, locality, fut, None))
                         self._place_event.set()
                         node_id = await fut
                         if node_id is not None:
@@ -2036,6 +2413,8 @@ class GcsServer:
                 "direct_dispatch": True,
                 "cancelled": False,
                 "return_ids": list(payload.get("return_ids", [])),
+                "ts_submit": time.time(), "ts_dispatch": time.time(),
+                "ts_finish": 0.0, "pending_reason": "",
             }
             self.task_table[task_id] = rec
             self._pin_deps(rec)
@@ -2416,7 +2795,7 @@ class GcsServer:
                     self._spawn(self._push_delete(node_conn, [oid]))
                 return
             entry = self.objects.setdefault(
-                oid, {"locations": set(), "size": size}
+                oid, {"locations": set(), "size": size, "ts": time.time()}
             )
             if blob is not None and "inline" not in entry:
                 entry["inline"] = blob
@@ -2461,7 +2840,8 @@ class GcsServer:
                         pass
                 return None
             entry = self.objects.setdefault(
-                oid, {"locations": set(), "size": msg.get("size", 0)}
+                oid, {"locations": set(), "size": msg.get("size", 0),
+                      "ts": time.time()}
             )
             self.record_event("object_spilled", object_id=oid.hex()[:16],
                               node_id=msg["node_id"],
@@ -2545,6 +2925,11 @@ class GcsServer:
                     stats.pop("stack_component", "controller"), stacks,
                     samples=stats.pop("stack_samples", 0) or
                     sum(stacks.values()))
+            # Consistency-audit inventory riding the report: kept out of
+            # node_stats (get_node_stats consumers don't want oid lists).
+            audit = stats.pop("audit", None)
+            if audit:
+                self.note_node_audit(msg["node_id"], audit)
             self.node_stats[msg["node_id"]] = stats
             return None
 
@@ -2865,10 +3250,18 @@ class GcsServer:
 
         @s.handler("get_events")
         async def get_events(msg, conn):
+            """Event-log query. ``after_seq`` turns it into a cursor read
+            (`cli events --follow`): only events with seq > after_seq are
+            returned, and ``oldest_seq``/``last_seq`` let the follower
+            detect when ring eviction outran its poll (a gap between its
+            cursor and oldest_seq = events it can never see)."""
             limit = int(msg.get("limit") or 1000)
             kind = msg.get("kind")
+            after = msg.get("after_seq")
             out = []
             for ev in reversed(self.cluster_events):
+                if after is not None and ev.get("seq", 0) <= after:
+                    break  # the ring is seq-ordered: nothing older matches
                 if kind is not None and ev.get("kind") != kind:
                     continue
                 out.append(ev)
@@ -2877,18 +3270,41 @@ class GcsServer:
             return {"ok": True, "events": out[::-1],
                     "dropped": self.events_dropped,
                     "capacity": self.cluster_events.maxlen,
+                    "last_seq": self._event_seq,
+                    "oldest_seq": (self.cluster_events[0].get("seq", 0)
+                                   if self.cluster_events else None),
                     "total_logged": sum(self._event_counts.values())}
 
         @s.handler("list_objects")
         async def list_objects(msg, conn):
+            limit = msg.get("limit", 1000)
             out = {}
-            for oid, info in list(self.objects.items())[:msg.get("limit", 1000)]:
+            for oid, info in list(self.objects.items())[:limit]:
                 out[oid.hex() if isinstance(oid, bytes) else str(oid)] = {
                     "locations": list(info.get("locations", [])),
                     "spilled": list(info.get("spilled", [])),
                     "size": info.get("size", 0),
                     "inline": info.get("inline") is not None,
+                    # Two error sources, both served here (a hardcoded
+                    # False made `cli memory` lie): control-plane
+                    # failures live in the error table; application
+                    # exceptions are ordinary result blobs with the "E"
+                    # serialization prefix — visible whenever the
+                    # directory holds the inline bytes. (Large errored
+                    # results on remote arenas stay unflagged: the GCS
+                    # never sees their bytes.)
+                    "has_error": oid in self.error_objects
+                    or (info.get("inline") or b"")[:1] == b"E",
                 }
+            # Objects that ONLY exist as terminal error blobs (no holder
+            # anywhere) still belong in the memory view.
+            for oid in list(self.error_objects):
+                if len(out) >= limit:
+                    break
+                hexid = oid.hex() if isinstance(oid, bytes) else str(oid)
+                if hexid not in out:
+                    out[hexid] = {"locations": [], "spilled": [], "size": 0,
+                                  "inline": False, "has_error": True}
             return {"ok": True, "objects": out}
 
         @s.handler("debug_state")
@@ -2904,6 +3320,123 @@ class GcsServer:
             ], "num_objects": len(self.objects),
                "num_errors": len(self.error_objects),
                "pending_place": len(self._pending_place)}
+
+        # ---- state API v2: the queryable task table ----
+        def _task_row(tid: bytes, r: Dict[str, Any]) -> Dict[str, Any]:
+            return {
+                "task_id": tid.hex(), "kind": r["kind"],
+                "state": r["state"],
+                "name": r["payload"].get("name") or "",
+                "node_id": r["node_id"] or "",
+                "pending_reason": r.get("pending_reason") or "",
+                "retries_left": r["retries_left"],
+                "cancelled": bool(r["cancelled"]),
+                "ts_submit": float(r.get("ts_submit") or 0.0),
+                "ts_dispatch": float(r.get("ts_dispatch") or 0.0),
+                "ts_finish": float(r.get("ts_finish") or 0.0),
+            }
+
+        @s.handler("list_tasks")
+        async def list_tasks(msg, conn):
+            """Bounded, filterable, paginated task-table query (reference:
+            Ray's state API ListTasks over the GCS task table,
+            arXiv:1712.05889 §GCS). Filters: state / kind / node_id /
+            reason / name_contains. ``total`` counts every match, so a
+            pager knows when it's done; the response is hard-capped at
+            10k rows regardless of the requested limit."""
+            limit = max(0, min(int(msg.get("limit") or 1000), 10_000))
+            offset = max(int(msg.get("offset") or 0), 0)
+            want_state = msg.get("state")
+            want_kind = msg.get("kind")
+            want_node = msg.get("node_id")
+            want_reason = msg.get("reason")
+            contains = msg.get("name_contains")
+            total = 0
+            rows: List[Dict[str, Any]] = []
+            for tid, r in self.task_table.items():
+                if want_state and r["state"] != want_state:
+                    continue
+                if want_kind and r["kind"] != want_kind:
+                    continue
+                if want_node and (r["node_id"] or "") != want_node:
+                    continue
+                if want_reason and \
+                        (r.get("pending_reason") or "") != want_reason:
+                    continue
+                if contains and \
+                        contains not in (r["payload"].get("name") or ""):
+                    continue
+                total += 1
+                if total > offset and len(rows) < limit:
+                    rows.append(_task_row(tid, r))
+            return {"ok": True, "tasks": rows, "total": total,
+                    "truncated": total > offset + len(rows)}
+
+        @s.handler("task_summary")
+        async def task_summary(msg, conn):
+            """One-scan rollup: per-state / per-kind counts plus the
+            pending set broken down by reason (the `cli tasks` header and
+            the dashboard's task panel)."""
+            states: Dict[str, int] = {}
+            kinds: Dict[str, int] = {}
+            reasons: Dict[str, int] = {}
+            for r in self.task_table.values():
+                states[r["state"]] = states.get(r["state"], 0) + 1
+                kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+                if r["state"] == "PENDING":
+                    name = r.get("pending_reason") or "unclassified"
+                    reasons[name] = reasons.get(name, 0) + 1
+            return {"ok": True, "total": len(self.task_table),
+                    "states": states, "kinds": kinds,
+                    "pending_reasons": reasons,
+                    "lineage_entries": len(self.lineage),
+                    "error_objects": len(self.error_objects)}
+
+        @s.handler("get_task")
+        async def get_task(msg, conn):
+            """One task by id (hex prefix accepted) with full detail for
+            `cli task <id>`: the row plus deps (and which are missing),
+            returns, resources — everything the why-pending line needs."""
+            want = str(msg.get("task_id") or "").lower()
+            if not want:
+                return {"ok": False, "error": "empty task id"}
+            matches = []
+            for tid, r in self.task_table.items():
+                if tid.hex().startswith(want):
+                    matches.append((tid, r))
+                    if len(matches) > 8:
+                        break
+            if not matches:
+                return {"ok": False, "error": f"no task matching {want!r}"}
+            if len(matches) > 1:
+                return {"ok": False,
+                        "error": f"{len(matches)}+ tasks match {want!r}",
+                        "candidates": [t.hex() for t, _ in matches]}
+            tid, r = matches[0]
+            row = _task_row(tid, r)
+            deps = list(r["payload"].get("deps", []))
+            row.update({
+                "deps": [o.hex() for o in deps],
+                "deps_missing": [o.hex() for o in deps
+                                 if not self._dep_alive(o)],
+                "return_ids": [o.hex() for o in r["return_ids"]],
+                "resources": dict(r.get("resources") or {}),
+                "max_retries": r["payload"].get("max_retries", 0),
+                "direct_dispatch": bool(r.get("direct_dispatch")),
+            })
+            return {"ok": True, "task": row}
+
+        @s.handler("run_audit")
+        async def run_audit(msg, conn):
+            """On-demand consistency audit (`cli doctor`). Detached: the
+            pass may probe controllers over fresh connections."""
+            async def work():
+                res = await self.run_audit(
+                    verify=bool(msg.get("verify", True)))
+                return {"ok": True, **res}
+
+            self._detach(msg, conn, work())
+            return None
 
         @s.handler("pending_demands")
         async def pending_demands(msg, conn):

@@ -51,11 +51,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MAGIC = 0xBF
 # v2 adds the inline-result frames (TASK_DONE2 / TASK_DONE_BATCH2 and the
-# _LOC_INLINE location flag); v3 adds the PROFILE_STACKS stats frame.
+# _LOC_INLINE location flag); v3 adds the PROFILE_STACKS stats frame; v4
+# adds the state-API frames (LIST_TASKS / LIST_TASKS_RESP).
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 3
+WIRE_VERSION = 4
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -86,9 +87,16 @@ PG_STATUS_RESP = 0x12
 # the GCS profile-stacks table on the 2 s stats cadence. Framed so the
 # periodic observability traffic never re-enters pickle on busy links.
 PROFILE_STACKS = 0x13
+# State-API frames (v4): the bounded/filterable/paginated task-table query
+# and its row response — framed so dashboards and `cli tasks` polling a
+# busy head never re-enter pickle on the state path.
+LIST_TASKS = 0x14
+LIST_TASKS_RESP = 0x15
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 _PG_STATES = ("PENDING", "CREATED", "RESCHEDULING", "REMOVED")
+_TASK_STATES = ("PENDING", "DISPATCHED", "FINISHED", "FAILED")
+_TASK_KINDS = ("task", "actor")
 
 # Task-spec versions. v1 is the base header; v2 appends a trace context
 # (sampled tasks only — unsampled specs still encode as v1, so the hot
@@ -752,6 +760,90 @@ def _dec_profile_stacks(r: _Reader, rpc_id) -> Dict[str, Any]:
             "samples": samples, "stacks": stacks, "rpc_id": rpc_id}
 
 
+def _enc_list_tasks(msg, peer_wire: int = WIRE_VERSION
+                    ) -> Optional[List[bytes]]:
+    if peer_wire < 4:
+        return None  # pre-v4 peer: pickle carries the query
+    return [_head(LIST_TASKS, msg.get("rpc_id")),
+            _s(msg.get("state") or ""),
+            _s(msg.get("kind") or ""),
+            _s(msg.get("node_id") or ""),
+            _s(msg.get("reason") or ""),
+            _s(msg.get("name_contains") or ""),
+            _U32.pack(int(msg.get("limit") or 0)),
+            _U32.pack(int(msg.get("offset") or 0))]
+
+
+def _dec_list_tasks(r: _Reader, rpc_id) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"type": "list_tasks", "rpc_id": rpc_id}
+    for key in ("state", "kind", "node_id", "reason", "name_contains"):
+        val = r.s()
+        if val:
+            out[key] = val
+    limit = r.u32()
+    offset = r.u32()
+    r.done()
+    if limit:
+        out["limit"] = limit
+    if offset:
+        out["offset"] = offset
+    return out
+
+
+def _enc_list_tasks_resp(msg, peer_wire: int = WIRE_VERSION
+                         ) -> Optional[List[bytes]]:
+    if peer_wire < 4:
+        return None
+    tasks = msg.get("tasks", ())
+    out = [_head(LIST_TASKS_RESP, msg.get("rpc_id")),
+           _U32.pack(int(msg.get("total", 0))),
+           _U8.pack(1 if msg.get("truncated") else 0),
+           _U32.pack(len(tasks))]
+    for t in tasks:
+        try:
+            state = _TASK_STATES.index(t["state"])
+            kind = _TASK_KINDS.index(t["kind"])
+            tid = bytes.fromhex(t["task_id"])
+        except ValueError:
+            return None  # unknown enum/id shape: pickle carries it
+        out.append(_b8(tid))
+        out.append(_U8.pack(kind))
+        out.append(_U8.pack(state))
+        out.append(_s(t.get("name") or ""))
+        out.append(_s(t.get("node_id") or ""))
+        out.append(_s(t.get("pending_reason") or ""))
+        out.append(_I32.pack(int(t.get("retries_left", 0))))
+        out.append(_U8.pack(1 if t.get("cancelled") else 0))
+        out.append(_F64.pack(float(t.get("ts_submit", 0.0))))
+        out.append(_F64.pack(float(t.get("ts_dispatch", 0.0))))
+        out.append(_F64.pack(float(t.get("ts_finish", 0.0))))
+    return out
+
+
+def _dec_list_tasks_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    total = r.u32()
+    truncated = bool(r.u8())
+    n = r.count(r.u32())
+    tasks = []
+    for _ in range(n):
+        tid = r.b8()
+        kind = r.u8()
+        state = r.u8()
+        if kind >= len(_TASK_KINDS) or state >= len(_TASK_STATES):
+            raise WireError("bad task kind/state code")
+        tasks.append({
+            "task_id": tid.hex(), "kind": _TASK_KINDS[kind],
+            "state": _TASK_STATES[state], "name": r.s(),
+            "node_id": r.s(), "pending_reason": r.s(),
+            "retries_left": r.i32(), "cancelled": bool(r.u8()),
+            "ts_submit": r.f64(), "ts_dispatch": r.f64(),
+            "ts_finish": r.f64(),
+        })
+    r.done()
+    return {"ok": True, "tasks": tasks, "total": total,
+            "truncated": truncated, "rpc_id": rpc_id}
+
+
 def _enc_pg_status_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     groups = msg.get("groups", {})
     out = [_head(PG_STATUS_RESP, msg.get("rpc_id")),
@@ -807,6 +899,7 @@ _ENCODERS = {
     "remove_placement_group": _enc_pg_remove,
     "list_placement_groups": _enc_pg_status,
     "add_profile_stacks": _enc_profile_stacks,
+    "list_tasks": _enc_list_tasks,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -817,6 +910,7 @@ _RESP_ENCODERS = {
     "create_placement_group": _enc_pg_ok,
     "remove_placement_group": _enc_pg_ok,
     "list_placement_groups": _enc_pg_status_resp,
+    "list_tasks": _enc_list_tasks_resp,
 }
 
 _DECODERS = {
@@ -839,6 +933,8 @@ _DECODERS = {
     PG_OK: _dec_pg_ok,
     PG_STATUS_RESP: _dec_pg_status_resp,
     PROFILE_STACKS: _dec_profile_stacks,
+    LIST_TASKS: _dec_list_tasks,
+    LIST_TASKS_RESP: _dec_list_tasks_resp,
 }
 
 

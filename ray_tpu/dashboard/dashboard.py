@@ -123,6 +123,30 @@ async function refresh() {
        `<tr><td class=num>${tasks.tasks_submitted ?? "-"}</td>` +
        `<td class=num>${tasks.tasks_finished ?? "-"}</td>` +
        `<td class=num>${tasks.tasks_failed ?? "-"}</td></tr></table>`;
+  // state API v2: GCS task-table summary + why-pending attribution
+  const tsum = tasks.summary;
+  if (tsum) {
+    const st = Object.entries(tsum.states || {}).map(
+      ([k, v]) => `${k.toLowerCase()}=${v}`).join(" ");
+    h += `<div>task table: ${tsum.total} records (${st || "-"})</div>`;
+    const reasons = Object.entries(tsum.pending_reasons || {});
+    if (reasons.length)
+      h += `<div style="color:#fc7">pending by reason: ` +
+           reasons.map(([k, v]) => `${esc(k)}=${v}`).join("  ") + `</div>`;
+    const rows = (tasks.rows || []).filter(t => t.state === "PENDING" ||
+                                                t.state === "DISPATCHED");
+    if (rows.length) {
+      h += "<table><tr><th>task</th><th>kind</th><th>state</th>" +
+           "<th>node</th><th>reason</th><th>name</th></tr>";
+      for (const t of rows.slice(0, 25))
+        h += `<tr><td>${esc(t.task_id).slice(0,16)}</td>` +
+             `<td>${esc(t.kind)}</td><td>${esc(t.state)}</td>` +
+             `<td>${esc(t.node_id || "-").slice(0,8)}</td>` +
+             `<td>${esc(t.pending_reason || "-")}</td>` +
+             `<td>${esc(t.name || "")}</td></tr>`;
+      h += "</table>";
+    }
+  }
   h += "<h2>nodes</h2><table><tr><th>id</th><th>alive</th><th>resources</th></tr>";
   for (const n of nodes)
     h += `<tr><td>${(n.NodeID||"").slice(0,12)}</td><td>${n.Alive}</td>` +
@@ -267,8 +291,19 @@ def _collect(endpoint: str):
     if endpoint == "node_stats":
         return state.node_stats()
     if endpoint == "tasks":
+        # State API v2 panel: driver counters (legacy keys kept) plus the
+        # GCS task table's per-state/per-reason summary and newest rows.
         core = global_worker().core
-        return dict(getattr(core, "stats", {}) or {})
+        out = dict(getattr(core, "stats", {}) or {})
+        if hasattr(core, "task_summary"):
+            try:
+                summ = core.task_summary()
+                summ.pop("ok", None)
+                out["summary"] = summ
+                out["rows"] = core.list_tasks(limit=100)["tasks"]
+            except Exception:  # noqa: BLE001 - GCS restart window
+                pass
+        return out
     if endpoint == "memory":
         # Reference-accounting view (reference: dashboard memory.py +
         # `ray memory`): who holds each object, task pins, sizes. Cluster

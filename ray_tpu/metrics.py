@@ -217,6 +217,25 @@ def slo_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def audit_metrics() -> Dict[str, "Metric"]:
+    """``audit_*`` series for the GCS consistency auditor: findings per
+    kind from the latest reconciliation pass (a gauge — zeros export so
+    recoveries are visible and Prometheus can alert on ``> 0``), passes
+    run, and the last pass's wall time. Lazily registered; idempotent."""
+    return {
+        "findings": get_or_create(
+            Gauge, "audit_findings", tag_keys=("kind",),
+            description="consistency-audit findings by kind in the latest "
+                        "reconciliation pass (0 = that invariant holds)"),
+        "runs": get_or_create(
+            Count, "audit_runs",
+            description="consistency-audit reconciliation passes run"),
+        "duration": get_or_create(
+            Gauge, "audit_last_duration_seconds",
+            description="wall seconds the latest audit pass took"),
+    }
+
+
 def collect_all() -> Dict[str, Dict]:
     """Snapshot every registered metric (the dashboard's /api/metrics)."""
     with _LOCK:

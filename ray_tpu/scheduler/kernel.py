@@ -55,6 +55,20 @@ STRATEGY_CODES = {"PACK": PACK, "SPREAD": SPREAD,
                   "STRICT_PACK": STRICT_PACK,
                   "STRICT_SPREAD": STRICT_SPREAD}
 
+# Pending-reason codes (the scheduling-explainability spec shared by
+# classify_pending / classify_pending_reference — bit-identical by the
+# same contract as gang admission). Every task a placement tick leaves
+# unplaced gets exactly one reason; precedence is fixed:
+# deps > quota > pg > infeasible > capacity.
+REASON_PLACED = 0            # placement >= 0: not pending at all
+REASON_WAITING_DEPS = 1      # an argument has no live copy yet
+REASON_WAITING_CAPACITY = 2  # fits the fleet's totals; nodes busy now
+REASON_INFEASIBLE = 3        # fits NO node even idle (autoscaler's cue)
+REASON_WAITING_PG = 4        # member of a not-yet-CREATED placement group
+REASON_QUOTA_THROTTLED = 5   # held back by an admission quota/weight
+REASON_NAMES = ("placed", "waiting-for-deps", "waiting-for-capacity",
+                "infeasible", "waiting-for-pg", "quota-throttled")
+
 
 @jax.jit
 def task_bits(key: jax.Array, round_idx, task_idx) -> jax.Array:
@@ -360,6 +374,77 @@ def admit_gangs(
     inf_g = (strategy == STRICT_SPREAD) & (size[:G] > N)
     placement = jnp.where(valid & inf_g[gclip], INFEASIBLE, placement)
     return placement.astype(jnp.int32)
+
+
+@jax.jit
+def classify_pending(
+    demand: jax.Array,        # [T, R] int32 fixed-point demands
+    placement: jax.Array,     # [T] int32 node index, or NO_PLACEMENT/INFEASIBLE
+    totals: jax.Array,        # [N, R] int32 per-node TOTAL resources
+    waiting_deps: jax.Array,  # [T] bool: an arg has no live copy
+    waiting_pg: jax.Array,    # [T] bool: member of a non-CREATED gang
+    quota: jax.Array,         # [T] bool: held by an admission quota
+) -> jax.Array:
+    """One data-parallel pending-reason pass (the explainability twin of a
+    placement round): every unplaced task is attributed to exactly one of
+    the five pending reasons. Feasibility is judged against node TOTALS —
+    the same infeasible-vs-waiting split the pg table already applies to
+    gangs (``_pg_feasible_vs_totals``), generalized to every task.
+
+    Precedence (highest wins): waiting-for-deps, quota-throttled,
+    waiting-for-pg, infeasible, waiting-for-capacity. Deps outrank
+    everything because a task that cannot even stage its arguments says
+    nothing about cluster capacity; quota/pg outrank feasibility because a
+    gang member's group-scoped resource names don't exist on any node
+    until the gang is CREATED — totals-infeasibility is then an artifact,
+    not a diagnosis. Deterministic, no RNG: bit-identity with the scalar
+    reference is exact equality of the int32 output."""
+    demand = demand.astype(jnp.int32)
+    totals = totals.astype(jnp.int32)
+    feas_any = (demand[:, None, :] <= totals[None, :, :]).all(-1).any(-1)
+    reason = jnp.where(feas_any, REASON_WAITING_CAPACITY, REASON_INFEASIBLE)
+    reason = jnp.where(waiting_pg, REASON_WAITING_PG, reason)
+    reason = jnp.where(quota, REASON_QUOTA_THROTTLED, reason)
+    reason = jnp.where(waiting_deps, REASON_WAITING_DEPS, reason)
+    reason = jnp.where(placement >= 0, REASON_PLACED, reason)
+    return reason.astype(jnp.int32)
+
+
+def classify_pending_host(demand: np.ndarray, placement: np.ndarray,
+                          totals, waiting_deps: np.ndarray,
+                          waiting_pg: np.ndarray,
+                          quota: np.ndarray) -> np.ndarray:
+    """Host entry for the jit'd reason pass: power-of-two padding on the
+    task axis so cluster ticks don't recompile per pending-set size
+    (padding rows classify as placed and are sliced off). An empty fleet
+    short-circuits — zero-node device buffers buy nothing, and the N=0
+    answer (infeasible unless masked) is the reference's by definition."""
+    demand = np.asarray(demand, np.int32)
+    placement = np.asarray(placement, np.int32)
+    totals_np = np.asarray(totals, np.int32)
+    T = demand.shape[0]
+    if T == 0:
+        return np.zeros((0,), np.int32)
+    if totals_np.shape[0] == 0:
+        from . import reference as _ref
+
+        return _ref.classify_pending_reference(
+            demand, placement, totals_np, waiting_deps, waiting_pg, quota)
+    pad = (1 << max(T - 1, 1).bit_length()) - T
+    wd = np.asarray(waiting_deps, bool)
+    wp = np.asarray(waiting_pg, bool)
+    q = np.asarray(quota, bool)
+    if pad:
+        demand = np.concatenate(
+            [demand, np.zeros((pad, demand.shape[1]), np.int32)])
+        placement = np.concatenate([placement, np.zeros(pad, np.int32)])
+        wd = np.concatenate([wd, np.zeros(pad, bool)])
+        wp = np.concatenate([wp, np.zeros(pad, bool)])
+        q = np.concatenate([q, np.zeros(pad, bool)])
+    out = classify_pending(jnp.asarray(demand), jnp.asarray(placement),
+                           jnp.asarray(totals_np), jnp.asarray(wd),
+                           jnp.asarray(wp), jnp.asarray(q))
+    return np.asarray(out)[:T]
 
 
 def admit_gangs_host(demand: np.ndarray, group: np.ndarray,

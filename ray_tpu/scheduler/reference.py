@@ -19,6 +19,12 @@ from .kernel import (
     INFEASIBLE,
     NO_PLACEMENT,
     PACK,
+    REASON_INFEASIBLE,
+    REASON_PLACED,
+    REASON_QUOTA_THROTTLED,
+    REASON_WAITING_CAPACITY,
+    REASON_WAITING_DEPS,
+    REASON_WAITING_PG,
     SPREAD,
     STRICT_PACK,
     STRICT_SPREAD,
@@ -98,6 +104,38 @@ def schedule_dag_reference(
         round_idx += 1
 
     return placement.astype(np.int32), round_idx
+
+
+def classify_pending_reference(demand, placement, totals, waiting_deps,
+                               waiting_pg, quota) -> np.ndarray:
+    """Scalar spec of ``kernel.classify_pending`` (bit-identical by the
+    same contract as the placement/gang references): one sequential pass
+    attributing every unplaced task to exactly one pending reason. The
+    GCS serves with THIS implementation (pending sets are small off the
+    happy path; RAY_TPU_REASON_KERNEL=1 routes the jit pass instead),
+    which is exactly why the kernel must reproduce it bit-for-bit."""
+    demand = np.asarray(demand, dtype=np.int64)
+    placement = np.asarray(placement, dtype=np.int64)
+    totals = np.asarray(totals, dtype=np.int64)
+    waiting_deps = np.asarray(waiting_deps, dtype=bool)
+    waiting_pg = np.asarray(waiting_pg, dtype=bool)
+    quota = np.asarray(quota, dtype=bool)
+    T = demand.shape[0]
+    out = np.empty(T, dtype=np.int32)
+    for t in range(T):
+        if placement[t] >= 0:
+            out[t] = REASON_PLACED
+        elif waiting_deps[t]:
+            out[t] = REASON_WAITING_DEPS
+        elif quota[t]:
+            out[t] = REASON_QUOTA_THROTTLED
+        elif waiting_pg[t]:
+            out[t] = REASON_WAITING_PG
+        elif totals.shape[0] and (demand[t] <= totals).all(axis=1).any():
+            out[t] = REASON_WAITING_CAPACITY
+        else:
+            out[t] = REASON_INFEASIBLE
+    return out
 
 
 def admit_gangs_reference(demand, group, strategy, avail, key,

@@ -355,6 +355,191 @@ def cmd_memory(args) -> None:
         gcs.close()
 
 
+# Human explanations for the scheduler's pending-reason attribution
+# (`cli task <id>` why-pending line; cli tasks legend).
+_WHY_PENDING = {
+    "waiting-for-deps": "an argument object has no live copy yet — its "
+                        "producer is still running, failed, or the copy "
+                        "is being recovered from lineage/spill",
+    "waiting-for-capacity": "the task fits the fleet's total resources "
+                            "but every node is busy; it will place when "
+                            "running work releases capacity",
+    "infeasible": "the demand fits NO node even when idle — the cluster "
+                  "needs bigger/different nodes (this feeds the "
+                  "autoscaler's pending-demand view)",
+    "waiting-for-pg": "the task targets a placement group whose gang "
+                      "reservation is not CREATED yet (check `cli pgs` "
+                      "for the group's own pending reason)",
+    "quota-throttled": "held back by an admission quota/weight policy",
+    "unclassified": "submitted but not yet seen by a placement tick",
+}
+
+
+def _fmt_age(now: float, ts: float) -> str:
+    if not ts:
+        return "-"
+    d = max(now - ts, 0.0)
+    if d < 120:
+        return f"{d:.1f}s"
+    if d < 7200:
+        return f"{d / 60:.1f}m"
+    return f"{d / 3600:.1f}h"
+
+
+def cmd_tasks(args) -> None:
+    """State API v2 task table: per-state summary plus a filtered,
+    paginated row listing with lifecycle ages and pending reasons."""
+    gcs = _gcs_client(args.address)
+    try:
+        summ = gcs.call({"type": "task_summary"})
+        states = " ".join(f"{k.lower()}={v}"
+                          for k, v in sorted(summ["states"].items()))
+        print(f"{summ['total']} tasks in table  {states or '-'}")
+        reasons = summ.get("pending_reasons") or {}
+        if reasons:
+            print("pending by reason: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(reasons.items())))
+        msg = {"type": "list_tasks", "limit": args.limit,
+               "offset": args.offset}
+        for key, val in (("state", args.state), ("kind", args.kind),
+                         ("reason", args.reason),
+                         ("name_contains", args.name)):
+            if val:
+                msg[key] = val
+        resp = gcs.call(msg)
+        rows = resp["tasks"]
+        now = time.time()
+        print(f"showing {len(rows)} of {resp['total']} matching "
+              f"(offset {args.offset})"
+              + (" — truncated, page with --offset"
+                 if resp.get("truncated") else ""))
+        if not rows:
+            return
+        print(f"{'TASK_ID':<18} {'KIND':<6} {'STATE':<11} {'AGE':>7} "
+              f"{'RUN':>7} {'NODE':<10} {'REASON':<21} NAME")
+        for t in rows:
+            run = (_fmt_age(t["ts_finish"] or now, t["ts_dispatch"])
+                   if t["ts_dispatch"] else "-")
+            print(f"{t['task_id'][:16]:<18} {t['kind']:<6} "
+                  f"{t['state']:<11} {_fmt_age(now, t['ts_submit']):>7} "
+                  f"{run:>7} {(t['node_id'] or '-')[:8]:<10} "
+                  f"{(t['pending_reason'] or '-'):<21} "
+                  f"{t['name'][:32]}")
+    finally:
+        gcs.close()
+
+
+def cmd_task(args) -> None:
+    """One task in full detail, with a human 'why pending' line for
+    PENDING tasks (the scheduler's per-tick reason attribution)."""
+    gcs = _gcs_client(args.address)
+    try:
+        try:
+            resp = gcs.call({"type": "get_task", "task_id": args.id})
+        except RuntimeError as e:
+            # ok:False responses (unknown id, ambiguous prefix) surface
+            # as RuntimeError from the RPC client.
+            raise SystemExit(f"task lookup failed: {e}")
+        t = resp["task"]
+        now = time.time()
+        print(f"task   {t['task_id']}")
+        print(f"kind   {t['kind']}   state {t['state']}"
+              + (f"   node {t['node_id'][:12]}" if t["node_id"] else "")
+              + ("   CANCELLED" if t["cancelled"] else ""))
+        if t.get("name"):
+            print(f"name   {t['name']}")
+        print(f"submitted {_fmt_age(now, t['ts_submit'])} ago"
+              + (f"; dispatched {_fmt_age(now, t['ts_dispatch'])} ago"
+                 if t["ts_dispatch"] else "")
+              + (f"; finished {_fmt_age(now, t['ts_finish'])} ago"
+                 if t["ts_finish"] else ""))
+        if t.get("resources"):
+            print(f"resources {t['resources']}")
+        print(f"retries_left {t['retries_left']} / "
+              f"max {t.get('max_retries', 0)}")
+        if t.get("deps"):
+            missing = set(t.get("deps_missing") or ())
+            print(f"deps ({len(t['deps'])}, {len(missing)} missing):")
+            for d in t["deps"][:16]:
+                print(f"  {d[:32]}{'  MISSING' if d in missing else ''}")
+        if t["state"] == "PENDING":
+            reason = t["pending_reason"] or "unclassified"
+            why = _WHY_PENDING.get(reason, "")
+            print(f"why pending: {reason} — {why}")
+    finally:
+        gcs.close()
+
+
+def cmd_doctor(args) -> None:
+    """Cross-process consistency audit + postmortem bundle. Runs the GCS
+    reconciliation pass (object directory vs controller arenas, spill
+    dirs, completion rings, task table, inline budget), prints the
+    findings, and writes one directory with everything a postmortem
+    needs: findings, task table, events, time-series snapshot, node
+    stats, handler stats, and collapsed flight-recorder profiles.
+    Exit status: 0 when every invariant holds, 1 when anything is
+    flagged."""
+    gcs = _gcs_client(args.address)
+    try:
+        resp = gcs.call({"type": "run_audit",
+                         "verify": not args.no_verify}, timeout=180.0)
+        findings = resp.get("findings", [])
+        summary = resp.get("summary", {})
+        bundle = args.out or (
+            f"/tmp/ray_tpu_postmortem_{time.strftime('%Y%m%d_%H%M%S')}")
+        os.makedirs(bundle, exist_ok=True)
+        os.makedirs(os.path.join(bundle, "profiles"), exist_ok=True)
+
+        def dump(name: str, payload) -> None:
+            with open(os.path.join(bundle, name), "w") as f:
+                json.dump(payload, f, indent=2, default=repr)
+
+        dump("findings.json", {"findings": findings, "summary": summary})
+        dump("tasks.json", {
+            "summary": gcs.call({"type": "task_summary"}),
+            "tasks": gcs.call({"type": "list_tasks",
+                               "limit": 10_000})["tasks"]})
+        dump("events.json", gcs.call({"type": "get_events",
+                                      "limit": 2000}))
+        dump("timeseries.json", gcs.call({"type": "get_timeseries"}))
+        dump("nodes.json", {
+            "nodes": gcs.call({"type": "list_nodes"})["nodes"],
+            "node_stats": gcs.call({"type": "get_node_stats"})["stats"],
+            "resources": gcs.call({"type": "cluster_resources"})})
+        dump("handlers.json", gcs.call({"type": "debug_stats"}))
+        comps = gcs.call({"type": "get_profile_stacks"})["components"]
+        for comp, info in comps.items():
+            path = os.path.join(bundle, "profiles", f"{comp}.folded")
+            with open(path, "w") as f:
+                for stack, n in sorted(info["stacks"].items(),
+                                       key=lambda kv: -kv[1]):
+                    f.write(f"{stack} {n}\n")
+        checked = (f"{summary.get('objects_checked', 0)} objects, "
+                   f"{summary.get('tasks_checked', 0)} tasks, "
+                   f"{summary.get('nodes_checked', 0)} node inventories")
+        if not findings:
+            print(f"doctor: all consistency checks passed ({checked})")
+            print(f"postmortem bundle: {bundle}")
+            return
+        print(f"doctor: {len(findings)} finding(s) across {checked}:")
+        by_kind: Dict[str, int] = {}
+        for f_ in findings:
+            by_kind[f_["kind"]] = by_kind.get(f_["kind"], 0) + 1
+        for kind, n in sorted(by_kind.items(), key=lambda kv: -kv[1]):
+            print(f"  {kind:<20} {n}")
+        for f_ in findings[:args.limit]:
+            detail = " ".join(f"{k}={v}" for k, v in f_.items()
+                              if k != "kind")
+            print(f"  {f_['kind']:<20} {detail}")
+        if len(findings) > args.limit:
+            print(f"  ... {len(findings) - args.limit} more "
+                  f"(see findings.json)")
+        print(f"postmortem bundle: {bundle}")
+        raise SystemExit(1)
+    finally:
+        gcs.close()
+
+
 def cmd_trace(args) -> None:
     """Per-task straggler report: top-k slowest sampled tasks with latency
     attributed to the 7 control-plane phases (needs tracing enabled —
@@ -498,6 +683,22 @@ def _render_top_frame(gcs) -> str:
         if p:
             lines.append(f"{label:<10} {p[-1][1]['last']:>10.1f}   "
                          f"{sparkline([c['last'] for _, c in p])}")
+    # Pending-by-reason gauges (the scheduling-explainability stream):
+    # shown whenever anything is pending, so a stuck fan-out explains
+    # itself in the first `cli top` frame.
+    from ray_tpu._private.timeseries import latest_value
+
+    reasons = {n[len("pending_reason:"):]: latest_value(pts(n))
+               for n in series if n.startswith("pending_reason:")}
+    reasons = {k: int(v) for k, v in reasons.items() if v}
+    if reasons:
+        lines.append("pending    " + "  ".join(
+            f"{k}={v}" for k, v in sorted(reasons.items(),
+                                          key=lambda kv: -kv[1])))
+    audit = latest_value(pts("audit_findings"))
+    if audit:
+        lines.append(f"AUDIT      {int(audit)} consistency finding(s) — "
+                     f"run `cli doctor` for the reconciliation report")
     pg_states = {n[len('pg_state:'):]: pts(n)[-1][1]["last"]
                  for n in series if n.startswith("pg_state:") and pts(n)}
     if pg_states:
@@ -546,9 +747,20 @@ def cmd_top(args) -> None:
         gcs.close()
 
 
+def _print_event(ev: Dict) -> None:
+    stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+    detail = " ".join(f"{k}={v}" for k, v in ev.items()
+                      if k not in ("ts", "kind", "seq"))
+    print(f"  {stamp} {ev['kind']:<22} {detail}")
+
+
 def cmd_events(args) -> None:
     """Cluster event log: structured lifecycle events (node up/down, task
-    retries, actor restarts, spill/restore, backpressure)."""
+    retries, actor restarts, spill/restore, backpressure). ``--follow``
+    tails it live with a sequence cursor: each poll asks only for events
+    newer than the last seen seq, and a cursor that falls behind the
+    ring's oldest surviving event (eviction outran the poll, or events
+    were dropped) is reported, never silent."""
     gcs = _gcs_client(args.address)
     try:
         msg = {"type": "get_events", "limit": args.limit}
@@ -563,10 +775,40 @@ def cmd_events(args) -> None:
                  f"{resp.get('capacity', '?')}-slot ring "
                  f"(raise RAY_TPU_EVENT_LOG_SIZE)" if dropped else ""))
         for ev in events:
-            stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
-            detail = " ".join(f"{k}={v}" for k, v in ev.items()
-                              if k not in ("ts", "kind"))
-            print(f"  {stamp} {ev['kind']:<22} {detail}")
+            _print_event(ev)
+        if not getattr(args, "follow", False):
+            return
+        cursor = resp.get("last_seq", 0)
+        last_dropped = dropped
+        print("-- following (Ctrl-C to stop) --")
+        while True:
+            time.sleep(args.interval)
+            msg = {"type": "get_events", "limit": args.limit,
+                   "after_seq": cursor}
+            if args.kind:
+                msg["kind"] = args.kind
+            try:
+                resp = gcs.call(msg)
+            except (ConnectionError, OSError):
+                print("  (GCS unreachable; retrying)")
+                continue
+            oldest = resp.get("oldest_seq")
+            if oldest is not None and oldest > cursor + 1:
+                # The ring evicted past our cursor between polls: those
+                # events are unrecoverable — honor the drop accounting.
+                print(f"  !! missed {oldest - cursor - 1} events "
+                      f"(ring evicted past cursor; raise "
+                      f"RAY_TPU_EVENT_LOG_SIZE or poll faster)")
+            new_dropped = resp.get("dropped", 0)
+            if new_dropped > last_dropped:
+                print(f"  !! {new_dropped - last_dropped} events dropped "
+                      f"from the full ring since last poll")
+                last_dropped = new_dropped
+            for ev in resp["events"]:
+                _print_event(ev)
+            cursor = max(cursor, resp.get("last_seq", cursor))
+    except KeyboardInterrupt:
+        pass
     finally:
         gcs.close()
 
@@ -865,7 +1107,43 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--kind", help="filter by event kind "
                                    "(e.g. node_down, task_retry)")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="tail the log live (cursor-based; reports "
+                         "evicted/dropped gaps instead of hiding them)")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval for --follow")
     sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("tasks", help="state API v2: the cluster task "
+                                      "table (filterable, paginated)")
+    sp.add_argument("--address")
+    sp.add_argument("--state", choices=["PENDING", "DISPATCHED",
+                                        "FINISHED", "FAILED"])
+    sp.add_argument("--kind", choices=["task", "actor"])
+    sp.add_argument("--reason", help="filter PENDING rows by pending "
+                                     "reason (e.g. infeasible)")
+    sp.add_argument("--name", help="substring filter on task name")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.add_argument("--offset", type=int, default=0)
+    sp.set_defaults(fn=cmd_tasks)
+
+    sp = sub.add_parser("task", help="one task in detail, with a "
+                                     "why-pending explanation")
+    sp.add_argument("id", help="task id (hex prefix accepted)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_task)
+
+    sp = sub.add_parser("doctor", help="consistency audit + postmortem "
+                                       "bundle (exit 1 on findings)")
+    sp.add_argument("--address")
+    sp.add_argument("--out", help="bundle directory (default "
+                                  "/tmp/ray_tpu_postmortem_<ts>)")
+    sp.add_argument("--limit", type=int, default=25,
+                    help="findings to print (the bundle holds all)")
+    sp.add_argument("--no-verify", action="store_true",
+                    help="skip the per-object has_object confirmation "
+                         "probes (faster, may over-report)")
+    sp.set_defaults(fn=cmd_doctor)
 
     sp = sub.add_parser("submit", help="run a driver script on the cluster")
     sp.add_argument("--address")

@@ -1,7 +1,9 @@
 """Global state API (reference: python/ray/state.py GlobalState).
 
 Snapshot queries over the running system: nodes, actors, objects, resources,
-and the memory summary that backs the ``ray memory`` CLI view.
+the memory summary that backs the ``ray memory`` CLI view, and — state API
+v2 — the bounded/filterable/paginated task table (``tasks()`` /
+``summarize_tasks()``) with per-task pending-reason attribution.
 """
 
 from __future__ import annotations
@@ -15,6 +17,41 @@ def _core():
     worker = global_worker()
     worker.check_connected()
     return worker.core
+
+
+def tasks(state: Optional[str] = None, kind: Optional[str] = None,
+          node_id: Optional[str] = None, reason: Optional[str] = None,
+          name_contains: Optional[str] = None,
+          limit: int = 1000, offset: int = 0) -> List[Dict[str, Any]]:
+    """Query the cluster task table (reference: Ray's state API
+    ``list_tasks``). Each row carries the lifecycle (state + wall-clock
+    stamps ``ts_submit``/``ts_dispatch``/``ts_finish``) and, for PENDING
+    tasks, the scheduler's pending-reason attribution (waiting-for-deps /
+    waiting-for-capacity / infeasible / waiting-for-pg / quota-throttled).
+
+    Filterable by ``state``/``kind``/``node_id``/``reason``/
+    ``name_contains``; paginated by ``limit``/``offset`` (server-capped at
+    10k rows per page). Local mode has no cluster task table and returns
+    []."""
+    core = _core()
+    if getattr(core, "gcs", None) is None:
+        return []
+    return core.list_tasks(state=state, kind=kind, node_id=node_id,
+                           reason=reason, name_contains=name_contains,
+                           limit=limit, offset=offset)["tasks"]
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Per-state counts over the cluster task table, with the PENDING set
+    broken down by pending reason:
+    ``{total, states, kinds, pending_reasons, ...}``."""
+    core = _core()
+    if getattr(core, "gcs", None) is None:
+        return {"total": 0, "states": {}, "kinds": {},
+                "pending_reasons": {}}
+    out = core.task_summary()
+    out.pop("ok", None)
+    return out
 
 
 def nodes() -> List[Dict[str, Any]]:
@@ -40,7 +77,11 @@ def objects() -> Dict[str, Dict[str, Any]]:
             return {}
         resp = gcs.call({"type": "list_objects", "limit": 1_000_000})
         return {
-            hex_id: {"size_bytes": info.get("size", 0), "has_error": False,
+            hex_id: {"size_bytes": info.get("size", 0),
+                     # Served by the GCS (error blobs live in its error
+                     # table, not the directory) — was hardcoded False,
+                     # which made `cli memory` lie about errored objects.
+                     "has_error": bool(info.get("has_error")),
                      "locations": info.get("locations", []),
                      "spilled": info.get("spilled", [])}
             for hex_id, info in resp.get("objects", {}).items()

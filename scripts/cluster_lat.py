@@ -455,6 +455,30 @@ def main():
         hist.append(out)
         with open(path, "w") as f:
             json.dump(hist, f, indent=2)
+    if args.record and runs:
+        # Control-plane bench trajectory: one compact machine-readable
+        # row per --record run appended to a cumulative history, so
+        # future PRs can chart warm-5k throughput across rounds without
+        # parsing the full CLUSTER_LAT entries.
+        path = os.path.join(REPO, "BENCH_CONTROL_PLANE.json")
+        try:
+            with open(path) as f:
+                bench = json.load(f)
+        except (OSError, ValueError):
+            bench = []
+        bench.append({
+            "unix": out["unix"],
+            "batch_k": args.batch,
+            "runs": args.runs,
+            "warm_tasks_per_sec": out["batch_warm_tasks_per_sec"],
+            "cold_tasks_per_sec": out["batch_tasks_per_sec"],
+            "p50_ms": out["p50_ms"],
+            "p99_ms": out["p99_ms"],
+            "phases_ms_per_1k": out.get("phases_ms_per_1k"),
+            "note": args.note,
+        })
+        with open(path, "w") as f:
+            json.dump(bench, f, indent=2)
 
 
 if __name__ == "__main__":

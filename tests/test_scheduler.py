@@ -570,3 +570,100 @@ class TestGangAdmission:
                 assert p[0] == 0 and p[1] == 1  # only feasible assignment
                 break
         assert admitted_round is not None
+
+
+class TestPendingReason:
+    """Pending-reason classification (scheduling explainability): the jit
+    pass (kernel.classify_pending) must reproduce the scalar reference
+    bit-for-bit on any input — including adversarial masks and empty
+    fleets — and the precedence spec must hold semantically."""
+
+    @staticmethod
+    def _both(demand, placement, totals, wd, wp, q):
+        from ray_tpu.scheduler.kernel import classify_pending_host
+        from ray_tpu.scheduler.reference import classify_pending_reference
+
+        kp = classify_pending_host(demand, placement, totals, wd, wp, q)
+        rp = classify_pending_reference(demand, placement, totals, wd, wp, q)
+        return kp, rp
+
+    @staticmethod
+    def _mk(seed, max_tasks=24, max_nodes=6, R=3):
+        rng = np.random.default_rng(seed)
+        T = int(rng.integers(0, max_tasks))
+        N = int(rng.integers(0, max_nodes))
+        demand = rng.integers(0, 3000, size=(T, R)).astype(np.int32)
+        totals = rng.integers(100, 2500, size=(N, R)).astype(np.int32)
+        placement = rng.integers(-2, max(N, 1), size=T).astype(np.int32)
+        wd = rng.random(T) < 0.25
+        wp = rng.random(T) < 0.25
+        q = rng.random(T) < 0.25
+        return demand, placement, totals, wd, wp, q
+
+    @pytest.mark.parametrize("seed", list(range(16)))
+    def test_random_mixes_bit_identical(self, seed):
+        kp, rp = self._both(*self._mk(seed))
+        np.testing.assert_array_equal(kp, rp)
+
+    @pytest.mark.parametrize("seed", [0, 3, 9])
+    def test_adversarial_masks_bit_identical(self, seed):
+        # Every mask combination on boundary demands: exactly-fits,
+        # off-by-one over, zero demand, and an empty fleet.
+        rng = np.random.default_rng(seed)
+        cap = 1000
+        demands, masks = [], []
+        for wd in (False, True):
+            for wp in (False, True):
+                for q in (False, True):
+                    for d in (0, cap, cap + 1, 10 * cap):
+                        demands.append([d])
+                        masks.append((wd, wp, q))
+        demand = np.asarray(demands, np.int32)
+        T = demand.shape[0]
+        wd = np.asarray([m[0] for m in masks])
+        wp = np.asarray([m[1] for m in masks])
+        q = np.asarray([m[2] for m in masks])
+        placement = rng.integers(-2, 1, size=T).astype(np.int32)
+        for totals in (np.asarray([[cap]], np.int32),
+                       np.zeros((0, 1), np.int32)):
+            kp, rp = self._both(demand, placement, totals, wd, wp, q)
+            np.testing.assert_array_equal(kp, rp)
+
+    def test_precedence_spec(self):
+        from ray_tpu.scheduler.kernel import (
+            REASON_INFEASIBLE, REASON_PLACED, REASON_QUOTA_THROTTLED,
+            REASON_WAITING_CAPACITY, REASON_WAITING_DEPS,
+            REASON_WAITING_PG,
+        )
+        from ray_tpu.scheduler.reference import classify_pending_reference
+
+        totals = np.asarray([[1000]], np.int32)
+        demand = np.asarray(
+            [[100], [100], [100], [100], [5000], [100]], np.int32)
+        placement = np.asarray([0, -1, -1, -1, -1, -1], np.int32)
+        wd = np.asarray([True, True, False, False, False, False])
+        wp = np.asarray([True, False, False, True, True, False])
+        q = np.asarray([True, False, True, True, False, False])
+        out = classify_pending_reference(
+            demand, placement, totals, wd, wp, q)
+        assert out.tolist() == [
+            REASON_PLACED,            # placed outranks every mask
+            REASON_WAITING_DEPS,      # deps outrank quota/pg
+            REASON_QUOTA_THROTTLED,   # quota outranks pg
+            REASON_QUOTA_THROTTLED,
+            REASON_WAITING_PG,        # pg outranks (in)feasibility
+            REASON_WAITING_CAPACITY,  # fits totals, unplaced
+        ]
+        # and infeasible when nothing masks and no node ever fits
+        out2 = classify_pending_reference(
+            np.asarray([[5000]], np.int32), np.asarray([-1], np.int32),
+            totals, np.asarray([False]), np.asarray([False]),
+            np.asarray([False]))
+        assert out2.tolist() == [REASON_INFEASIBLE]
+
+    def test_reason_names_cover_codes(self):
+        from ray_tpu.scheduler import kernel as k
+
+        assert len(k.REASON_NAMES) == 6
+        assert k.REASON_NAMES[k.REASON_INFEASIBLE] == "infeasible"
+        assert k.REASON_NAMES[k.REASON_WAITING_PG] == "waiting-for-pg"

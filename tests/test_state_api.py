@@ -1,0 +1,383 @@
+"""State API v2 + consistency-auditor tests (PR 7).
+
+Covers: the bounded/filterable/paginated task table (``ray_tpu.state.tasks``
+/ ``summarize_tasks`` / the ``list_tasks`` GCS handler), per-task
+pending-reason attribution landing on records and in the time-series,
+the ``state.objects()`` has_error fix, the event-log sequence cursor
+(`cli events --follow`'s substrate), and the `cli doctor` acceptance run:
+two injected faults (an orphaned arena object + a stale directory
+location) detected, ``audit_*`` events emitted, nonzero exit, complete
+postmortem bundle — and exit 0 on a clean cluster.
+"""
+
+import contextlib
+import io
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.protocol import RpcClient
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def driver(cluster):
+    ray_tpu.init(address=cluster.address, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def gcs(cluster):
+    cli = RpcClient("127.0.0.1", cluster.gcs_port)
+    yield cli
+    cli.close()
+
+
+def _core():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().core
+
+
+def _settled_rows(name_contains, want, timeout=20.0):
+    """Rows for one function, once ``want`` of them report FINISHED.
+    get() can return via the completion ring BEFORE the GCS processes the
+    coalesced task_done batch, so records lag results by a beat."""
+    core = _core()
+    deadline = time.monotonic() + timeout
+    rows = []
+    while time.monotonic() < deadline:
+        rows = core.list_tasks(name_contains=name_contains,
+                               limit=1000)["tasks"]
+        if sum(t["state"] == "FINISHED" for t in rows) >= want:
+            return rows
+        time.sleep(0.1)
+    return rows
+
+
+class TestTaskTable:
+    def test_rows_states_and_timestamps(self, driver):
+        @ray_tpu.remote
+        def tagged(x):
+            return x * 3
+
+        refs = [tagged.remote(i) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=60) == [i * 3 for i in range(8)]
+        # Hold the refs so lineage (and thus the records) can't be GC'd
+        # mid-assertion.
+        rows = _settled_rows("tagged", want=8)
+        assert len(rows) >= 8
+        for t in rows:
+            assert t["state"] == "FINISHED"
+            assert t["ts_submit"] > 0
+            assert t["ts_dispatch"] >= t["ts_submit"] - 1e-3
+            assert t["ts_finish"] >= t["ts_dispatch"] - 1e-3
+            assert t["pending_reason"] == ""
+        del refs
+
+    def test_filters_pagination_and_bounds(self, driver):
+        @ray_tpu.remote
+        def pager(x):
+            return x
+
+        refs = [pager.remote(i) for i in range(12)]
+        ray_tpu.get(refs, timeout=60)
+        _settled_rows("pager", want=12)
+        core = _core()
+        # name filter
+        resp = core.list_tasks(name_contains="pager", limit=1000)
+        assert resp["total"] >= 12
+        assert all("pager" in t["name"] for t in resp["tasks"])
+        # state filter composes with it
+        resp = core.list_tasks(name_contains="pager", state="FINISHED")
+        assert resp["total"] >= 12
+        # pagination: pages tile the match set without overlap
+        p1 = core.list_tasks(name_contains="pager", limit=5, offset=0)
+        p2 = core.list_tasks(name_contains="pager", limit=5, offset=5)
+        assert len(p1["tasks"]) == 5 and p1["truncated"]
+        ids1 = {t["task_id"] for t in p1["tasks"]}
+        ids2 = {t["task_id"] for t in p2["tasks"]}
+        assert not ids1 & ids2
+        # offset past the end: empty page, total still reported
+        tail = core.list_tasks(name_contains="pager",
+                               offset=p1["total"] + 10)
+        assert tail["tasks"] == [] and tail["total"] == p1["total"]
+        # the server caps the page size regardless of the request
+        big = core.list_tasks(limit=10_000_000)
+        assert len(big["tasks"]) <= 10_000
+        # kind filter: no actors were created by this test's tasks
+        acts = core.list_tasks(kind="actor", name_contains="pager")
+        assert acts["total"] == 0
+        del refs
+
+    def test_summary_counts_match_listing(self, driver):
+        @ray_tpu.remote
+        def summed(x):
+            return x
+
+        refs = [summed.remote(i) for i in range(5)]
+        ray_tpu.get(refs, timeout=60)
+        from ray_tpu import state
+
+        summ = state.summarize_tasks()
+        assert summ["total"] == sum(summ["states"].values())
+        listed = _core().list_tasks(limit=10_000)
+        assert summ["total"] == listed["total"]
+        del refs
+
+    def test_get_task_prefix_and_detail(self, driver):
+        @ray_tpu.remote
+        def detailed(x):
+            return x
+
+        ref = detailed.remote(1)
+        assert ray_tpu.get(ref, timeout=30) == 1
+        core = _core()
+        row = core.list_tasks(name_contains="detailed")["tasks"][0]
+        got = core.get_task(row["task_id"][:12])
+        assert got["ok"]
+        assert got["task"]["task_id"] == row["task_id"]
+        assert "return_ids" in got["task"] and "deps" in got["task"]
+        with pytest.raises(RuntimeError, match="no task matching"):
+            core.get_task("ff" * 16)
+        del ref
+
+
+class TestPendingReasons:
+    def test_infeasible_task_is_attributed(self, driver):
+        @ray_tpu.remote(resources={"CPU": 100_000})
+        def impossible():
+            return 0
+
+        ref = impossible.remote()
+        core = _core()
+        deadline = time.monotonic() + 20
+        reason = None
+        while time.monotonic() < deadline:
+            pend = core.list_tasks(state="PENDING",
+                                   name_contains="impossible")
+            if pend["tasks"] and pend["tasks"][0]["pending_reason"]:
+                reason = pend["tasks"][0]["pending_reason"]
+                break
+            time.sleep(0.2)
+        assert reason == "infeasible"
+        # reason filter finds it too
+        assert core.list_tasks(reason="infeasible")["total"] >= 1
+        # and the summary breaks the pending set down by reason
+        summ = core.task_summary()
+        assert summ["pending_reasons"].get("infeasible", 0) >= 1
+        ray_tpu.cancel(ref)
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=30)
+
+    def test_waiting_for_deps_attributed(self, driver):
+        @ray_tpu.remote(resources={"CPU": 100_000})
+        def never_runs():
+            return 0
+
+        @ray_tpu.remote
+        def consumer(x):
+            return x
+
+        blocker = never_runs.remote()
+        ref = consumer.remote(blocker)
+        core = _core()
+        deadline = time.monotonic() + 20
+        reason = None
+        while time.monotonic() < deadline:
+            pend = core.list_tasks(state="PENDING",
+                                   name_contains="consumer")
+            if pend["tasks"] and pend["tasks"][0]["pending_reason"]:
+                reason = pend["tasks"][0]["pending_reason"]
+                break
+            time.sleep(0.2)
+        assert reason == "waiting-for-deps"
+        got = core.get_task(pend["tasks"][0]["task_id"])
+        assert got["task"]["deps_missing"]  # the blocker's return object
+        for r in (blocker, ref):
+            ray_tpu.cancel(r)
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=30)
+
+    def test_reason_gauges_reach_timeseries(self, driver):
+        core = _core()
+        deadline = time.monotonic() + 15
+        names = []
+        while time.monotonic() < deadline:
+            ts = core.cluster_timeseries(last=10)
+            names = [n for n in ts["series"]
+                     if n.startswith("pending_reason:")]
+            if names:
+                break
+            time.sleep(0.5)
+        # every reason in the classifier spec is trended, zeros included
+        from ray_tpu.scheduler.kernel import REASON_NAMES
+
+        for want in REASON_NAMES[1:]:
+            assert f"pending_reason:{want}" in names
+
+    def test_gcs_gauge_names_match_kernel_spec(self):
+        # gcs.py keeps a literal copy so the event loop never imports jax;
+        # this pins it to the kernel's spec.
+        from ray_tpu.cluster.gcs import _REASON_GAUGE_NAMES
+        from ray_tpu.scheduler.kernel import REASON_NAMES
+
+        assert tuple(_REASON_GAUGE_NAMES) == tuple(REASON_NAMES[1:])
+
+
+class TestObjectsHasError:
+    def test_errored_object_visible_in_state_and_memory(self, driver):
+        @ray_tpu.remote
+        def kaboom():
+            raise ValueError("kaboom")
+
+        ref = kaboom.remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=30)
+        from ray_tpu import state
+
+        deadline = time.monotonic() + 10
+        flagged = False
+        while time.monotonic() < deadline and not flagged:
+            objs = state.objects()
+            flagged = any(o["has_error"] for o in objs.values())
+            if not flagged:
+                time.sleep(0.2)
+        assert flagged, "errored object not flagged in state.objects()"
+        assert "True" in state.memory_summary()
+        del ref
+
+
+class TestEventCursor:
+    def test_after_seq_returns_only_new_events(self, driver, gcs):
+        core = _core()
+        base = core.cluster_events_page(limit=1)
+        cursor = base["last_seq"]
+        assert base.get("oldest_seq") is not None
+        gcs.send_oneway({"type": "log_event", "kind": "cursor_probe",
+                         "n": 1})
+        gcs.send_oneway({"type": "log_event", "kind": "cursor_probe",
+                         "n": 2})
+        deadline = time.monotonic() + 10
+        got = []
+        while time.monotonic() < deadline and len(got) < 2:
+            page = core.cluster_events_page(after_seq=cursor)
+            got = [e for e in page["events"]
+                   if e["kind"] == "cursor_probe"]
+            time.sleep(0.1)
+        assert [e["n"] for e in got] == [1, 2]
+        # every returned event is strictly newer than the cursor, ordered
+        assert all(e["seq"] > cursor for e in page["events"])
+        seqs = [e["seq"] for e in page["events"]]
+        assert seqs == sorted(seqs)
+        # a caught-up cursor returns nothing
+        assert core.cluster_events_page(
+            after_seq=page["last_seq"])["events"] == []
+
+
+def _run_cli(argv):
+    from ray_tpu.scripts import cli
+
+    buf = io.StringIO()
+    code = 0
+    try:
+        with contextlib.redirect_stdout(buf):
+            cli.main(argv)
+    except SystemExit as e:
+        code = e.code or 0
+    return code, buf.getvalue()
+
+
+class TestDoctor:
+    """Acceptance: `cli doctor` with two injected faults detects both,
+    emits audit_* events, exits nonzero, writes a complete bundle; with
+    no faults it exits 0. One dedicated cluster — the faults stay."""
+
+    def test_doctor_clean_then_injected_faults(self, tmp_path):
+        c = Cluster(head_resources={"CPU": 4}, num_workers=1)
+        ray_tpu.init(address=c.address)
+        try:
+            @ray_tpu.remote
+            def warm(x):
+                return x
+
+            keep = [warm.remote(i) for i in range(4)]
+            assert ray_tpu.get(keep, timeout=60) == list(range(4))
+            addr = c.address
+            gcs = RpcClient("127.0.0.1", c.gcs_port)
+            node = gcs.call({"type": "list_nodes"})["nodes"][0]
+
+            # Let two controller inventory snapshots land first.
+            time.sleep(5.0)
+            clean_dir = str(tmp_path / "clean")
+            code, out = _run_cli(["doctor", "--address", addr,
+                                  "--out", clean_dir])
+            assert code == 0, out
+            assert "all consistency checks passed" in out
+
+            # Fault 1: stale directory location — the GCS is told this
+            # node holds an object it has never seen.
+            stale_oid = os.urandom(24)
+            gcs.send_oneway({"type": "add_object_location",
+                             "object_id": stale_oid,
+                             "node_id": node["NodeID"], "size": 64})
+            # Fault 2: orphaned arena object — bytes written into the
+            # node's shm arena behind the controller's back (no
+            # registration ever happens).
+            from ray_tpu._native import open_store
+
+            leak_oid = os.urandom(24)
+            open_store(node["StoreName"]).put(leak_oid, b"leaked")
+
+            # The stale entry must age past the audit grace AND two fresh
+            # inventory snapshots must observe the leak.
+            time.sleep(6.0)
+            bad_dir = str(tmp_path / "bad")
+            code, out = _run_cli(["doctor", "--address", addr,
+                                  "--out", bad_dir])
+            assert code != 0, out
+            findings = json.load(
+                open(os.path.join(bad_dir, "findings.json")))["findings"]
+            kinds = {f["kind"] for f in findings}
+            assert "stale_location" in kinds
+            assert "leaked_object" in kinds
+            assert any(f.get("object_id") == stale_oid.hex()
+                       for f in findings if f["kind"] == "stale_location")
+            assert any(f.get("object_id") == leak_oid.hex()
+                       for f in findings if f["kind"] == "leaked_object")
+            # audit_* events landed in the cluster event log
+            evs = gcs.call({"type": "get_events", "limit": 500})["events"]
+            ev_kinds = {e["kind"] for e in evs}
+            assert "audit_stale_location" in ev_kinds
+            assert "audit_leaked_object" in ev_kinds
+            # complete postmortem bundle
+            for name in ("findings.json", "tasks.json", "events.json",
+                         "timeseries.json", "nodes.json",
+                         "handlers.json"):
+                path = os.path.join(bad_dir, name)
+                assert os.path.exists(path), f"bundle missing {name}"
+                json.load(open(path))  # valid JSON
+            assert os.path.isdir(os.path.join(bad_dir, "profiles"))
+            # audit gauges reach the time-series store
+            ts = gcs.call({"type": "get_timeseries",
+                           "names": ["audit_findings"]})
+            pts = (ts["series"].get("audit_findings") or {}).get(
+                "points", [])
+            assert pts and pts[-1][1]["last"] >= 2
+            gcs.close()
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()

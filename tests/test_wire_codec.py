@@ -498,3 +498,169 @@ class TestPlacementGroupFrames:
                 wire.decode(body[:cut])
         with pytest.raises(wire.WireError):
             wire.decode(body + b"\x00")
+
+
+class TestListTasksCodec:
+    """State-API frames (wire v4)."""
+
+    def test_list_tasks_round_trip(self):
+        msg = {"type": "list_tasks", "state": "PENDING", "kind": "task",
+               "node_id": "n1", "reason": "infeasible",
+               "name_contains": "fn-é", "limit": 50, "offset": 10,
+               "rpc_id": 3}
+        out = _rt(msg)
+        assert out == msg
+
+    def test_list_tasks_empty_filters_omitted(self):
+        out = _rt({"type": "list_tasks", "limit": 5, "rpc_id": 1})
+        assert out == {"type": "list_tasks", "limit": 5, "rpc_id": 1}
+
+    def test_list_tasks_resp_round_trip(self):
+        rows = [{"task_id": (bytes([i]) * 16).hex(), "kind": "actor",
+                 "state": "DISPATCHED", "name": f"fn-{i}", "node_id": "n",
+                 "pending_reason": "", "retries_left": -1,
+                 "cancelled": bool(i % 2), "ts_submit": 1000.5 + i,
+                 "ts_dispatch": 1001.5 + i, "ts_finish": 0.0}
+                for i in range(4)]
+        msg = {"ok": True, "tasks": rows, "total": 9, "truncated": True,
+               "rpc_id": 7}
+        out = _rt(msg, req_type="list_tasks")
+        assert out == msg
+
+    def test_list_tasks_resp_pending_reason_survives(self):
+        row = {"task_id": (b"\x05" * 16).hex(),
+               "kind": "task", "state": "PENDING", "name": "",
+               "node_id": "", "pending_reason": "waiting-for-capacity",
+               "retries_left": 0, "cancelled": False,
+               "ts_submit": 5.0, "ts_dispatch": 0.0, "ts_finish": 0.0}
+        out = _rt({"ok": True, "tasks": [row], "total": 1,
+                   "truncated": False}, req_type="list_tasks")
+        assert out["tasks"][0]["pending_reason"] == "waiting-for-capacity"
+
+    def test_pre_v4_peer_gets_pickle_fallback(self):
+        assert wire.encode({"type": "list_tasks", "limit": 1},
+                           peer_wire=3) is None
+        assert wire.encode_response(
+            "list_tasks", {"ok": True, "tasks": [], "total": 0},
+            peer_wire=3) is None
+
+    def test_unknown_enum_falls_back_to_pickle(self):
+        row = {"task_id": "00" * 16, "kind": "task", "state": "EXOTIC",
+               "name": "", "node_id": "", "pending_reason": "",
+               "retries_left": 0, "cancelled": False, "ts_submit": 0.0,
+               "ts_dispatch": 0.0, "ts_finish": 0.0}
+        assert wire.encode_response(
+            "list_tasks", {"ok": True, "tasks": [row], "total": 1}) is None
+
+    def test_truncated_list_tasks_frames_raise(self):
+        bufs = wire.encode({"type": "list_tasks", "state": "PENDING",
+                            "limit": 5, "rpc_id": 1})
+        body = b"".join(bufs)
+        for cut in (11, len(body) - 1):
+            with pytest.raises(wire.WireError):
+                wire.decode(body[:cut])
+
+
+def _coverage_spec_blob():
+    return wire.encode_task_spec({
+        "task_id": b"T" * 16, "fn_id": b"F" * 16, "name": "f",
+        "max_retries": 0, "return_ids": [b"R" * 24], "deps": [],
+        "pin_refs": [], "resources": {"CPU": 1.0}, "args": [],
+        "kwargs": {}})
+
+
+# One encode case per registered frame code. kind "req" goes through
+# wire.encode; ("resp", req_type) through wire.encode_response.
+_FRAME_CASES = {
+    wire.SUBMIT_BATCH: ("req", lambda: {
+        "type": "submit_batch", "tasks": [{"_spec": _coverage_spec_blob()}],
+        "rpc_id": 1}),
+    wire.SUBMIT_BATCH_RESP: (("resp", "submit_batch"), lambda: {
+        "ok": True, "count": 1, "rpc_id": 1}),
+    wire.TASK_DONE_BATCH: ("req", lambda: {
+        "type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {"CPU": 1.0},
+             "exec_s": 0.5, "reg_s": 0.25, "added": [[b"R" * 24, 5]]}]}),
+    wire.TASK_DONE_BATCH2: ("req", lambda: {
+        "type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {},
+             "exec_s": 0.0, "reg_s": 0.0,
+             "added": [[b"R" * 24, 5, b"inline"]]}]}),
+    wire.LOCATIONS_BATCH: ("req", lambda: {
+        "type": "locations_batch", "object_ids": [b"R" * 24],
+        "wait_s": 1.0, "wave_s": 0.0, "probe": True, "rpc_id": 2}),
+    wire.LOCATIONS_BATCH_RESP: (("resp", "locations_batch"), lambda: {
+        "ok": True, "objects": {b"R" * 24: {
+            "addresses": [["h", 1]],
+            "transfer_addresses": [["h", 2]]}}, "rpc_id": 2}),
+    wire.FETCH_BATCH: ("req", lambda: {
+        "type": "fetch_batch", "object_ids": [b"R" * 24], "rpc_id": 3}),
+    wire.FETCH_BATCH_RESP: (("resp", "fetch_batch"), lambda: {
+        "ok": True, "blobs": {b"R" * 24: b"bytes"}, "rpc_id": 3}),
+    wire.OBJECT_ADDED: ("req", lambda: {
+        "type": "object_added", "object_id": b"R" * 24, "size": 9}),
+    wire.ASSIGN_BATCH: ("req", lambda: {
+        "type": "assign_batch", "tasks": [{"_spec": _coverage_spec_blob()}]}),
+    wire.EXECUTE_TASK: ("req", lambda: {
+        "type": "execute_task", "_spec": _coverage_spec_blob()}),
+    wire.TASK_DONE: ("req", lambda: {
+        "type": "task_done", "pid": 7, "return_ids": [b"R" * 24],
+        "added": [[b"R" * 24, 5]], "exec_s": 0.0, "reg_s": 0.0}),
+    wire.TASK_DONE2: ("req", lambda: {
+        "type": "task_done", "pid": 7, "return_ids": [b"R" * 24],
+        "added": [[b"R" * 24, 5, b"inline"]], "exec_s": 0.0,
+        "reg_s": 0.0}),
+    wire.PG_CREATE: ("req", lambda: {
+        "type": "create_placement_group", "pg_id": b"P" * 16,
+        "strategy": "PACK", "name": "g", "bundles": [{"CPU": 1.0}]}),
+    wire.PG_REMOVE: ("req", lambda: {
+        "type": "remove_placement_group", "pg_id": b"P" * 16}),
+    wire.PG_STATUS: ("req", lambda: {"type": "list_placement_groups"}),
+    wire.PG_OK: (("resp", "remove_placement_group"), lambda: {
+        "ok": True, "removed": True, "rpc_id": 4}),
+    wire.PG_STATUS_RESP: (("resp", "list_placement_groups"), lambda: {
+        "ok": True, "groups": {("P" * 16).encode().hex(): {
+            "state": "CREATED", "strategy": "SPREAD", "name": "g",
+            "reason": "", "bundles": [{"CPU": 1.0}], "nodes": ["n1"]}}}),
+    wire.PROFILE_STACKS: ("req", lambda: {
+        "type": "add_profile_stacks", "component": "gcs", "samples": 2,
+        "stacks": {"a.py:f;b.py:g": 2}}),
+    wire.LIST_TASKS: ("req", lambda: {
+        "type": "list_tasks", "state": "PENDING", "limit": 10}),
+    wire.LIST_TASKS_RESP: (("resp", "list_tasks"), lambda: {
+        "ok": True, "total": 0, "truncated": False, "tasks": []}),
+}
+
+
+class TestWireFrameCoverage:
+    """Wire-frame coverage lint (PR-7 satellite): every frame code
+    registered in ``wire._DECODERS`` must have an encode/decode case in
+    ``_FRAME_CASES`` above. A future wire bump that adds a frame without
+    a round-trip case fails ``test_every_registered_frame_has_a_case`` —
+    the guard the audit/state frames (and all later ones) ride."""
+
+    def test_every_registered_frame_has_a_case(self):
+        registered = set(wire._DECODERS)
+        covered = set(_FRAME_CASES)
+        missing = {f"0x{c:02x}" for c in registered - covered}
+        extra = {f"0x{c:02x}" for c in covered - registered}
+        assert not missing, (
+            f"frame codes with no round-trip case in _FRAME_CASES: "
+            f"{sorted(missing)} — add one when adding a frame")
+        assert not extra, f"cases for unregistered codes: {sorted(extra)}"
+
+    @pytest.mark.parametrize("code", sorted(_FRAME_CASES))
+    def test_frame_round_trips_under_its_code(self, code):
+        kind, build = _FRAME_CASES[code]
+        msg = build()
+        if kind == "req":
+            bufs = wire.encode(msg)
+        else:
+            bufs = wire.encode_response(kind[1], msg)
+        assert bufs is not None, f"no binary encoding for 0x{code:02x}"
+        body = b"".join(bufs)
+        assert body[0] == wire.MAGIC
+        assert body[1] == code, (
+            f"case for 0x{code:02x} encoded as 0x{body[1]:02x}")
+        decoded = wire.decode(body)
+        assert isinstance(decoded, dict) and decoded
