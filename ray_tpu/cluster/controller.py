@@ -141,6 +141,7 @@ class NodeController:
         self._admit_queues: Dict[Tuple, Any] = {}
         self._admit_pump_running = False
         self.workers: Dict[int, WorkerHandle] = {}  # pid -> handle
+        self._spawning = 0  # async spawns in flight (bounds worker growth)
         self._idle_event = asyncio.Event()
         self._gcs: Optional[RpcClient] = None
         self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
@@ -214,8 +215,8 @@ class NodeController:
         from .._native import completion_ring as _cring
 
         _cring.sweep_stale_rings()
-        for _ in range(self.num_workers):
-            self._spawn_worker()
+        await asyncio.gather(
+            *(self._spawn_worker_async() for _ in range(self.num_workers)))
         if getattr(self.config, "flight_recorder", True):
             from .._private import flight_recorder
 
@@ -245,7 +246,10 @@ class NodeController:
             self.transfer_server.stop()
         self.store.close()
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _launch_worker_proc(self) -> subprocess.Popen:
+        """The blocking half of a worker spawn (fork+exec, milliseconds on
+        a loaded host). Only called from worker threads — the event loop
+        spawns via _spawn_worker_async."""
         import ray_tpu
 
         pkg_root = os.path.dirname(
@@ -254,17 +258,32 @@ class NodeController:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_STORE_NAME"] = self.store_name
         env.update(self.worker_env)
-        proc = subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
              "--controller", f"{self.address[0]}:{self.address[1]}",
              "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, bufsize=1,
         )
+
+    def _adopt_worker(self, proc: subprocess.Popen) -> WorkerHandle:
         handle = WorkerHandle(proc)
         self.workers[proc.pid] = handle
         self._start_log_pump(proc)
         return handle
+
+    async def _spawn_worker_async(self) -> WorkerHandle:
+        """Spawn a worker without stalling the event loop: Popen runs in a
+        worker thread (raylint async-blocking flagged the inline fork+exec
+        — every connection stalled for its duration), bookkeeping lands
+        back on the loop. ``_spawning`` keeps the grow-under-load bound
+        honest while spawns are in flight."""
+        self._spawning += 1
+        try:
+            proc = await asyncio.to_thread(self._launch_worker_proc)
+        finally:
+            self._spawning -= 1
+        return self._adopt_worker(proc)
 
     def _start_log_pump(self, proc: subprocess.Popen) -> None:
         """Forward the worker's stdout/stderr to the GCS logs channel so
@@ -272,6 +291,7 @@ class NodeController:
         files + worker.py:960 print_logs)."""
         import threading
 
+        # raylint: hotpath — 43% of head self-time in the PR 6 live profile
         def pump():
             batch: List[str] = []
             last_flush = time.monotonic()
@@ -543,7 +563,7 @@ class NodeController:
                             "no_restart": w.killed_deliberately,
                         })
                     if not self._shutting_down:
-                        self._spawn_worker()
+                        await self._spawn_worker_async()
 
     # ------------------------------------------------------------ object store
     def _gcs_send(self, msg: Dict) -> None:
@@ -806,8 +826,12 @@ class NodeController:
             if w is not None:
                 return w
             if all(w.conn is not None for w in self.workers.values()) and \
-                    len(self.workers) < self.num_workers + 8:
-                self._spawn_worker()  # grow under load (bounded)
+                    len(self.workers) + self._spawning \
+                    < self.num_workers + 8:
+                # Grow under load (bounded; in-flight spawns count so
+                # concurrent waiters can't overshoot while Popen runs
+                # off-loop).
+                await self._spawn_worker_async()
             if time.monotonic() > deadline:
                 raise TimeoutError("no idle worker available")
             self._idle_event.clear()
@@ -852,6 +876,8 @@ class NodeController:
         if error_blob is None:
             err = (WorkerCrashedError(message) if crashed
                    else ClusterUnavailableError(message))
+            # Bounded: a bare exception with a short message, not task
+            # data.  # raylint: disable=async-blocking
             error_blob = ERR_PREFIX + pickle.dumps(err)
         for oid in task["return_ids"]:
             await self._store_put(oid, error_blob)
@@ -1541,7 +1567,10 @@ class NodeController:
 
         from ..exceptions import ActorDiedError
 
-        blob = ERR_PREFIX + pickle.dumps(ActorDiedError(msg["actor_id"].hex()[:12]))
+        # Bounded: a bare exception carrying a 12-char actor id.
+        # raylint: disable=async-blocking
+        blob = ERR_PREFIX + pickle.dumps(
+            ActorDiedError(msg["actor_id"].hex()[:12]))
         for oid in msg["return_ids"]:
             await self._store_put(oid, blob)
         if msg.get("return_ids"):

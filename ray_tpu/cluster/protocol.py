@@ -342,6 +342,9 @@ class RpcClient:
         except OSError:
             pass
         finally:
+            # Benign race: GIL-atomic latch flag, writers on both sides
+            # only ever store True; readers tolerate either order.
+            # raylint: disable=thread-shared-state
             self._closed = True
             for ev in list(self._pending.values()):
                 ev.set()
@@ -351,6 +354,7 @@ class RpcClient:
                 except Exception:  # noqa: BLE001
                     pass
 
+    # raylint: hotpath — 14% of head / 60% of worker self-time (PR 6 profile)
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = bytearray()
         while len(buf) < n:
@@ -363,6 +367,7 @@ class RpcClient:
             buf.extend(chunk)
         return bytes(buf)
 
+    # raylint: hotpath — every frame every client sends funnels through here
     def _send_buffers(self, bufs: List[bytes], frames: int) -> None:
         """One scatter-gather write for any number of frames. Caller holds
         ``_wlock``. Partial sendmsg results are continued manually."""

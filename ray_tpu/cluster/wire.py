@@ -93,6 +93,35 @@ PROFILE_STACKS = 0x13
 LIST_TASKS = 0x14
 LIST_TASKS_RESP = 0x15
 
+# Minimum peer wire version able to parse each frame — the declarative
+# manifest the static lint (raylint wire-discipline) audits: every frame
+# must appear here, encoders emitting a >v1 frame must gate on peer_wire
+# with a pickle fallback, and max(values) must equal WIRE_VERSION (adding
+# a frame without bumping the version is a lint error).
+FRAME_MIN_WIRE = {
+    SUBMIT_BATCH: 1,
+    SUBMIT_BATCH_RESP: 1,
+    TASK_DONE_BATCH: 1,
+    LOCATIONS_BATCH: 1,
+    LOCATIONS_BATCH_RESP: 1,
+    FETCH_BATCH: 1,
+    FETCH_BATCH_RESP: 1,
+    OBJECT_ADDED: 1,
+    ASSIGN_BATCH: 1,
+    EXECUTE_TASK: 1,
+    TASK_DONE: 1,
+    TASK_DONE2: 2,
+    TASK_DONE_BATCH2: 2,
+    PG_CREATE: 1,
+    PG_REMOVE: 1,
+    PG_STATUS: 1,
+    PG_OK: 1,
+    PG_STATUS_RESP: 1,
+    PROFILE_STACKS: 3,
+    LIST_TASKS: 4,
+    LIST_TASKS_RESP: 4,
+}
+
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 _PG_STATES = ("PENDING", "CREATED", "RESCHEDULING", "REMOVED")
 _TASK_STATES = ("PENDING", "DISPATCHED", "FINISHED", "FAILED")

@@ -407,94 +407,104 @@ def main():
                         "actor_id", msg.get("actor_id"))
         await asyncio.to_thread(complete_actor_method, msg, result)
 
-    while True:
-        msg = inbox.get()
-        mtype = msg.get("type")
-        if mtype == "shutdown":
-            break
-        if mtype == "execute_task" and msg.get("task_id") is not None:
-            with revoke_lock:
-                inbox_ids.discard(msg["task_id"])
-                if msg["task_id"] in revoked:
-                    # Revoked while queued: the controller re-dispatched it
-                    # elsewhere; executing here too would double-run it.
-                    revoked.discard(msg["task_id"])
+    # The worker inner loop — one of the flight recorder's top burners, so
+    # it is a named, hot-path-linted function: no pickle/json or loud
+    # logging may creep into the per-task path (raylint hot-path).
+    # raylint: hotpath
+    def serve_loop() -> None:
+        nonlocal actor_instance, actor_id, actor_loop, actor_pool
+        while True:
+            msg = inbox.get()
+            mtype = msg.get("type")
+            if mtype == "shutdown":
+                break
+            if mtype == "execute_task" and msg.get("task_id") is not None:
+                with revoke_lock:
+                    inbox_ids.discard(msg["task_id"])
+                    if msg["task_id"] in revoked:
+                        # Revoked while queued: the controller re-dispatched
+                        # it elsewhere; executing here too would double-run
+                        # it.
+                        revoked.discard(msg["task_id"])
+                        continue
+            if "_spec" in msg and "args" not in msg:
+                # Pickle-relayed opaque spec (mixed-wire path): the header
+                # dict carries the encoded blob but not the args — the full
+                # decode happens here, at the executing worker, exactly
+                # like the binary execute_task frame.
+                msg = dict(wire.decode_task_spec(msg["_spec"]), type=mtype)
+            if mtype == "execute_actor_task" and actor_instance is not None:
+                # Dispatch order == controller FIFO order for all three
+                # modes; completion may interleave for async/pooled actors
+                # (that is their contract). The concurrent paths own their
+                # error handling + task_done, so they bypass the serial
+                # finally.
+                if actor_loop is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        run_actor_method_async(msg), actor_loop)
                     continue
-        if "_spec" in msg and "args" not in msg:
-            # Pickle-relayed opaque spec (mixed-wire path): the header dict
-            # carries the encoded blob but not the args — the full decode
-            # happens here, at the executing worker, exactly like the
-            # binary execute_task frame.
-            msg = dict(wire.decode_task_spec(msg["_spec"]), type=mtype)
-        if mtype == "execute_actor_task" and actor_instance is not None:
-            # Dispatch order == controller FIFO order for all three modes;
-            # completion may interleave for async/pooled actors (that is
-            # their contract). The concurrent paths own their error
-            # handling + task_done, so they bypass the serial finally.
-            if actor_loop is not None:
-                asyncio.run_coroutine_threadsafe(
-                    run_actor_method_async(msg), actor_loop)
+                if actor_pool is not None:
+                    actor_pool.submit(run_actor_method, msg)
+                    continue
+                run_actor_method(msg)
                 continue
-            if actor_pool is not None:
-                actor_pool.submit(run_actor_method, msg)
-                continue
-            run_actor_method(msg)
-            continue
-        try:
-            if mtype == "execute_task":
-                fn = load_function(msg["fn_id"])
-                pos, kwargs = resolve_args(msg)
-                trace = msg.get("trace")  # sampled task: stamp phase spans
-                t0 = time.monotonic()
-                try:
-                    result = fn(*pos, **kwargs)
-                finally:
-                    _phase_times[threading.get_ident()] = \
-                        [time.monotonic() - t0, 0.0]
-                    record_span("task", getattr(fn, "__name__", "task"),
-                                t0, "task_id", msg.get("task_id"))
+            try:
+                if mtype == "execute_task":
+                    fn = load_function(msg["fn_id"])
+                    pos, kwargs = resolve_args(msg)
+                    trace = msg.get("trace")  # sampled task: phase spans
+                    t0 = time.monotonic()
+                    try:
+                        result = fn(*pos, **kwargs)
+                    finally:
+                        _phase_times[threading.get_ident()] = \
+                            [time.monotonic() - t0, 0.0]
+                        record_span("task", getattr(fn, "__name__", "task"),
+                                    t0, "task_id", msg.get("task_id"))
+                        if trace is not None:
+                            core.record_trace_span(
+                                trace, msg.get("task_id"), "worker_exec",
+                                t0, time.monotonic())
+                    t1 = time.monotonic()
+                    run_returns(msg, result)
+                    _phase_times[threading.get_ident()][1] = \
+                        time.monotonic() - t1
                     if trace is not None:
                         core.record_trace_span(
-                            trace, msg.get("task_id"), "worker_exec",
-                            t0, time.monotonic())
-                t1 = time.monotonic()
-                run_returns(msg, result)
-                _phase_times[threading.get_ident()][1] = \
-                    time.monotonic() - t1
-                if trace is not None:
-                    core.record_trace_span(
-                        trace, msg.get("task_id"), "result_register",
-                        t1, time.monotonic())
-            elif mtype == "create_actor_instance":
-                cls = load_function(msg["fn_id"])
-                pos, kwargs = resolve_args(msg)
-                actor_instance = cls(*pos, **kwargs)
-                actor_id = msg["actor_id"]
-                maybe_restore_checkpoint(msg)
-                if msg.get("is_asyncio"):
-                    actor_loop = asyncio.new_event_loop()
-                    threading.Thread(
-                        target=actor_loop.run_forever, daemon=True,
-                        name="actor-asyncio-loop").start()
-                elif int(msg.get("max_concurrency", 1) or 1) > 1:
-                    from concurrent.futures import ThreadPoolExecutor
+                            trace, msg.get("task_id"), "result_register",
+                            t1, time.monotonic())
+                elif mtype == "create_actor_instance":
+                    cls = load_function(msg["fn_id"])
+                    pos, kwargs = resolve_args(msg)
+                    actor_instance = cls(*pos, **kwargs)
+                    actor_id = msg["actor_id"]
+                    maybe_restore_checkpoint(msg)
+                    if msg.get("is_asyncio"):
+                        actor_loop = asyncio.new_event_loop()
+                        threading.Thread(
+                            target=actor_loop.run_forever, daemon=True,
+                            name="actor-asyncio-loop").start()
+                    elif int(msg.get("max_concurrency", 1) or 1) > 1:
+                        from concurrent.futures import ThreadPoolExecutor
 
-                    actor_pool = ThreadPoolExecutor(
-                        max_workers=int(msg["max_concurrency"]),
-                        thread_name_prefix="actor-exec")
-                store_result(msg["return_ids"][0], True)
-            elif mtype == "execute_actor_task":
-                raise RuntimeError("actor not initialized")
-            else:
-                continue
-        except BaseException as e:  # noqa: BLE001 - task errors are data
-            try:
-                store_error(msg, e)
-            except Exception:  # noqa: BLE001
-                traceback.print_exc()
-        finally:
-            if not finish(msg):
-                break
+                        actor_pool = ThreadPoolExecutor(
+                            max_workers=int(msg["max_concurrency"]),
+                            thread_name_prefix="actor-exec")
+                    store_result(msg["return_ids"][0], True)
+                elif mtype == "execute_actor_task":
+                    raise RuntimeError("actor not initialized")
+                else:
+                    continue
+            except BaseException as e:  # noqa: BLE001 - task errors are data
+                try:
+                    store_error(msg, e)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+            finally:
+                if not finish(msg):
+                    break
+
+    serve_loop()
 
     if actor_loop is not None:
         actor_loop.call_soon_threadsafe(actor_loop.stop)

@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the repo but never imports at runtime.
+
+Nothing under ``ray_tpu.devtools`` may be imported by production modules —
+it exists for ``scripts/lint.py``, CI gates, and future codemod tooling.
+"""

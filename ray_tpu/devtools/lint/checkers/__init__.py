@@ -1,0 +1,1 @@
+"""The five raylint checkers (one module per rule)."""

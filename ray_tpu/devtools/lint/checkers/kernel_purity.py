@@ -1,0 +1,130 @@
+"""kernel-purity: every jit'd scheduler pass stays pure and verifiable.
+
+The data-parallel placement passes are the repo's differentiator vs the
+reference's sequential loop (Ray, arXiv:1712.05889) — and the entire
+safety argument rests on each jit'd pass having a bit-identical scalar
+reference that property tests pin (placement, gang admission, and
+pending-reason classification all ship that way today; Tesserae,
+arXiv:2508.04953, makes the same argument for evolving policies against a
+pinned spec). This checker makes the convention structural:
+
+  1. every ``@jax.jit`` function in ``scheduler/kernel.py`` must have a
+     ``<name>_reference`` in ``scheduler/reference.py`` — or be a shared
+     spec helper the reference itself imports (directly or via its
+     ``<name>_host`` wrapper);
+  2. some test module must exercise the pair by naming BOTH the kernel
+     entry and its reference (the property-test handle);
+  3. jit'd bodies must be pure: no ``time``/``random``/``np.random``
+     draws, no host side effects (``print``/``open``/``os.*``) — a trace
+     captures those once at compile time and silently freezes them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from ..model import Checker, Finding, Module, Project, call_root
+
+KERNEL_PATH = "ray_tpu/scheduler/kernel.py"
+REFERENCE_PATH = "ray_tpu/scheduler/reference.py"
+TESTS_PREFIX = "tests/"
+
+IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                   "os.", "datetime.")
+IMPURE_CALLS = {"print", "open", "input", "eval", "exec"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """Matches @jax.jit, @jit, @functools.partial(jax.jit, ...),
+    @partial(jax.jit, ...)."""
+    dotted = call_root(dec)
+    if dotted in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fn = call_root(dec.func)
+        if fn in ("functools.partial", "partial") and dec.args:
+            return call_root(dec.args[0]) in ("jax.jit", "jit")
+        return fn in ("jax.jit", "jit")
+    return False
+
+
+class KernelPurityChecker(Checker):
+    rule_id = "kernel-purity"
+    description = ("jit'd scheduler passes: scalar reference mirror, "
+                   "property test naming both, no host effects in traces")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        kernel = project.get(KERNEL_PATH)
+        if kernel is None:
+            return
+        reference = project.get(REFERENCE_PATH)
+
+        jit_fns: Dict[str, ast.AST] = {}
+        for node in kernel.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_is_jit_decorator(d) for d in node.decorator_list):
+                jit_fns[node.name] = node
+
+        ref_defs: Set[str] = set()
+        ref_imports: Set[str] = set()
+        if reference is not None:
+            for node in ast.walk(reference.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ref_defs.add(node.name)
+                elif isinstance(node, ast.ImportFrom) and node.module \
+                        and node.module.endswith("kernel"):
+                    ref_imports.update(a.name for a in node.names)
+
+        test_sources = [m.source for m in project.glob(TESTS_PREFIX)]
+
+        for name, node in sorted(jit_fns.items()):
+            ref_name = f"{name}_reference"
+            # Shared-spec helpers (e.g. the threefry draw both sides use)
+            # are exempt: the reference imports them (or their _host
+            # wrapper), so they ARE the spec rather than mirroring one.
+            shared = name in ref_imports or f"{name}_host" in ref_imports
+            if not shared and reference is not None \
+                    and ref_name not in ref_defs:
+                yield Finding(
+                    rule=self.rule_id, path=kernel.relpath,
+                    line=node.lineno, col=0,
+                    message=(f"jit'd pass `{name}` has no `{ref_name}` in "
+                             f"{REFERENCE_PATH}"),
+                    hint="add the bit-identical scalar mirror (or import "
+                         "the helper into reference.py if it IS the spec)",
+                    symbol=name)
+            elif not shared and reference is not None and test_sources:
+                if not any(name in src and ref_name in src
+                           for src in test_sources):
+                    yield Finding(
+                        rule=self.rule_id, path=kernel.relpath,
+                        line=node.lineno, col=0,
+                        message=(f"no test module names both `{name}` and "
+                                 f"`{ref_name}` (bit-identity property "
+                                 f"test missing)"),
+                        hint="add a property test asserting kernel == "
+                             "reference on random + adversarial inputs",
+                        symbol=name)
+            yield from self._check_purity(kernel, name, node)
+
+    def _check_purity(self, kernel: Module, name: str,
+                      fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = call_root(node.func)
+            if not dotted:
+                continue
+            impure = dotted in IMPURE_CALLS or any(
+                dotted.startswith(p) for p in IMPURE_PREFIXES)
+            # jax.random / jax.* are the sanctioned in-trace RNG & ops.
+            if impure and not dotted.startswith(("jax.", "jnp.")):
+                yield Finding(
+                    rule=self.rule_id, path=kernel.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    message=(f"host call `{dotted}` inside jit'd pass "
+                             f"`{name}` (traced once, then frozen)"),
+                    hint="hoist host work out of the jit body; use "
+                         "jax.random for in-kernel draws",
+                    symbol=name)

@@ -1,0 +1,617 @@
+"""raylint: the tier-1 gate plus per-checker fixtures and mutation tests.
+
+Three layers:
+
+  * the GATE — the whole repo must lint clean against the committed
+    baseline, the baseline must stay under its ceiling, and a full run
+    must fit the CI budget;
+  * per-checker FIXTURES — a deliberate-violation and a clean snippet for
+    each of the five rules, run against synthetic projects so the rules
+    are pinned independently of the real tree;
+  * MUTATION tests — inject a violation into a temp copy of a REAL
+    module and assert the rule catches it (the checkers must work on the
+    code we actually ship, not just on toy fixtures).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.devtools.lint import (RULE_IDS, load_project, run_lint)
+from ray_tpu.devtools.lint import baseline as lint_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_CEILING = 10
+
+
+def make_project(tmp_path, files):
+    """Materialize {relpath: source} under tmp_path and load it."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    project, errors = load_project(str(tmp_path))
+    assert not errors, errors
+    return project
+
+
+def lint(tmp_path, files, rules):
+    project = make_project(tmp_path, files)
+    result = run_lint(str(tmp_path), rules=rules, use_baseline=False,
+                      project=project)
+    return result.findings
+
+
+def real_source(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath), "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_lints_clean_within_budget(self):
+        t0 = time.monotonic()
+        result = run_lint(REPO)
+        elapsed = time.monotonic() - t0
+        assert not result.parse_errors, result.parse_errors
+        assert result.findings == [], "non-baselined findings:\n" + \
+            "\n".join(f.format() for f in result.findings)
+        assert result.stale_baseline == [], \
+            "baseline entries whose findings are fixed — rewrite it"
+        assert result.files_scanned > 100  # the walker found the repo
+        assert elapsed < 30.0, f"lint run took {elapsed:.1f}s (budget 30s)"
+
+    def test_baseline_under_ceiling(self):
+        path = os.path.join(REPO, lint_baseline.BASELINE_NAME)
+        assert os.path.exists(path), "commit .raylint_baseline.json"
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        assert len(data["suppressions"]) <= BASELINE_CEILING
+
+    def test_cli_exits_zero_on_clean_repo(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "lint.py"), "-q"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "raylint CLEAN" in proc.stdout
+
+    def test_rule_catalog_is_stable(self):
+        assert RULE_IDS == ("async-blocking", "wire-discipline",
+                            "kernel-purity", "thread-shared-state",
+                            "hot-path")
+
+
+# ---------------------------------------------------------------------------
+# async-blocking fixtures
+# ---------------------------------------------------------------------------
+
+class TestAsyncBlocking:
+    RULE = ["async-blocking"]
+
+    def test_direct_blocking_calls_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import time, subprocess, pickle
+
+            async def handler(msg):
+                time.sleep(0.1)
+                subprocess.run(["ls"])
+                open("/tmp/x")
+                pickle.dumps(msg)
+            """}, self.RULE)
+        targets = {f.message.split("`")[1] for f in findings}
+        assert targets == {"time.sleep", "subprocess.run", "open",
+                           "pickle.dumps"}
+
+    def test_transitive_reach_through_sync_helper(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import time
+
+            class Svc:
+                def _helper(self):
+                    self._deeper()
+
+                def _deeper(self):
+                    time.sleep(1.0)
+
+                async def handler(self, msg):
+                    self._helper()
+            """}, self.RULE)
+        assert len(findings) == 1
+        assert "time.sleep" in findings[0].message
+        assert "Svc.handler" in findings[0].message
+
+    def test_clean_async_and_offloaded_calls_pass(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import asyncio, time, pickle
+
+            def blocking_io(path):
+                time.sleep(1.0)
+                return open(path).read()
+
+            def sync_helper(x):
+                return pickle.dumps(x)   # not in any coroutine: fine
+
+            async def handler(msg):
+                await asyncio.sleep(0.1)
+                data = await asyncio.to_thread(blocking_io, "/tmp/x")
+                return data
+            """}, self.RULE)
+        assert findings == []
+
+    def test_thread_join_flagged_but_str_join_ignored(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            async def handler(sep, parts, worker_thread):
+                key = sep.join(parts)
+                worker_thread.join(1.0)
+                return key
+            """}, self.RULE)
+        assert len(findings) == 1
+        assert "worker_thread.join" in findings[0].message
+
+    def test_disable_annotation_suppresses(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import pickle
+
+            async def handler(msg):
+                # Bounded: tiny tuple.
+                # raylint: disable=async-blocking
+                return pickle.dumps((1, 2))
+            """}, self.RULE)
+        assert findings == []
+
+    def test_mutation_of_real_gcs_module_is_caught(self, tmp_path):
+        src = real_source("ray_tpu/cluster/gcs.py")
+        assert "await asyncio.sleep(1.0)" in src
+        mutated = src.replace("await asyncio.sleep(1.0)",
+                              "time.sleep(1.0)", 1)
+        findings = lint(tmp_path, {"ray_tpu/cluster/gcs.py": mutated},
+                        self.RULE)
+        assert any("time.sleep" in f.message for f in findings), \
+            [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# wire-discipline fixtures
+# ---------------------------------------------------------------------------
+
+_MINI_WIRE_CLEAN = """
+    WIRE_VERSION = 2
+
+    PING = 0x01
+    PONG = 0x02
+    FANCY = 0x03
+
+    FRAME_MIN_WIRE = {PING: 1, PONG: 1, FANCY: 2}
+
+    def _head(code, rpc_id):
+        return bytes([code])
+
+    def _enc_ping(msg, peer_wire=1):
+        return [_head(PING, 0)]
+
+    def _dec_ping(r, rpc_id):
+        return {"type": "ping"}
+
+    def _enc_pong(msg, peer_wire=1):
+        return [_head(PONG, 0)]
+
+    def _dec_pong(r, rpc_id):
+        return {"ok": True}
+
+    def _enc_fancy(msg, peer_wire=1):
+        if peer_wire < 2:
+            return None
+        return [_head(FANCY, 0)]
+
+    def _dec_fancy(r, rpc_id):
+        return {"type": "fancy"}
+
+    _ENCODERS = {"ping": _enc_ping, "fancy": _enc_fancy}
+    _RESP_ENCODERS = {"ping": _enc_pong}
+    _DECODERS = {PING: _dec_ping, PONG: _dec_pong, FANCY: _dec_fancy}
+    """
+
+_MINI_HANDLERS = """
+    def register(s):
+        @s.handler("ping")
+        async def ping(msg, conn):
+            return {"ok": True}
+
+        @s.handler("fancy")
+        async def fancy(msg, conn):
+            return None
+    """
+
+
+class TestWireDiscipline:
+    RULE = ["wire-discipline"]
+
+    def test_clean_mini_wire_passes(self, tmp_path):
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": _MINI_WIRE_CLEAN,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+        }, self.RULE)
+        assert findings == []
+
+    def test_id_collision_flagged(self, tmp_path):
+        src = _MINI_WIRE_CLEAN.replace("PONG = 0x02", "PONG = 0x01")
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": src,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+        }, self.RULE)
+        assert any("collision" in f.message for f in findings)
+
+    def test_missing_decoder_registration_flagged(self, tmp_path):
+        src = _MINI_WIRE_CLEAN.replace(
+            "_DECODERS = {PING: _dec_ping, PONG: _dec_pong, "
+            "FANCY: _dec_fancy}",
+            "_DECODERS = {PING: _dec_ping, PONG: _dec_pong}")
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": src,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+        }, self.RULE)
+        assert any("FANCY has no _DECODERS entry" in f.message
+                   for f in findings)
+
+    def test_missing_version_gate_flagged(self, tmp_path):
+        src = _MINI_WIRE_CLEAN.replace(
+            "        if peer_wire < 2:\n            return None\n", "")
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": src,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+        }, self.RULE)
+        assert any("peer_wire gate" in f.message for f in findings)
+
+    def test_version_bump_discipline(self, tmp_path):
+        # A v3-gated frame while WIRE_VERSION is still 2: lint error.
+        src = _MINI_WIRE_CLEAN.replace("FANCY: 2}", "FANCY: 3}")
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": src,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+        }, self.RULE)
+        assert any("WIRE_VERSION" in f.message for f in findings)
+
+    def test_missing_handler_site_flagged(self, tmp_path):
+        handlers = _MINI_HANDLERS.replace('@s.handler("ping")',
+                                          '@s.handler("other")')
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": _MINI_WIRE_CLEAN,
+            "ray_tpu/cluster/svc.py": handlers,
+        }, self.RULE)
+        assert any("'ping'" in f.message and "handler" in f.message
+                   for f in findings)
+
+    def test_codec_test_coverage_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "ray_tpu/cluster/wire.py": _MINI_WIRE_CLEAN,
+            "ray_tpu/cluster/svc.py": _MINI_HANDLERS,
+            "tests/test_wire_codec.py": """
+                def test_ping():
+                    assert PING and PONG
+                """,
+        }, self.RULE)
+        assert any("FANCY is never referenced" in f.message
+                   for f in findings)
+        assert not any("PING is never" in f.message for f in findings)
+
+    def test_mutation_of_real_wire_module_is_caught(self, tmp_path):
+        src = real_source("ray_tpu/cluster/wire.py")
+        mutated = src.replace("LIST_TASKS_RESP = 0x15",
+                              "LIST_TASKS_RESP = 0x15\nBOGUS_FRAME = 0x42")
+        findings = lint(tmp_path, {"ray_tpu/cluster/wire.py": mutated},
+                        self.RULE)
+        assert any("BOGUS_FRAME" in f.message and "_DECODERS" in f.message
+                   for f in findings)
+        assert any("BOGUS_FRAME missing from FRAME_MIN_WIRE" in f.message
+                   for f in findings)
+
+    def test_real_wire_module_alone_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            {"ray_tpu/cluster/wire.py": real_source(
+                "ray_tpu/cluster/wire.py")},
+            self.RULE)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# kernel-purity fixtures
+# ---------------------------------------------------------------------------
+
+class TestKernelPurity:
+    RULE = ["kernel-purity"]
+
+    FILES_CLEAN = {
+        "ray_tpu/scheduler/kernel.py": """
+            import jax
+
+            @jax.jit
+            def my_pass(x):
+                return x + 1
+            """,
+        "ray_tpu/scheduler/reference.py": """
+            def my_pass_reference(x):
+                return x + 1
+            """,
+        "tests/test_sched.py": """
+            def test_identity():
+                assert my_pass(1) == my_pass_reference(1)
+            """,
+    }
+
+    def test_clean_pair_passes(self, tmp_path):
+        assert lint(tmp_path, self.FILES_CLEAN, self.RULE) == []
+
+    def test_missing_reference_flagged(self, tmp_path):
+        files = dict(self.FILES_CLEAN)
+        files["ray_tpu/scheduler/reference.py"] = "def other():\n    pass\n"
+        findings = lint(tmp_path, files, self.RULE)
+        assert any("no `my_pass_reference`" in f.message for f in findings)
+
+    def test_missing_property_test_flagged(self, tmp_path):
+        files = dict(self.FILES_CLEAN)
+        files["tests/test_sched.py"] = "def test_nothing():\n    pass\n"
+        findings = lint(tmp_path, files, self.RULE)
+        assert any("property" in f.message for f in findings)
+
+    def test_impure_jit_body_flagged(self, tmp_path):
+        files = dict(self.FILES_CLEAN)
+        files["ray_tpu/scheduler/kernel.py"] = """\
+import jax
+import time
+
+@jax.jit
+def my_pass(x):
+    t = time.time()
+    print(x)
+    return x + t
+"""
+        findings = lint(tmp_path, files, self.RULE)
+        msgs = " | ".join(f.message for f in findings)
+        assert "time.time" in msgs and "print" in msgs
+
+    def test_shared_spec_helper_exempt(self, tmp_path):
+        files = {
+            "ray_tpu/scheduler/kernel.py": """
+                import jax
+
+                @jax.jit
+                def draw_bits(key):
+                    return key
+
+                def draw_bits_host(key):
+                    return draw_bits(key)
+                """,
+            "ray_tpu/scheduler/reference.py": """
+                from .kernel import draw_bits_host
+                """,
+        }
+        assert lint(tmp_path, files, self.RULE) == []
+
+    def test_mutation_of_real_kernel_module_is_caught(self, tmp_path):
+        rogue = ("\n\n@jax.jit\ndef rogue_pass(x):\n"
+                 "    return x * time.time()\n")
+        files = {
+            "ray_tpu/scheduler/kernel.py":
+                real_source("ray_tpu/scheduler/kernel.py") + rogue,
+            "ray_tpu/scheduler/reference.py":
+                real_source("ray_tpu/scheduler/reference.py"),
+            "tests/test_scheduler.py": real_source("tests/test_scheduler.py"),
+        }
+        findings = lint(tmp_path, files, self.RULE)
+        assert any("rogue_pass" in f.message and "no `rogue_pass_reference`"
+                   in f.message for f in findings)
+        assert any("time.time" in f.message for f in findings)
+        # ... and the unmutated originals stay clean.
+        files["ray_tpu/scheduler/kernel.py"] = real_source(
+            "ray_tpu/scheduler/kernel.py")
+        assert lint(tmp_path, files, self.RULE) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-shared-state fixtures
+# ---------------------------------------------------------------------------
+
+class TestThreadSharedState:
+    RULE = ["thread-shared-state"]
+
+    def test_unlocked_cross_thread_mutation_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self.counts = {}
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.counts = {}
+
+                def drain(self):
+                    out, self.counts = self.counts, {}
+                    return out
+            """}, self.RULE)
+        assert len(findings) == 1
+        assert "`self.counts`" in findings[0].message
+
+    def test_locked_mutations_pass(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self.counts = {}
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.counts = {}
+
+                def drain(self):
+                    with self._lock:
+                        out, self.counts = self.counts, {}
+                    return out
+            """}, self.RULE)
+        assert findings == []
+
+    def test_thread_only_mutation_passes(self, tmp_path):
+        # Mutated on one side only: no sharing, no finding.
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import threading
+
+            class Svc:
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.samples = 1
+
+                def read(self):
+                    return getattr(self, "samples", 0)
+            """}, self.RULE)
+        assert findings == []
+
+    def test_mutation_of_real_flight_recorder_is_caught(self, tmp_path):
+        src = real_source("ray_tpu/_private/flight_recorder.py")
+        locked = ("        with self._counts_lock:\n"
+                  "            counts, self._counts = self._counts, {}\n")
+        assert locked in src
+        mutated = src.replace(
+            locked, "        counts, self._counts = self._counts, {}\n")
+        findings = lint(
+            tmp_path, {"ray_tpu/_private/flight_recorder.py": mutated},
+            self.RULE)
+        assert any("`self._counts`" in f.message for f in findings), \
+            [f.message for f in findings]
+        # The unmutated original is clean (drain's swap holds the lock).
+        assert lint(tmp_path,
+                    {"ray_tpu/_private/flight_recorder.py": src},
+                    self.RULE) == []
+
+
+# ---------------------------------------------------------------------------
+# hot-path fixtures
+# ---------------------------------------------------------------------------
+
+class TestHotPath:
+    RULE = ["hot-path"]
+
+    def test_forbidden_calls_in_hotpath_function(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import json, logging, pickle
+
+            logger = logging.getLogger(__name__)
+
+            # raylint: hotpath
+            def pump(frame):
+                pickle.dumps(frame)
+                json.dumps({})
+                logger.info("frame")
+                logger.debug(f"frame {frame}")
+            """}, self.RULE)
+        msgs = " | ".join(f.message for f in findings)
+        assert "pickle.dumps" in msgs
+        assert "json.dumps" in msgs
+        assert "INFO-level log" in msgs
+        assert "eager f-string" in msgs
+        assert len(findings) == 4
+
+    def test_unannotated_function_is_untouched(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import pickle
+
+            def slow_path(frame):
+                return pickle.dumps(frame)
+            """}, self.RULE)
+        assert findings == []
+
+    def test_debug_logging_with_lazy_args_passes(self, tmp_path):
+        findings = lint(tmp_path, {"ray_tpu/cluster/svc.py": """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            # raylint: hotpath
+            def pump(frame):
+                logger.debug("frame %s", frame)
+                return frame
+            """}, self.RULE)
+        assert findings == []
+
+    def test_mutation_of_real_protocol_module_is_caught(self, tmp_path):
+        src = real_source("ray_tpu/cluster/protocol.py")
+        anchor = "        buf = bytearray()\n"
+        assert anchor in src  # _recv_exact, already hotpath-annotated
+        mutated = src.replace(
+            anchor, anchor + "        pickle.dumps(buf)\n", 1)
+        findings = lint(tmp_path,
+                        {"ray_tpu/cluster/protocol.py": mutated}, self.RULE)
+        assert any("pickle.dumps" in f.message
+                   and "_recv_exact" in f.message for f in findings), \
+            [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+class TestBaselineWorkflow:
+    FILES = {"ray_tpu/cluster/svc.py": """
+        import time
+
+        async def handler(msg):
+            time.sleep(0.1)
+        """}
+
+    def test_baseline_suppresses_then_goes_stale(self, tmp_path):
+        project = make_project(tmp_path, self.FILES)
+        root = str(tmp_path)
+        first = run_lint(root, rules=["async-blocking"], project=project)
+        assert len(first.findings) == 1
+
+        lint_baseline.save(root, first.findings)
+        project = make_project(tmp_path, self.FILES)
+        second = run_lint(root, rules=["async-blocking"], project=project)
+        assert second.findings == []
+        assert len(second.baselined) == 1
+
+        # Fix the violation: the baseline entry must surface as stale.
+        (tmp_path / "ray_tpu/cluster/svc.py").write_text(
+            "async def handler(msg):\n    return msg\n")
+        project, _ = load_project(root)
+        third = run_lint(root, rules=["async-blocking"], project=project)
+        assert third.findings == []
+        assert len(third.stale_baseline) == 1
+
+    def test_line_drift_does_not_invalidate_baseline(self, tmp_path):
+        project = make_project(tmp_path, self.FILES)
+        root = str(tmp_path)
+        first = run_lint(root, rules=["async-blocking"], project=project)
+        lint_baseline.save(root, first.findings)
+
+        # Prepend unrelated code: every line number shifts.
+        src = (tmp_path / "ray_tpu/cluster/svc.py").read_text()
+        (tmp_path / "ray_tpu/cluster/svc.py").write_text(
+            "def unrelated():\n    return 1\n\n\n" + src)
+        project, _ = load_project(root)
+        second = run_lint(root, rules=["async-blocking"], project=project)
+        assert second.findings == []
+        assert len(second.baselined) == 1
