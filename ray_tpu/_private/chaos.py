@@ -1,0 +1,169 @@
+"""Fault-injection harness for cluster chaos testing.
+
+Reference counterpart: Ray's ``ray._private.test_utils`` failure helpers +
+the chaos-testing ``NodeKillerActor`` — collapsed into one env-driven
+module so any process in the cluster can be told to misbehave without code
+changes. The head-failover soak scenario and the chaos-matrix tests drive
+these knobs; ``docs/devtools.md`` documents them.
+
+Env knobs (all off by default; read once at :func:`install_from_env`):
+
+``RAY_TPU_CHAOS_DROP_FRAME_P``
+    Probability in [0, 1] that an inbound RPC frame is dropped on the
+    floor by the server (the sender sees a timeout, not an error — the
+    lost-oneway / lost-request case).
+``RAY_TPU_CHAOS_DELAY_FRAME_P`` / ``RAY_TPU_CHAOS_DELAY_FRAME_MS``
+    Probability that an inbound frame is delayed, and the maximum delay in
+    milliseconds (uniform in [0, max]).
+``RAY_TPU_CHAOS_PARTITION_NODE``
+    Node-id prefix to partition: every frame arriving on a connection that
+    registered that node is dropped (a one-way network partition as seen
+    from this server).
+``RAY_TPU_CHAOS_KILL_HEAD_AFTER_S``
+    In a head process: SIGKILL the whole process after N seconds (the hard
+    leader-death drill).
+``RAY_TPU_CHAOS_PAUSE_HEAD_AFTER_S`` / ``RAY_TPU_CHAOS_PAUSE_HEAD_S``
+    In a head process: SIGSTOP after N seconds, SIGCONT after a further M
+    seconds (default 10) — the deposed-leader/split-brain drill: the head
+    wakes up believing it still leads and must find its lease stolen.
+``RAY_TPU_CHAOS_SEED``
+    Deterministic RNG seed for the drop/delay draws.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+from typing import Optional
+
+
+def _env_f(name: str, default: float = 0.0) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class Chaos:
+    """One process's installed fault plan. Decision methods are cheap and
+    called from the server's event loop; timers run on daemon threads."""
+
+    def __init__(self, drop_p: float = 0.0, delay_p: float = 0.0,
+                 delay_max_ms: float = 0.0, partition_node: str = "",
+                 seed: Optional[int] = None):
+        self.drop_p = max(0.0, min(1.0, drop_p))
+        self.delay_p = max(0.0, min(1.0, delay_p))
+        self.delay_max_s = max(0.0, delay_max_ms) / 1000.0
+        self.partition_node = partition_node
+        self._rng = random.Random(seed)
+        # Counters for tests/postmortems (single-threaded loop updates).
+        self.dropped = 0
+        self.delayed = 0
+
+    def should_drop_frame(self, conn_meta: Optional[dict] = None) -> bool:
+        """Drop decision for one inbound frame (server side)."""
+        if self.partition_node and conn_meta is not None:
+            nid = str(conn_meta.get("node_id") or "")
+            if nid and nid.startswith(self.partition_node):
+                self.dropped += 1
+                return True
+        if self.drop_p > 0.0 and self._rng.random() < self.drop_p:
+            self.dropped += 1
+            return True
+        return False
+
+    def frame_delay_s(self) -> float:
+        """Extra latency to inject before handling one frame (0 = none)."""
+        if self.delay_p > 0.0 and self._rng.random() < self.delay_p:
+            self.delayed += 1
+            return self._rng.uniform(0.0, self.delay_max_s)
+        return 0.0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop_p or self.delay_p or self.partition_node)
+
+
+# The process-wide plan. Written once by install_from_env() before the
+# server starts serving, read by the protocol layer per frame.
+_active: Optional[Chaos] = None
+
+
+def get() -> Optional[Chaos]:
+    return _active
+
+
+def install_from_env() -> Optional[Chaos]:
+    """Read the env knobs; install and return a plan when any is set."""
+    global _active
+    plan = Chaos(
+        drop_p=_env_f("RAY_TPU_CHAOS_DROP_FRAME_P"),
+        delay_p=_env_f("RAY_TPU_CHAOS_DELAY_FRAME_P"),
+        delay_max_ms=_env_f("RAY_TPU_CHAOS_DELAY_FRAME_MS"),
+        partition_node=os.environ.get("RAY_TPU_CHAOS_PARTITION_NODE", ""),
+        seed=int(_env_f("RAY_TPU_CHAOS_SEED")) or None,
+    )
+    if plan.active:
+        _active = plan
+        return plan
+    return None
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+# ---------------------------------------------------------------- process
+# helpers: kill / pause / resume by pid (the head-failover drill and
+# `cli kill_random_node --head` use these; SIGSTOP/SIGCONT model a hung —
+# not dead — leader, the split-brain case fencing must win).
+
+def kill_process(pid: int) -> bool:
+    try:
+        os.kill(pid, signal.SIGKILL)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def pause_process(pid: int) -> bool:
+    try:
+        os.kill(pid, signal.SIGSTOP)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def resume_process(pid: int) -> bool:
+    try:
+        os.kill(pid, signal.SIGCONT)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def arm_head_timers() -> None:
+    """In a head process: arm the self-kill / self-pause timers from the
+    env knobs. Daemon threads so they never block shutdown."""
+    kill_after = _env_f("RAY_TPU_CHAOS_KILL_HEAD_AFTER_S")
+    if kill_after > 0:
+        t = threading.Timer(kill_after, kill_process, args=(os.getpid(),))
+        t.daemon = True
+        t.start()
+    pause_after = _env_f("RAY_TPU_CHAOS_PAUSE_HEAD_AFTER_S")
+    if pause_after > 0:
+        pause_s = _env_f("RAY_TPU_CHAOS_PAUSE_HEAD_S", 10.0)
+
+        def _pause_then_resume():
+            pid = os.getpid()
+            resume = threading.Timer(pause_s, resume_process, args=(pid,))
+            resume.daemon = True
+            resume.start()  # armed BEFORE the stop: we can't run while stopped
+            pause_process(pid)
+
+        t = threading.Timer(pause_after, _pause_then_resume)
+        t.daemon = True
+        t.start()

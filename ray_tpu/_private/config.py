@@ -89,6 +89,29 @@ class Config:
     # passes (directory vs controller arenas/spill dirs/rings/task table).
     # <= 0 disables the loop; `cli doctor` still audits on demand.
     audit_interval_s: float = 30.0
+    # --- head HA: GCS reconnect / leadership / replication ---
+    # ResilientClient re-dial budget per call (was a hardcoded 30 s) and
+    # the jittered-exponential-backoff shape of the re-dials
+    # (sleep = min(cap, base * 2^attempt) * uniform[0.5, 1.5)).
+    gcs_retry_window_s: float = 30.0
+    gcs_retry_backoff_base_s: float = 0.05
+    gcs_retry_backoff_cap_s: float = 2.0
+    # Extra GCS addresses clients rotate through on reconnect
+    # ("host:port,host:port" — typically the warm standby).
+    gcs_addrs: str = ""
+    # Leadership lease: the leader renews every ttl/3; a standby may steal
+    # only after expiry (epoch bump). Must comfortably exceed one renewal
+    # round-trip to the persistent store.
+    gcs_lease_ttl_s: float = 3.0
+    # Replication log: buffered on-loop, flushed to the snapshot backend
+    # off-loop at this cadence (the acked-but-unflushed window a hard head
+    # kill can lose; the warm standby's wire tail usually covers it).
+    gcs_repl_flush_interval_s: float = 0.05
+    # Warm standby: leader-tail poll cadence and the in-memory ring of
+    # recent records the leader serves tails from (a standby farther
+    # behind than the ring gets a full-snapshot resync).
+    gcs_standby_poll_interval_s: float = 0.1
+    gcs_repl_ring_size: int = 65536
     # --- raw overrides applied last ---
     _overrides: Dict[str, Any] = field(default_factory=dict)
 

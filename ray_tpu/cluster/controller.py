@@ -196,20 +196,9 @@ class NodeController:
         # over this same connection; the reader thread hops them onto the
         # event loop (reference: raylet receiving leases over its GCS link).
         self._gcs = ResilientClient(*self.gcs_addr,
-                                    push_handler=self._on_gcs_push)
-        from . import wire
-
-        reg = self._gcs.call({
-            "type": "register_node", "node_id": self.node_id,
-            "address": list(self.address), "resources": self.resources,
-            "store_name": self.store_name,
-            "transfer_port": self.transfer_port,
-            "label": self.label,
-            "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION,
-        })
-        # The GCS's advertised version gates the v2 inline-result frames
-        # on the task_done_batch relay (a v1 GCS gets pickle instead).
-        self._gcs.peer_wire = int(reg.get("wire") or 1)
+                                    push_handler=self._on_gcs_push,
+                                    on_reconnect=self._on_gcs_reconnect)
+        self._register_with_gcs(self._gcs)
         # Reap completion rings left by SIGKILLed owners (each pins ~1 MiB
         # of tmpfs); flock liveness keeps live rings untouched.
         from .._native import completion_ring as _cring
@@ -226,6 +215,37 @@ class NodeController:
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
         return port
+
+    def _register_with_gcs(self, client) -> None:
+        """Send register_node over ``client``. Idempotent on the GCS side
+        (same node_id updates in place, rebinds the push connection), so it
+        doubles as the reconnect re-registration after a head failover."""
+        from . import wire
+
+        reg = client.call({
+            "type": "register_node", "node_id": self.node_id,
+            "address": list(self.address), "resources": self.resources,
+            "store_name": self.store_name,
+            "transfer_port": self.transfer_port,
+            "label": self.label,
+            "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION,
+        })
+        # The GCS's advertised version gates the v2 inline-result frames
+        # on the task_done_batch relay (a v1 GCS gets pickle instead).
+        client.peer_wire = int(reg.get("wire") or 1)
+
+    def _on_gcs_reconnect(self, client) -> None:
+        """After the ResilientClient re-dials (head restart or failover to
+        the standby): re-register so the new leader learns this node and
+        binds the fresh connection for dispatch pushes. Runs on the calling
+        thread of whatever RPC triggered the re-dial; the TLS latch in the
+        client prevents recursion if this call itself has to re-dial."""
+        if self._shutting_down:
+            return
+        try:
+            self._register_with_gcs(client)
+        except Exception:  # noqa: BLE001 — next heartbeat retries
+            pass
 
     async def stop(self):
         self._shutting_down = True

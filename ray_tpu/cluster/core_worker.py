@@ -53,7 +53,8 @@ class ClusterCoreWorker:
 
         self.config = config or get_config()
         self.role = role
-        self.gcs = ResilientClient(*gcs_addr)
+        self.gcs = ResilientClient(*gcs_addr,
+                                   on_reconnect=self._on_gcs_reconnect)
         self.gcs_addr = gcs_addr
         # Random, NOT time-derived: two drivers initialized within the
         # same second would otherwise share a job id — and therefore the
@@ -273,6 +274,30 @@ class ClusterCoreWorker:
             except (ConnectionError, OSError):
                 pass
 
+    def _on_gcs_reconnect(self, client) -> None:
+        """After a re-dial (head restart or failover to the standby):
+        re-assert this process's state on the new leader. Everything here
+        is idempotent — the GCS treats ref_refresh as the authoritative
+        held set, and the log subscription is per-connection so the old
+        one died with the old head. Exported functions need no replay:
+        put_function is replicated, so the new leader already has them."""
+        if self._ref_shutdown.is_set():
+            return
+        with self._ref_lock:
+            held = list(self._ref_counts)
+        try:
+            client.send_oneway({"type": "ref_refresh",
+                               "worker": self.worker_uid, "held": held})
+        except (ConnectionError, OSError):
+            pass  # the periodic refresh loop re-asserts in <= 2 s
+        if self._sub_client is not None:
+            try:
+                self._sub_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._sub_client = None
+            self._subscribe_logs()
+
     def _subscribe_logs(self) -> None:
         """Stream worker stdout/stderr lines to this driver's console
         (reference: worker.py:960 print_logs over redis pubsub)."""
@@ -287,7 +312,9 @@ class ClusterCoreWorker:
                 print(f"{prefix} {line}", file=_sys.stderr)
 
         try:
-            self._sub_client = RpcClient(*self.gcs_addr, push_handler=on_push)
+            # self.gcs.addr, not self.gcs_addr: after a failover the live
+            # head is whatever address the ResilientClient rotated to.
+            self._sub_client = RpcClient(*self.gcs.addr, push_handler=on_push)
             self._sub_client.call({"type": "subscribe", "channel": "logs"})
         except (ConnectionError, OSError):
             self._sub_client = None

@@ -20,6 +20,7 @@ import numpy as np
 
 from .._private.config import Config
 from .._private.resources import NUM_PREDEFINED, ResourceSet, dense_matrix
+from . import wire
 from .protocol import Connection, RpcServer
 
 # The pending reasons trended as per-tick gauges. A literal (not an import)
@@ -28,7 +29,6 @@ from .protocol import Connection, RpcServer
 # kernel.REASON_NAMES[1:].
 _REASON_GAUGE_NAMES = ("waiting-for-deps", "waiting-for-capacity",
                        "infeasible", "waiting-for-pg", "quota-throttled")
-
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
@@ -52,9 +52,24 @@ class NodeEntry:
         self.label = label
 
 
+class _ReplayConnection:
+    """Stand-in connection for replication-log replay and standby apply:
+    handlers may attach meta and push, but nothing leaves the process."""
+
+    def __init__(self):
+        self.meta: Dict[str, Any] = {}
+
+    async def send(self, msg, req_type=None):
+        pass
+
+    def send_nowait(self, msg):
+        pass
+
+
 class GcsServer:
     def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 standby_of: Optional[Tuple[str, int]] = None):
         self.config = config
         self.server = RpcServer(host, port)
         # Snapshot persistence (reference: GCS tables against persistent
@@ -237,12 +252,40 @@ class GcsServer:
         self._place_warming: set = set()
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()
+        # ---- head HA (replication log + lease-based leadership). With no
+        # persistent store there is nothing to replicate against or lease
+        # from: the server is unconditionally "leader" and every HA hook
+        # below is a no-op (handlers stay unwrapped — zero hot-path cost).
+        self.standby_of = standby_of  # (host, port) of the leader to tail
+        self._is_leader = standby_of is None and self._storage is None
+        self._leader_epoch = 0
+        import os as _os2
+        import uuid as _uuid
+
+        self._holder_id = f"gcs-{_os2.getpid()}-{_uuid.uuid4().hex[:8]}"
+        self._repl_seq = 0            # last replication-log seq assigned
+        self._repl_buf: List[Tuple[int, bytes]] = []   # awaiting disk flush
+        self._repl_inflight: Set[int] = set()  # seqs mid-handler (watermark)
+        self._repl_recent: Any = _deque(
+            maxlen=max(int(getattr(config, "gcs_repl_ring_size", 65536)), 1))
+        self._replay_mode = False     # suppress side effects while applying
+        self._replay_conn = _ReplayConnection()
+        self._raw_handlers: Dict[str, Any] = {}   # unwrapped, for replay
+        self.failover_count = 0
+        self.time_to_recover_s = 0.0
+        self._standby_lag_bytes = 0
         self._register_handlers()
+        if self._storage is not None:
+            self._install_replication()
 
     def record_event(self, kind: str, **data) -> None:
         """Append one structured lifecycle event to the cluster event log.
         Values must stay JSON-serializable (the dashboard serves them).
         A full ring evicts the oldest event — counted, not silent."""
+        if self._replay_mode:
+            # Replaying a log record must not re-log events the original
+            # leader already recorded (they'd double-count in the rollups).
+            return
         self._event_counts[kind] = self._event_counts.get(kind, 0) + 1
         self._event_seq += 1
         if len(self.cluster_events) == self.cluster_events.maxlen:
@@ -256,8 +299,12 @@ class GcsServer:
                                 "event-log ring").record(1.0)
             except Exception:  # noqa: BLE001 - metrics never fail control
                 pass
+        # The leader epoch disambiguates seq cursors across a failover:
+        # the promoted standby starts a fresh seq counter, and a follower
+        # holding (epoch, seq) can tell a restart from a ring gap.
         self.cluster_events.append(
-            {"ts": time.time(), "kind": kind, "seq": self._event_seq, **data})
+            {"ts": time.time(), "kind": kind, "seq": self._event_seq,
+             "epoch": self._leader_epoch, **data})
 
     def _trace_span(self, trace, task_id, phase: str,
                     start_mono: float, end_mono: float) -> None:
@@ -325,29 +372,23 @@ class GcsServer:
 
     # ------------------------------------------------------------------ setup
     async def start(self) -> int:
-        if self.persist_path:
+        standby = self.standby_of is not None
+        if self.persist_path and not standby:
             self._load_snapshot()
+            # Recovery = snapshot + log replay: records past the snapshot
+            # watermark re-apply through the (idempotent) handlers.
+            await self._replay_log()
+            await self._acquire_leadership()
         port = await self.server.start()
-        # Tasks restored mid-flight re-enter the placement queue; DISPATCHED
-        # ones stay put — their node either reports done/failed or dies, and
-        # both paths re-drive them.
-        for rec in self.task_table.values():
-            if rec["state"] == "DISPATCHED":
-                node = self.nodes.get(rec["node_id"])
-                if node is None or not node.alive:
-                    # Snapshot caught the record mid-flight on a node that
-                    # is already gone: no death transition will ever fire
-                    # for it again, so re-drive now.
-                    rec["state"] = "PENDING"
-                    rec["node_id"] = None
-            if rec["state"] == "PENDING":
-                self._spawn(self._drive_task(rec))
-        self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
-        self._tasks.append(asyncio.create_task(self._placement_loop()))
-        self._tasks.append(asyncio.create_task(self._pg_loop()))
-        self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
+        if standby:
+            # Warm standby: read-only (mutations rejected NOT_LEADER),
+            # tails the leader's snapshot + replication log over the wire
+            # and takes over when the leadership lease expires.
+            self._tasks.append(asyncio.create_task(self._standby_loop()))
+        else:
+            self._redrive_restored()
+            self._start_leader_loops()
         self._tasks.append(asyncio.create_task(self._stats_loop()))
-        self._tasks.append(asyncio.create_task(self._audit_loop()))
         # Warm the scheduler import off-loop: the pending-reason classifier
         # routes through scheduler.reference, whose module chain imports
         # jax — that must never load inline on the event loop's first
@@ -363,12 +404,37 @@ class GcsServer:
             # The head process's ONE sampler (a colocated controller
             # thread shares it); samples merge under component "gcs".
             flight_recorder.start("gcs")
+        return port
+
+    def _redrive_restored(self) -> None:
+        """Re-drive restored records. Tasks restored mid-flight re-enter
+        the placement queue; DISPATCHED ones stay put — their node either
+        reports done/failed or dies, and both paths re-drive them."""
+        for rec in self.task_table.values():
+            if rec["state"] == "DISPATCHED":
+                node = self.nodes.get(rec["node_id"])
+                if node is None or not node.alive:
+                    # Snapshot caught the record mid-flight on a node that
+                    # is already gone: no death transition will ever fire
+                    # for it again, so re-drive now.
+                    rec["state"] = "PENDING"
+                    rec["node_id"] = None
+            if rec["state"] == "PENDING":
+                self._spawn(self._drive_task(rec))
+
+    def _start_leader_loops(self) -> None:
+        self._tasks.append(asyncio.create_task(self._heartbeat_checker()))
+        self._tasks.append(asyncio.create_task(self._placement_loop()))
+        self._tasks.append(asyncio.create_task(self._pg_loop()))
+        self._tasks.append(asyncio.create_task(self._ref_gc_loop()))
+        self._tasks.append(asyncio.create_task(self._audit_loop()))
         if any(r["state"] in ("PENDING", "RESCHEDULING")
                for r in self.placement_groups.values()):
             self._pg_event.set()
-        if self.persist_path:
+        if self._storage is not None:
             self._tasks.append(asyncio.create_task(self._snapshot_loop()))
-        return port
+            self._tasks.append(asyncio.create_task(self._repl_flush_loop()))
+            self._tasks.append(asyncio.create_task(self._lease_loop()))
 
     async def stop(self):
         for t in self._tasks:
@@ -380,10 +446,40 @@ class GcsServer:
             # Only the sampler THIS server started: an in-process GCS
             # (sim runs, unit tests) must not kill the host driver's.
             flight_recorder.stop()
-        if self.persist_path:
-            self._write_snapshot()
+        if self._storage is not None:
+            if self._is_leader and self.persist_path:
+                self._final_persist()
             self._storage.close()
         await self.server.stop()
+
+    def _final_persist(self) -> None:
+        """Shutdown persistence: confirm leadership (a deposed leader must
+        not clobber its successor's snapshot), flush the replication
+        buffer, write the final snapshot, drop the now-covered log, and
+        release the lease so a standby can take over immediately."""
+        still_leader = True
+        try:
+            still_leader = self._storage.renew_lease(
+                self._holder_id, self._leader_epoch, 1.0)
+        except Exception:  # noqa: BLE001 - storage down: write best-effort
+            pass
+        if not still_leader:
+            return
+        if self._repl_buf:
+            entries, self._repl_buf = self._repl_buf, []
+            try:
+                self._storage.append_log(entries, self._leader_epoch)
+            except Exception:  # noqa: BLE001
+                pass
+        self._write_snapshot()
+        try:
+            self._storage.truncate_log(self._repl_seq)
+            # ttl 0 = expire now: a clean shutdown hands leadership over
+            # without waiting out the lease.
+            self._storage.renew_lease(self._holder_id, self._leader_epoch,
+                                      0.0)
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------------ persistence
 
@@ -413,6 +509,13 @@ class GcsServer:
                 pid: {k: v for k, v in rec.items() if k != "waiters"}
                 for pid, rec in self.placement_groups.items()
             },
+            # Replication watermark: every log record with seq <= this is
+            # fully reflected in the state above (in-flight handlers hold
+            # their seq until they return, so the watermark never advances
+            # past a half-applied mutation). Recovery replays seq >
+            # watermark; the log before it can be truncated.
+            "repl_seq": self._repl_watermark(),
+            "leader_epoch": self._leader_epoch,
         }
 
     def _write_snapshot(self) -> None:
@@ -443,6 +546,9 @@ class GcsServer:
             state = _pickle.loads(payload)
         except (EOFError, _pickle.UnpicklingError, ValueError):
             return
+        self._restore_state(state)
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
         for n in state.get("nodes", []):
             entry = NodeEntry(
                 n["node_id"], tuple(n["address"]), n["resources"],
@@ -471,10 +577,15 @@ class GcsServer:
         for tid, rec in self.task_table.items():
             if rec["state"] == "FINISHED":
                 self._finished_order.append(tid)
+        self._repl_seq = int(state.get("repl_seq", 0) or 0)
 
     async def _snapshot_loop(self):
         while True:
             await asyncio.sleep(1.0)
+            if not self._is_leader:
+                # A deposed leader writing snapshots would clobber its
+                # successor's state in the shared store.
+                continue
             try:
                 # Top-level tables are copied on the loop (cheap, and the
                 # copies pin a stable top-level iteration order); the
@@ -487,9 +598,365 @@ class GcsServer:
                 # retries, the same staleness class as the 1 Hz cadence.
                 state = self._snapshot_state(shallow=True)
                 await asyncio.to_thread(self._pickle_and_write, state)
+                # The snapshot covers everything up to its watermark: the
+                # log prefix below it is dead weight (only AFTER the write
+                # lands — a crash mid-snapshot must still replay it).
+                await asyncio.to_thread(self._storage.truncate_log,
+                                        int(state.get("repl_seq", 0) or 0))
             except Exception:  # noqa: BLE001
                 # One failed snapshot must not end persistence for good.
                 continue
+
+    # ----------------------------------------- head HA: replication log,
+    # lease-based leadership, warm standby (reference: GCS fault tolerance
+    # via replicated state behind reconnecting clients, arXiv:1712.05889
+    # §GCS). Every state-mutating handler is wrapped at registration time:
+    # the incoming message is re-encoded with the binary wire codec and
+    # appended (buffered, flushed off-loop) to the snapshot backend's
+    # replication log. Recovery = last snapshot + replay of the log past
+    # the snapshot's watermark through the same (idempotent) handlers. A
+    # warm standby tails the leader's in-memory record ring over the wire
+    # (repl_tail) and promotes itself when the leadership lease expires;
+    # split-brain is prevented by fencing every log append with the leader
+    # epoch (persistence raises LeaseFenced for a stale epoch) and by
+    # rejecting mutations with NOT_LEADER on any non-leader head.
+
+    # Handlers whose effects must survive a head failover. Reads, live-
+    # rebuilt state (heartbeat, ref refresh — periodic by design), and
+    # observability feeds (log_event, stats) are deliberately absent.
+    _REPLICATED = frozenset({
+        "register_node", "report_node_dead", "submit_batch", "submit_task",
+        "create_actor", "register_actor", "update_actor", "task_done",
+        "task_done_batch", "task_failed", "cancel_task",
+        "record_direct_task", "requeue_task", "add_object_location",
+        "object_spilled", "free_objects", "remove_object_locations",
+        "remove_object_location", "put_function", "kv_put", "set_resource",
+        "create_placement_group", "remove_placement_group",
+    })
+
+    def _install_replication(self) -> None:
+        for mtype in self._REPLICATED:
+            fn = self.server._handlers.get(mtype)
+            if fn is None:
+                continue
+            self._raw_handlers[mtype] = fn
+            self.server._handlers[mtype] = self._make_replicated(fn)
+
+    def _make_replicated(self, fn):
+        async def replicated(msg, conn):
+            if not self._is_leader:
+                return {"ok": False, "error": self._not_leader_error()}
+            seq = self._repl_append(msg)
+            try:
+                return await fn(msg, conn)
+            finally:
+                if seq:
+                    self._repl_inflight.discard(seq)
+        return replicated
+
+    def _not_leader_error(self) -> str:
+        role = "a warm standby" if self.standby_of is not None \
+            else "a deposed leader"
+        return (f"NOT_LEADER: this head is {role} "
+                f"(last known epoch {self._leader_epoch}); "
+                f"retry against the current leader")
+
+    def _repl_append(self, msg: Dict[str, Any]) -> int:
+        """Write-ahead append of one mutating message (on-loop: buffer +
+        ring only; the disk append happens in _repl_flush_loop). Returns
+        the assigned seq, held in _repl_inflight until the handler
+        returns so the snapshot watermark can never pass a half-applied
+        mutation."""
+        if self._replay_mode:
+            return 0  # applying an already-logged record
+        self._repl_seq += 1
+        seq = self._repl_seq
+        self._repl_inflight.add(seq)
+        body = self._encode_record(msg)
+        self._repl_buf.append((seq, body))
+        self._repl_recent.append((seq, body))
+        return seq
+
+    @staticmethod
+    def _encode_record(msg: Dict[str, Any]) -> bytes:
+        """One log record: the message re-framed with the binary codec
+        (compact, version-stamped); types without a codec fall back to
+        pickle — _decode_record tells them apart by the magic byte."""
+        rec = {k: v for k, v in msg.items() if k != "rpc_id"}
+        try:
+            bufs = wire.encode(rec, wire.WIRE_VERSION)
+        except wire.WireError:
+            bufs = None
+        if bufs is not None:
+            return b"".join(bufs)
+        return pickle.dumps(rec, protocol=5)
+
+    @staticmethod
+    def _decode_record(body: bytes) -> Dict[str, Any]:
+        if wire.is_binary(body):
+            return wire.decode(body)
+        return pickle.loads(body)
+
+    def _repl_watermark(self) -> int:
+        if self._repl_inflight:
+            return min(self._repl_inflight) - 1
+        return self._repl_seq
+
+    async def _apply_record(self, body: bytes, seq: int = 0) -> None:
+        """Apply one replication record through its (unwrapped) handler
+        with every live side effect suppressed: no pushes, no driving
+        coroutines, no events, no re-logging — state only."""
+        try:
+            msg = self._decode_record(body)
+        except Exception:  # noqa: BLE001 - corrupt record: skip, not fatal
+            if seq:
+                self._repl_seq = max(self._repl_seq, seq)
+            return
+        fn = self._raw_handlers.get(msg.get("type")) \
+            or self.server._handlers.get(msg.get("type"))
+        if fn is not None:
+            self._replay_mode = True
+            try:
+                await fn(msg, self._replay_conn)
+            except Exception:  # noqa: BLE001 - one bad record never stops replay
+                pass
+            finally:
+                self._replay_mode = False
+        if seq:
+            self._repl_seq = max(self._repl_seq, seq)
+
+    def _replay_epilogue(self) -> None:
+        """Clear replay artifacts: fast-lane entries queued by replayed
+        submissions (the re-drive pass owns driving them) and node conns
+        bound to the replay stub."""
+        self._fast_place.clear()
+        self._node_conns = {
+            nid: c for nid, c in self._node_conns.items()
+            if not isinstance(c, _ReplayConnection)}
+
+    async def _replay_log(self) -> None:
+        try:
+            records = self._storage.read_log(after_seq=self._repl_seq)
+        except Exception:  # noqa: BLE001 - unreadable log: snapshot-only start
+            return
+        for seq, body in records:
+            await self._apply_record(body, seq)
+        self._replay_epilogue()
+
+    async def _acquire_leadership(self) -> None:
+        """Block until the leadership lease is ours (immediate on a fresh
+        store; waits out a live holder's ttl otherwise)."""
+        ttl = float(getattr(self.config, "gcs_lease_ttl_s", 3.0))
+        while True:
+            try:
+                epoch = await asyncio.to_thread(
+                    self._storage.acquire_lease, self._holder_id, ttl)
+            except Exception:  # noqa: BLE001 - storage hiccup: retry
+                epoch = None
+            if epoch is not None:
+                self._leader_epoch = int(epoch)
+                self._is_leader = True
+                return
+            await asyncio.sleep(max(0.05, ttl / 3.0))
+
+    async def _lease_loop(self) -> None:
+        """Leader half of the lease protocol: renew every ttl/3; a failed
+        renewal means the lease was stolen after expiry — step down."""
+        ttl = float(getattr(self.config, "gcs_lease_ttl_s", 3.0))
+        while True:
+            await asyncio.sleep(max(0.05, ttl / 3.0))
+            if not self._is_leader:
+                continue
+            try:
+                ok = await asyncio.to_thread(
+                    self._storage.renew_lease, self._holder_id,
+                    self._leader_epoch, ttl)
+            except Exception:  # noqa: BLE001 - transient: next round retries
+                continue
+            if not ok:
+                self._demote("lease stolen after expiry")
+
+    async def _repl_flush_loop(self) -> None:
+        """Off-loop durability for the replication buffer. A LeaseFenced
+        append is the storage telling us a newer epoch exists: step down
+        instead of fighting it."""
+        from .persistence import LeaseFenced
+
+        interval = float(getattr(self.config,
+                                 "gcs_repl_flush_interval_s", 0.05))
+        while True:
+            await asyncio.sleep(interval)
+            if not self._repl_buf or not self._is_leader:
+                continue
+            entries, self._repl_buf = self._repl_buf, []
+            try:
+                await asyncio.to_thread(self._storage.append_log, entries,
+                                        self._leader_epoch)
+            except LeaseFenced:
+                self._demote("append fenced by a newer epoch")
+            except Exception:  # noqa: BLE001 - storage hiccup: retry entries
+                self._repl_buf[:0] = entries
+
+    def _demote(self, reason: str) -> None:
+        """Step down: stop persisting (snapshot loop and flush loop check
+        _is_leader), reject every mutating RPC with NOT_LEADER, and tell
+        the world. Local read-only state stays served."""
+        if not self._is_leader:
+            return
+        self._is_leader = False
+        self._repl_buf.clear()  # a deposed leader's writes are void
+        self.record_event("leader_lost", epoch=self._leader_epoch,
+                          holder=self._holder_id, reason=reason)
+        try:
+            from ..metrics import Count, get_or_create
+
+            get_or_create(
+                Count, "gcs_leader_lost",
+                description="times this head lost GCS leadership"
+            ).record(1.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _standby_loop(self) -> None:
+        """Warm-standby main loop: tail the leader's replication ring over
+        the wire (falling back to a full-snapshot resync when the ring has
+        outrun us), watch the lease, and promote when it expires."""
+        from .protocol import RpcClient
+
+        poll = float(getattr(self.config, "gcs_standby_poll_interval_s",
+                             0.1))
+        ttl = float(getattr(self.config, "gcs_lease_ttl_s", 3.0))
+        client: Optional[RpcClient] = None
+        detected: Optional[float] = None
+        while not self._is_leader:
+            await asyncio.sleep(poll)
+            try:
+                if client is None or client._closed:
+                    client = await asyncio.to_thread(
+                        RpcClient, self.standby_of[0], self.standby_of[1])
+                resp = await asyncio.to_thread(
+                    client.call,
+                    {"type": "repl_tail", "after_seq": self._repl_seq,
+                     "max_records": 4096}, 5.0)
+                await self._apply_tail(resp)
+            except Exception:  # noqa: BLE001 - leader unreachable
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    client = None
+            # The lease in the SHARED store is the source of truth for
+            # takeover (the wire tail is just warmth): only an expired
+            # lease may be stolen.
+            try:
+                lease = await asyncio.to_thread(self._storage.read_lease)
+            except Exception:  # noqa: BLE001
+                continue
+            if lease is not None and \
+                    float(lease.get("expires", 0.0)) > time.time():
+                detected = None
+                continue
+            if detected is None:
+                detected = time.monotonic()
+            try:
+                epoch = await asyncio.to_thread(
+                    self._storage.acquire_lease, self._holder_id, ttl)
+            except Exception:  # noqa: BLE001
+                continue
+            if epoch is not None:
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                await self._promote(int(epoch), detected)
+                return
+
+    async def _apply_tail(self, resp: Dict[str, Any]) -> None:
+        """Fold one repl_tail response into local state."""
+        if resp.get("resync") and resp.get("snapshot") is not None:
+            try:
+                state = await asyncio.to_thread(
+                    pickle.loads, resp["snapshot"])
+            except Exception:  # noqa: BLE001 - bad snapshot: next poll retries
+                return
+            self._reset_state()
+            self._replay_mode = True
+            try:
+                self._restore_state(state)
+            finally:
+                self._replay_mode = False
+            self._repl_seq = int(resp.get("snapshot_seq") or 0)
+        for blob in resp.get("records") or ():
+            # Each record rides as a repl_record frame ([epoch][seq][body])
+            # so the cursor advances exactly as far as what was applied.
+            try:
+                rec = wire.decode(blob)
+            except wire.WireError:
+                continue
+            await self._apply_record(rec["body"], int(rec["seq"]))
+        self._standby_lag_bytes = max(
+            0, int(resp.get("lag_bytes") or 0))
+
+    def _reset_state(self) -> None:
+        """Drop every replicated table before a full resync."""
+        self.nodes.clear()
+        self._node_order.clear()
+        self.actors.clear()
+        self.named_actors.clear()
+        self.objects.clear()
+        self.functions.clear()
+        self.kv.clear()
+        self.task_table.clear()
+        self.lineage.clear()
+        self.error_objects.clear()
+        self.placement_groups.clear()
+        self._error_order.clear()
+        self._finished_order.clear()
+        self._node_conns.clear()
+
+    async def _promote(self, epoch: int, detected: Optional[float]) -> None:
+        """Standby -> leader. Catch up from the shared log (records the
+        wire tail missed), re-drive restored work, start the leader loops,
+        and report time-to-recover from the moment the expired lease was
+        first observed."""
+        t0 = detected if detected is not None else time.monotonic()
+        self._leader_epoch = epoch
+        try:
+            records = await asyncio.to_thread(
+                self._storage.read_log, self._repl_seq)
+            for seq, body in records:
+                await self._apply_record(body, seq)
+        except Exception:  # noqa: BLE001 - wire tail already covered most
+            pass
+        self._replay_epilogue()
+        self._is_leader = True
+        self.standby_of = None
+        # Restored nodes must re-prove liveness, with a full window to do
+        # so — their clients are still rotating toward this address.
+        now = time.monotonic()
+        for node in self.nodes.values():
+            node.last_heartbeat = now
+        self._redrive_restored()
+        self._start_leader_loops()
+        self.failover_count += 1
+        self.time_to_recover_s = time.monotonic() - t0
+        self.record_event(
+            "leader_elected", epoch=epoch, holder=self._holder_id,
+            time_to_recover_s=round(self.time_to_recover_s, 3))
+        try:
+            from ..metrics import Count, Gauge, get_or_create
+
+            get_or_create(
+                Count, "gcs_failover",
+                description="standby promotions to GCS leader").record(1.0)
+            get_or_create(
+                Gauge, "gcs_time_to_recover_s",
+                description="seconds from observed lease expiry to serving "
+                            "as leader").record(self.time_to_recover_s)
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------- flight recorder + time-series
     _STACKS_PER_COMPONENT = 20_000
@@ -581,6 +1048,33 @@ class GcsServer:
         if self._last_audit:
             self.timeseries.add_gauge("audit_findings",
                                       self._last_audit.get("total", 0))
+        # Head-HA series: leadership epoch, standby replication lag (as
+        # observed by the leader serving repl_tail), promotions, and the
+        # last failover's time-to-recover — the SLO engine and `cli top`
+        # read these; Prometheus mirrors them.
+        self.timeseries.add_gauge("gcs_leader_epoch", self._leader_epoch)
+        if self._storage is not None or self.failover_count:
+            self.timeseries.add_gauge("gcs_standby_lag_bytes",
+                                      self._standby_lag_bytes)
+            self.timeseries.add_gauge("gcs_failover_count",
+                                      self.failover_count)
+            if self.time_to_recover_s:
+                self.timeseries.add_gauge("gcs_time_to_recover_s",
+                                          self.time_to_recover_s)
+        try:
+            from ..metrics import Gauge, get_or_create
+
+            get_or_create(
+                Gauge, "gcs_leader_epoch",
+                description="current GCS leadership epoch"
+            ).record(float(self._leader_epoch))
+            get_or_create(
+                Gauge, "gcs_standby_lag_bytes",
+                description="replication-ring bytes the standby has not "
+                            "fetched yet").record(
+                float(self._standby_lag_bytes))
+        except Exception:  # noqa: BLE001 - metrics never fail rollups
+            pass
 
     async def _stats_loop(self):
         """Periodic observability tick: drain this process's stack sampler
@@ -811,6 +1305,8 @@ class GcsServer:
             return
         while True:
             await asyncio.sleep(interval)
+            if not self._is_leader:
+                continue
             try:
                 await self.run_audit(verify=True)
             except Exception:  # noqa: BLE001 - the auditor never kills GCS
@@ -820,6 +1316,12 @@ class GcsServer:
 
     # ----------------------------------------------------- task lifecycle
     def _spawn(self, coro) -> None:
+        if self._replay_mode:
+            # Record-only application: driving coroutines (dispatch,
+            # retries) belong to the live leader; after replay finishes,
+            # start()/_promote re-drive every PENDING record exactly once.
+            coro.close()
+            return
         task = asyncio.create_task(coro)
         self._bg.add(task)
 
@@ -862,6 +1364,10 @@ class GcsServer:
             self.lineage[oid] = task_id
             # A resubmitted/restarted producer supersedes any old error.
             self.error_objects.pop(oid, None)
+        if self._replay_mode:
+            # Replay records state only; the post-replay re-drive pass
+            # spawns _drive_task for every surviving PENDING record.
+            return rec
         if kind == "task" and not payload.get("deps"):
             # Fast lane: dep-free tasks go straight to the placement loop.
             self._fast_place.append(rec)
@@ -1225,6 +1731,8 @@ class GcsServer:
         lease = 20.0
         while True:
             await asyncio.sleep(1.0)
+            if not self._is_leader:
+                continue
             now = time.monotonic()
             for worker, seen in list(self._ref_worker_seen.items()):
                 if now - seen > lease:
@@ -1394,6 +1902,8 @@ class GcsServer:
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, data: Dict[str, Any]):
+        if self._replay_mode:
+            return  # the original leader already pushed this
         msg = {"type": "pubsub", "channel": channel, "data": data}
         dead = []
         for conn in self.subscribers.get(channel, set()):
@@ -1410,6 +1920,8 @@ class GcsServer:
                      * self.config.num_heartbeats_timeout) / 1000.0
         while True:
             await asyncio.sleep(self.config.heartbeat_interval_ms / 1000.0)
+            if not self._is_leader:
+                continue  # deposed: the new leader owns death detection
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > timeout_s:
@@ -1519,6 +2031,8 @@ class GcsServer:
             self._place_event.clear()
             # small accumulation window so concurrent submissions batch
             await asyncio.sleep(tick)
+            if not self._is_leader:
+                continue  # deposed: dispatching now would double-run tasks
             fast, self._fast_place = self._fast_place, []
             batch, self._pending_place = self._pending_place, []
             entries = list(batch)
@@ -1981,6 +2495,9 @@ class GcsServer:
         unplaceable gang NEVER stalls singleton placement — a pending
         group holds zero resources until the pass admits all its bundles."""
         while True:
+            if not self._is_leader:
+                await asyncio.sleep(1.0)
+                continue
             if not self._pg_pending():
                 await self._pg_event.wait()
                 self._pg_event.clear()
@@ -2391,6 +2908,63 @@ class GcsServer:
         async def ping(msg, conn):
             return {"ok": True}
 
+        # ---- head HA ----
+        @s.handler("ha_status")
+        async def ha_status(msg, conn):
+            """Leadership/replication introspection (`cli status`, tests,
+            the failover drill's time-to-recover report)."""
+            role = ("leader" if self._is_leader
+                    else ("standby" if self.standby_of is not None
+                          else "demoted"))
+            return {"ok": True, "epoch": int(self._leader_epoch),
+                    "is_leader": bool(self._is_leader), "role": role,
+                    "failover_count": int(self.failover_count),
+                    "standby_lag_bytes": int(self._standby_lag_bytes),
+                    "time_to_recover_s": float(self.time_to_recover_s),
+                    "repl_seq": int(self._repl_seq),
+                    "peers": []}
+
+        @s.handler("repl_tail")
+        async def repl_tail(msg, conn):
+            """Standby tail of the replication stream. Serves records with
+            seq > after_seq from the in-memory ring; a cursor that fell
+            behind the ring gets a full-snapshot resync instead (records
+            intentionally empty there — the next poll tails from the
+            snapshot's watermark)."""
+            if not self._is_leader:
+                return {"ok": False, "error": self._not_leader_error()}
+            after = int(msg.get("after_seq") or 0)
+            maxn = max(1, int(msg.get("max_records") or 4096))
+            ring = self._repl_recent
+            oldest = ring[0][0] if ring else self._repl_seq + 1
+            if after + 1 < oldest and after < self._repl_seq:
+                state = self._snapshot_state(shallow=True)
+                payload = await asyncio.to_thread(pickle.dumps, state)
+                return {"ok": True, "epoch": int(self._leader_epoch),
+                        "last_seq": int(self._repl_seq), "resync": True,
+                        "snapshot": payload,
+                        "snapshot_seq": int(state.get("repl_seq", 0) or 0),
+                        "records": []}
+            records = []
+            lag = 0
+            for seq, body in ring:
+                if seq <= after:
+                    continue
+                if len(records) < maxn:
+                    records.append(b"".join(wire.encode(
+                        {"type": "repl_record",
+                         "epoch": int(self._leader_epoch),
+                         "seq": seq, "body": body}, wire.WIRE_VERSION)))
+                else:
+                    lag += len(body)
+            # Standby replication lag, as observed where monitoring lives
+            # (the leader): bytes in the ring this follower has not
+            # fetched yet after this response.
+            self._standby_lag_bytes = lag
+            return {"ok": True, "epoch": int(self._leader_epoch),
+                    "last_seq": int(self._repl_seq), "resync": False,
+                    "records": records, "lag_bytes": lag}
+
         @s.handler("debug_stats")
         async def debug_stats(msg, conn):
             """Per-RPC-type count + cumulative event-loop seconds (the
@@ -2671,6 +3245,18 @@ class GcsServer:
             return {"ok": True}
 
         def _handle_task_done(msg) -> None:
+            tid = msg.get("task_id")
+            dup = self.task_table.get(tid)
+            if dup is not None and dup["state"] in ("FINISHED", "FAILED"):
+                # Duplicate completion: a client retry across a reconnect/
+                # failover re-sent the batch, or log replay re-applied a
+                # record the snapshot already covers. The first report
+                # released the node share and counted the phase stats —
+                # doing either again would corrupt accounting.
+                return
+            if dup is None and tid and tid in self._early_task_done:
+                # Duplicate of a completion that already beat its record.
+                return
             if "exec_s" in msg:
                 # Worker-measured execution + result-store wall time rides
                 # in the completion item; accumulated here so one
@@ -3287,6 +3873,7 @@ class GcsServer:
             return {"ok": True, "events": out[::-1],
                     "dropped": self.events_dropped,
                     "capacity": self.cluster_events.maxlen,
+                    "epoch": self._leader_epoch,
                     "last_seq": self._event_seq,
                     "oldest_seq": (self.cluster_events[0].get("seq", 0)
                                    if self.cluster_events else None),

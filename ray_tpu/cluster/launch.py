@@ -43,14 +43,22 @@ def _force_cpu_jax():
 
 async def run_head(port: int, resources: dict, num_workers: int,
                    with_node: bool = True, worker_env: dict | None = None,
-                   persist: str | None = None):
+                   persist: str | None = None,
+                   standby_of: tuple | None = None):
+    from ray_tpu._private import chaos
     from ray_tpu._private.config import get_config
     from ray_tpu.cluster.gcs import GcsServer
 
     import os
 
     config = get_config()
-    gcs = GcsServer(config, port=port, persist_path=persist)
+    # Fault-injection plan for this head (off unless env knobs are set):
+    # frame drop/delay/partition install into the protocol layer; the
+    # kill/pause timers model leader death and a hung leader.
+    chaos.install_from_env()
+    chaos.arm_head_timers()
+    gcs = GcsServer(config, port=port, persist_path=persist,
+                    standby_of=standby_of)
     # RAY_TPU_PROFILE_GCS=<path>: cProfile the GCS event loop, dump pstats
     # at shutdown (the server-side complement of profiling the driver).
     profiler = None
@@ -61,7 +69,13 @@ async def run_head(port: int, resources: dict, num_workers: int,
         profiler = cProfile.Profile()
         profiler.enable()
     gcs_port = await gcs.start()
-    print(json.dumps({"event": "gcs_started", "port": gcs_port}), flush=True)
+    print(json.dumps({"event": "gcs_started", "port": gcs_port,
+                      "role": "standby" if standby_of else "leader",
+                      "pid": os.getpid()}), flush=True)
+    if standby_of is not None:
+        # A standby head runs no colocated controller until promoted: its
+        # GCS is read-only and nodes belong to the leader.
+        with_node = False
     node_stop = None
     if with_node:
         # The controller does blocking RPCs to the GCS, so it must live on
@@ -155,6 +169,14 @@ def main():
     head.add_argument("--worker-env", default="{}")
     head.add_argument("--persist", default=None,
                       help="snapshot file for GCS state (restart recovery)")
+    head.add_argument("--standby", action="store_true",
+                      help="start as a warm standby: read-only, tails the "
+                           "leader named by --peer over the wire, promotes "
+                           "itself when the leadership lease expires "
+                           "(requires --persist on the SAME shared store "
+                           "as the leader)")
+    head.add_argument("--peer", default=None,
+                      help="leader address host:port to tail (--standby)")
 
     node = sub.add_parser("node")
     node.add_argument("--gcs", required=True)
@@ -169,10 +191,17 @@ def main():
     worker_env.setdefault("JAX_PLATFORMS", "cpu")
     try:
         if args.role == "head":
+            standby_of = None
+            if args.standby:
+                if not args.peer or not args.persist:
+                    parser.error("--standby requires --peer and --persist "
+                                 "(shared with the leader)")
+                peer_host, peer_port = args.peer.rsplit(":", 1)
+                standby_of = (peer_host, int(peer_port))
             asyncio.run(run_head(
                 args.port, json.loads(args.resources), args.num_workers,
                 with_node=not args.no_node, worker_env=worker_env,
-                persist=args.persist,
+                persist=args.persist, standby_of=standby_of,
             ))
         else:
             host, port = args.gcs.rsplit(":", 1)

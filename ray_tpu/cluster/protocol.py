@@ -26,13 +26,16 @@ from __future__ import annotations
 import asyncio
 import itertools
 import pickle
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import wire
+from .._private import chaos as _chaos
+from .._private.config import get_config
 
 _LEN = struct.Struct("<Q")
 MAX_MESSAGE = 1 << 34
@@ -169,6 +172,15 @@ class RpcServer:
                 if frame is None:
                     break
                 msg, was_binary = frame
+                plan = _chaos.get()
+                if plan is not None:
+                    # Fault injection (off unless a chaos plan is installed;
+                    # the common path pays one module-global None check).
+                    delay = plan.frame_delay_s()
+                    if delay > 0.0:
+                        await asyncio.sleep(delay)
+                    if plan.should_drop_frame(conn.meta):
+                        continue
                 if was_binary:
                     # Observed capability: this peer talks binary, so
                     # responses/pushes to it may too — but only v1 frames
@@ -444,24 +456,69 @@ class RpcClient:
             pass
 
 
+def _parse_addr_list(spec: str) -> List[Tuple[str, int]]:
+    """Parse "host:port,host:port" (the ``gcs_addrs`` config knob /
+    RAY_TPU_GCS_ADDRS) into an address list; malformed entries are skipped."""
+    out: List[Tuple[str, int]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        host, _, port = part.rpartition(":")
+        try:
+            out.append((host, int(port)))
+        except ValueError:
+            continue
+    return out
+
+
 class ResilientClient:
     """RpcClient that transparently reconnects across server restarts.
 
     Used for GCS connections (reference: clients retry against the restarted
     GCS in test_gcs_fault_tolerance.py). A call that hits a dead socket
-    re-dials until ``retry_window`` elapses; the GCS restores its tables from
-    its snapshot, so retried calls see consistent state.
+    re-dials with jittered exponential backoff — sleep =
+    min(cap, base * 2^attempt) * uniform[0.5, 1.5) — until ``retry_window``
+    elapses; window and backoff shape come from RayConfig
+    (``gcs_retry_window_s`` / ``gcs_retry_backoff_base_s`` / ``_cap_s``).
+
+    For head HA the client holds a multi-address list (primary + warm
+    standbys, extended by ``addrs`` and the ``gcs_addrs`` knob) and rotates
+    through it on every failed dial, so a promoted standby is found without
+    reconfiguration. A ``NOT_LEADER`` rejection from a fenced or demoted
+    head is treated like a dead socket: drop, rotate, retry. After every
+    successful RE-dial (not the first connect) ``on_reconnect`` fires with
+    the live client so callers can idempotently re-register themselves
+    (re-publish inventory, re-arm rings and long-polls) with the new leader.
     """
 
     def __init__(self, host: str, port: int,
                  push_handler: Optional[Callable[[Dict], None]] = None,
-                 retry_window: float = 30.0):
-        self.addr = (host, port)
+                 retry_window: Optional[float] = None,
+                 addrs: Optional[Sequence[Tuple[str, int]]] = None,
+                 on_reconnect: Optional[Callable[["RpcClient"], None]] = None):
+        cfg = get_config()
+        self._retry_window = (cfg.gcs_retry_window_s if retry_window is None
+                              else retry_window)
+        self._backoff_base = max(1e-3, cfg.gcs_retry_backoff_base_s)
+        self._backoff_cap = max(self._backoff_base, cfg.gcs_retry_backoff_cap_s)
+        self._addrs: List[Tuple[str, int]] = [(host, int(port))]
+        for cand in list(addrs or []) + _parse_addr_list(cfg.gcs_addrs):
+            cand = (cand[0], int(cand[1]))
+            if cand not in self._addrs:
+                self._addrs.append(cand)
+        self._addr_idx = 0
+        self.addr = self._addrs[0]  # currently-targeted address
         self._push_handler = push_handler
-        self._retry_window = retry_window
+        self._on_reconnect = on_reconnect
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
+        self._ever_connected = False
+        # Reentrancy latch: an on_reconnect callback typically calls back
+        # through this client; a failure inside it must not recurse into
+        # another callback invocation.
+        self._reconnect_tls = threading.local()
         # Shared across reconnects so coalescing counters survive re-dials.
         self.io_stats: Dict[str, int] = {"frames_sent": 0, "writes": 0}
         self._ensure()
@@ -470,34 +527,69 @@ class ResilientClient:
         with self._lock:
             if self._closed:
                 raise ConnectionError(f"client to {self.addr} closed")
-            if self._client is None or self._client._closed:
-                self._client = RpcClient(
-                    *self.addr, push_handler=self._push_handler,
-                    io_stats=self.io_stats)
-            return self._client
+            if self._client is not None and not self._client._closed:
+                return self._client
+            self.addr = self._addrs[self._addr_idx]
+            self._client = RpcClient(
+                *self.addr, push_handler=self._push_handler,
+                io_stats=self.io_stats)
+            client = self._client
+            is_reconnect = self._ever_connected
+            self._ever_connected = True
+        if (is_reconnect and self._on_reconnect is not None
+                and not getattr(self._reconnect_tls, "active", False)):
+            # Outside the lock: the callback re-registers through this very
+            # client (call() -> _ensure() would deadlock otherwise).
+            self._reconnect_tls.active = True
+            try:
+                self._on_reconnect(client)
+            except Exception:  # noqa: BLE001 - re-registration is best-effort
+                pass
+            finally:
+                self._reconnect_tls.active = False
+        return client
 
-    def _drop(self) -> None:
+    def _drop(self, rotate: bool = False) -> None:
         with self._lock:
             if self._client is not None:
                 self._client.close()
                 self._client = None
+            if rotate and len(self._addrs) > 1:
+                self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+
+    def _backoff(self, attempt: int) -> None:
+        sleep = min(self._backoff_cap, self._backoff_base * (2 ** attempt))
+        time.sleep(sleep * (0.5 + random.random()))
 
     def call(self, msg: Dict[str, Any], timeout: Optional[float] = 60.0) -> Dict:
         deadline = time.monotonic() + self._retry_window
+        attempt = 0
         while True:
             try:
                 return self._ensure().call(msg, timeout=timeout)
-            except (ConnectionError, OSError):
-                self._drop()
+            except (ConnectionError, OSError, TimeoutError):
+                # TimeoutError is retried too: a paused head or a chaos-
+                # dropped frame looks like a hang, and every GCS mutation
+                # is idempotent/deduped so re-sending is safe.
+                self._drop(rotate=True)
                 if self._closed or time.monotonic() > deadline:
                     raise
-                time.sleep(0.25)
+            except RuntimeError as e:
+                # A fenced/demoted head rejects mutations with NOT_LEADER;
+                # the real leader is (or will be) at another address.
+                if "NOT_LEADER" not in str(e):
+                    raise
+                self._drop(rotate=True)
+                if self._closed or time.monotonic() > deadline:
+                    raise
+            self._backoff(attempt)
+            attempt += 1
 
     def send_oneway(self, msg: Dict[str, Any]) -> None:
         try:
             self._ensure().send_oneway(msg)
         except (ConnectionError, OSError):
-            self._drop()
+            self._drop(rotate=True)
             # one immediate retry; oneway messages are periodic (heartbeats)
             # so a miss is recovered by the next tick anyway
             try:
@@ -509,7 +601,7 @@ class ResilientClient:
         try:
             self._ensure().send_oneway_many(msgs)
         except (ConnectionError, OSError):
-            self._drop()
+            self._drop(rotate=True)
             try:
                 self._ensure().send_oneway_many(msgs)
             except (ConnectionError, OSError):

@@ -77,12 +77,25 @@ class Cluster:
     """
 
     def __init__(self, head_resources: Optional[Dict[str, float]] = None,
-                 num_workers: int = 2, reap_on_sigterm: bool = True):
+                 num_workers: int = 2, reap_on_sigterm: bool = True,
+                 persist_path: Optional[str] = None,
+                 head_with_node: bool = True,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.nodes: List[ClusterNode] = []
         self._head = None
         self.gcs_port: Optional[int] = None
+        self.head_pid: Optional[int] = None
         self.head_resources = head_resources or {"CPU": 4}
         self.num_workers = num_workers
+        # HA testing hooks: a persisted head can be paired with a warm
+        # standby (start_standby) and hard-killed (kill_head) to drive the
+        # failover path; extra_env reaches every spawned component (e.g.
+        # RAY_TPU_GCS_ADDRS so nodes know the standby's address, or the
+        # chaos knobs).
+        self.persist_path = persist_path
+        self.head_with_node = head_with_node
+        self._extra_env = dict(extra_env or {})
+        self.standby: Optional[ClusterNode] = None
         self._start_head()
         # A driver that dies without calling shutdown() (crashed script,
         # timed-out tool) must not orphan the process tree: a leaked head +
@@ -130,23 +143,98 @@ class Cluster:
                     continue
         raise TimeoutError("cluster process did not report startup")
 
+    def _env(self) -> Dict[str, str]:
+        env = _subprocess_env()
+        env.update(self._extra_env)
+        return env
+
     def _start_head(self):
         log_path = tempfile.mktemp(prefix="ray_tpu_head_", suffix=".log")
+        cmd = [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
+               "--resources", json.dumps(self.head_resources),
+               "--num-workers", str(self.num_workers)]
+        if self.persist_path:
+            cmd += ["--persist", self.persist_path]
+        if not self.head_with_node:
+            cmd += ["--no-node"]
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
-             "--resources", json.dumps(self.head_resources),
-             "--num-workers", str(self.num_workers)],
+            cmd,
             stdout=subprocess.PIPE, stderr=open(log_path, "w"), text=True,
-            env=_subprocess_env(),
+            env=self._env(),
         )
         self._head = proc
         evt = self._read_event(proc, log_path=log_path)
         assert evt["event"] == "gcs_started"
         self.gcs_port = evt["port"]
-        evt = self._read_event(proc, log_path=log_path)  # colocated head node
-        assert evt["event"] == "node_started"
-        self.nodes.append(
-            ClusterNode(proc, evt["port"], evt.get("node_id", ""), log_path))
+        self.head_pid = evt.get("pid")
+        if self.head_with_node:
+            evt = self._read_event(proc, log_path=log_path)  # colocated node
+            assert evt["event"] == "node_started"
+            self.nodes.append(ClusterNode(
+                proc, evt["port"], evt.get("node_id", ""), log_path))
+        else:
+            # Track the head process for shutdown even without a node.
+            self.nodes.append(ClusterNode(proc, 0, "", log_path))
+
+    # ----------------------------------------------------------------- HA
+    def start_standby(self, port: int = 0) -> ClusterNode:
+        """Start a warm-standby head tailing the current leader over the
+        shared persistent store. It promotes itself when the leader's
+        lease expires (see kill_head)."""
+        assert self.persist_path, "standby requires Cluster(persist_path=)"
+        log_path = tempfile.mktemp(prefix="ray_tpu_standby_", suffix=".log")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.launch", "head",
+             "--standby", "--peer", self.address,
+             "--persist", self.persist_path, "--port", str(port),
+             "--resources", json.dumps(self.head_resources),
+             "--num-workers", str(self.num_workers)],
+            stdout=subprocess.PIPE, stderr=open(log_path, "w"), text=True,
+            env=self._env(),
+        )
+        evt = self._read_event(proc, log_path=log_path)
+        assert evt["event"] == "gcs_started" and evt.get("role") == "standby"
+        node = ClusterNode(proc, evt["port"], "", log_path)
+        self.standby = node
+        self.nodes.append(node)  # so shutdown() reaps it
+        return node
+
+    def kill_head(self) -> Optional[int]:
+        """SIGKILL the head process — the hard leader-death drill. Returns
+        the dead head's pid. The colocated controller (if any) dies with
+        it; a started standby takes over once the lease expires."""
+        pid = self.head_pid
+        if self._head is not None and self._head.poll() is None:
+            self._head.kill()
+            self._head.wait()
+        for n in list(self.nodes):
+            if n.proc is self._head:
+                n._unlink_store()
+                self.nodes.remove(n)
+        return pid
+
+    def wait_for_leader(self, port: int, timeout: float = 30.0) -> dict:
+        """Poll ha_status on ``port`` until that head reports leadership
+        (standby promotion complete). Returns the ha_status response."""
+        from .protocol import RpcClient
+
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                client = RpcClient("127.0.0.1", port)
+                try:
+                    resp = client.call({"type": "ha_status"})
+                    last = resp
+                    if resp.get("is_leader"):
+                        return resp
+                finally:
+                    client.close()
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(f"no leader on port {port} "
+                           f"within {timeout}s (last: {last})")
 
     def add_node(self, resources: Optional[Dict[str, float]] = None,
                  num_workers: int = 2) -> ClusterNode:
@@ -157,7 +245,7 @@ class Cluster:
              "--resources", json.dumps(resources or {"CPU": 4}),
              "--num-workers", str(num_workers)],
             stdout=subprocess.PIPE, stderr=open(log_path, "w"), text=True,
-            env=_subprocess_env(),
+            env=self._env(),
         )
         evt = self._read_event(proc, log_path=log_path)
         node = ClusterNode(proc, evt["port"], evt.get("node_id", ""), log_path)

@@ -52,11 +52,13 @@ from typing import Any, Dict, List, Optional, Tuple
 MAGIC = 0xBF
 # v2 adds the inline-result frames (TASK_DONE2 / TASK_DONE_BATCH2 and the
 # _LOC_INLINE location flag); v3 adds the PROFILE_STACKS stats frame; v4
-# adds the state-API frames (LIST_TASKS / LIST_TASKS_RESP).
+# adds the state-API frames (LIST_TASKS / LIST_TASKS_RESP); v5 adds the
+# head-HA frames (REPL_RECORD / REPL_TAIL / REPL_TAIL_RESP / HA_STATUS /
+# HA_STATUS_RESP).
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 4
+WIRE_VERSION = 5
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -92,6 +94,18 @@ PROFILE_STACKS = 0x13
 # busy head never re-enter pickle on the state path.
 LIST_TASKS = 0x14
 LIST_TASKS_RESP = 0x15
+# Head-HA frames (v5). REPL_RECORD wraps one state-mutating RPC body with
+# its (epoch, seq) fencing header — the unit of both the on-disk
+# replication log and the over-the-wire standby tail. REPL_TAIL is the
+# standby's cursor poll; its response either carries the records after the
+# cursor or a full-snapshot resync when the leader's ring no longer covers
+# it. HA_STATUS is the leadership probe (`cli status`, monitor, peers
+# learning the leader).
+REPL_RECORD = 0x16
+REPL_TAIL = 0x17
+REPL_TAIL_RESP = 0x18
+HA_STATUS = 0x19
+HA_STATUS_RESP = 0x1A
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -120,6 +134,11 @@ FRAME_MIN_WIRE = {
     PROFILE_STACKS: 3,
     LIST_TASKS: 4,
     LIST_TASKS_RESP: 4,
+    REPL_RECORD: 5,
+    REPL_TAIL: 5,
+    REPL_TAIL_RESP: 5,
+    HA_STATUS: 5,
+    HA_STATUS_RESP: 5,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -914,6 +933,141 @@ def _dec_pg_status_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "groups": groups, "rpc_id": rpc_id}
 
 
+# --------------------------------------------------------------------------
+# head-HA frames (v5)
+# --------------------------------------------------------------------------
+
+def _enc_repl_record(msg, peer_wire: int = WIRE_VERSION
+                     ) -> Optional[List[bytes]]:
+    """One replication-log entry: the (epoch, seq) fencing header plus the
+    original mutating RPC's frame body, carried opaquely. This is the
+    record envelope on the standby's over-the-wire tail (repl_tail
+    responses); the on-disk log carries the same fields in the
+    persistence layer's own fenced header."""
+    if peer_wire < 5:
+        return None
+    body = msg["body"]
+    return [_head(REPL_RECORD, msg.get("rpc_id")),
+            _U32.pack(int(msg["epoch"])),
+            _U64.pack(int(msg["seq"])),
+            _U32.pack(len(body)), body]
+
+
+def _dec_repl_record(r: _Reader, rpc_id) -> Dict[str, Any]:
+    epoch = r.u32()
+    seq = r.u64()
+    body = r.b32()
+    r.done()
+    return {"type": "repl_record", "epoch": epoch, "seq": seq,
+            "body": body, "rpc_id": rpc_id}
+
+
+def _enc_repl_tail(msg, peer_wire: int = WIRE_VERSION
+                   ) -> Optional[List[bytes]]:
+    if peer_wire < 5:
+        return None
+    return [_head(REPL_TAIL, msg.get("rpc_id")),
+            _U64.pack(int(msg.get("after_seq") or 0)),
+            _U32.pack(int(msg.get("max_records") or 0))]
+
+
+def _dec_repl_tail(r: _Reader, rpc_id) -> Dict[str, Any]:
+    after = r.u64()
+    max_records = r.u32()
+    r.done()
+    return {"type": "repl_tail", "after_seq": after,
+            "max_records": max_records, "rpc_id": rpc_id}
+
+
+def _enc_repl_tail_resp(msg, peer_wire: int = WIRE_VERSION
+                        ) -> Optional[List[bytes]]:
+    if peer_wire < 5:
+        return None
+    records = msg.get("records") or []
+    snapshot = msg.get("snapshot")
+    out = [_head(REPL_TAIL_RESP, msg.get("rpc_id")),
+           _U32.pack(int(msg.get("epoch") or 0)),
+           _U64.pack(int(msg.get("last_seq") or 0)),
+           _U8.pack(1 if msg.get("resync") else 0),
+           _U8.pack(1 if snapshot is not None else 0)]
+    if snapshot is not None:
+        out.append(_U64.pack(len(snapshot)))
+        out.append(snapshot)
+        out.append(_U64.pack(int(msg.get("snapshot_seq") or 0)))
+    out.append(_U32.pack(len(records)))
+    for rec in records:
+        out.append(_U32.pack(len(rec)))
+        out.append(rec)
+    return out
+
+
+def _dec_repl_tail_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    epoch = r.u32()
+    last_seq = r.u64()
+    resync = bool(r.u8())
+    snapshot = None
+    snapshot_seq = 0
+    if r.u8():
+        snapshot = r.b64()
+        snapshot_seq = r.u64()
+    n = r.count(r.u32())
+    records = [r.b32() for _ in range(n)]
+    r.done()
+    return {"ok": True, "epoch": epoch, "last_seq": last_seq,
+            "resync": resync, "snapshot": snapshot,
+            "snapshot_seq": snapshot_seq, "records": records,
+            "rpc_id": rpc_id}
+
+
+def _enc_ha_status(msg, peer_wire: int = WIRE_VERSION
+                   ) -> Optional[List[bytes]]:
+    if peer_wire < 5:
+        return None
+    return [_head(HA_STATUS, msg.get("rpc_id"))]
+
+
+def _dec_ha_status(r: _Reader, rpc_id) -> Dict[str, Any]:
+    r.done()
+    return {"type": "ha_status", "rpc_id": rpc_id}
+
+
+def _enc_ha_status_resp(msg, peer_wire: int = WIRE_VERSION
+                        ) -> Optional[List[bytes]]:
+    if peer_wire < 5:
+        return None
+    peers = msg.get("peers") or []
+    if len(peers) > 0xFF:
+        return None
+    out = [_head(HA_STATUS_RESP, msg.get("rpc_id")),
+           _U32.pack(int(msg.get("epoch") or 0)),
+           _U8.pack(1 if msg.get("is_leader") else 0),
+           _s(msg.get("role") or ""),
+           _U32.pack(int(msg.get("failover_count") or 0)),
+           _U64.pack(int(msg.get("standby_lag_bytes") or 0)),
+           _F64.pack(float(msg.get("time_to_recover_s") or 0.0)),
+           _U64.pack(int(msg.get("repl_seq") or 0)),
+           _U8.pack(len(peers))]
+    for p in peers:
+        out.append(_s(p))
+    return out
+
+
+def _dec_ha_status_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    epoch = r.u32()
+    is_leader = bool(r.u8())
+    role = r.s()
+    failover_count = r.u32()
+    lag = r.u64()
+    ttr = r.f64()
+    repl_seq = r.u64()
+    peers = [r.s() for _ in range(r.u8())]
+    r.done()
+    return {"ok": True, "epoch": epoch, "is_leader": is_leader,
+            "role": role, "failover_count": failover_count,
+            "standby_lag_bytes": lag, "time_to_recover_s": ttr,
+            "repl_seq": repl_seq, "peers": peers, "rpc_id": rpc_id}
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -929,6 +1083,9 @@ _ENCODERS = {
     "list_placement_groups": _enc_pg_status,
     "add_profile_stacks": _enc_profile_stacks,
     "list_tasks": _enc_list_tasks,
+    "repl_record": _enc_repl_record,
+    "repl_tail": _enc_repl_tail,
+    "ha_status": _enc_ha_status,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -940,6 +1097,8 @@ _RESP_ENCODERS = {
     "remove_placement_group": _enc_pg_ok,
     "list_placement_groups": _enc_pg_status_resp,
     "list_tasks": _enc_list_tasks_resp,
+    "repl_tail": _enc_repl_tail_resp,
+    "ha_status": _enc_ha_status_resp,
 }
 
 _DECODERS = {
@@ -964,6 +1123,11 @@ _DECODERS = {
     PROFILE_STACKS: _dec_profile_stacks,
     LIST_TASKS: _dec_list_tasks,
     LIST_TASKS_RESP: _dec_list_tasks_resp,
+    REPL_RECORD: _dec_repl_record,
+    REPL_TAIL: _dec_repl_tail,
+    REPL_TAIL_RESP: _dec_repl_tail_resp,
+    HA_STATUS: _dec_ha_status,
+    HA_STATUS_RESP: _dec_ha_status_resp,
 }
 
 

@@ -99,6 +99,14 @@ def default_slo_rules() -> List[SloRule]:
                 budget=_env_f("RAY_TPU_SLO_ERROR_BUDGET", 0.01),
                 burn_threshold=_env_f("RAY_TPU_SLO_BURN_THRESHOLD", 2.0),
                 window_s=300.0, long_window_s=1800.0, min_count=50),
+        # Head HA: a standby falling behind the leader's replication
+        # stream stretches the failover recovery window — page before it
+        # becomes a data-loss-shaped hole. Gauge is leader-side (set while
+        # serving repl_tail), so it reads 0 with no standby attached.
+        SloRule("standby_replication_lag", "ceiling",
+                "gcs_standby_lag_bytes",
+                threshold=_env_f("RAY_TPU_SLO_STANDBY_LAG_BYTES", 4_000_000.0),
+                window_s=60.0),
     ]
 
 

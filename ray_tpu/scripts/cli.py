@@ -279,6 +279,19 @@ def cmd_stop(args) -> None:
 def cmd_status(args) -> None:
     gcs = _gcs_client(args.address)
     try:
+        try:
+            ha = gcs.call({"type": "ha_status"})
+            line = (f"leadership: {ha.get('role', '?')} "
+                    f"epoch={ha.get('epoch', 0)} "
+                    f"failovers={ha.get('failover_count', 0)}")
+            if ha.get("role") == "standby":
+                line += f" lag_bytes={ha.get('standby_lag_bytes', 0)}"
+            if ha.get("failover_count"):
+                line += (f" last_recovery="
+                         f"{ha.get('time_to_recover_s', 0.0):.2f}s")
+            print(line)
+        except RuntimeError:
+            pass  # pre-HA GCS without the ha_status handler
         nodes = gcs.call({"type": "list_nodes"})["nodes"]
         res = gcs.call({"type": "cluster_resources"})
         print(f"nodes: {sum(n['Alive'] for n in nodes)} alive / {len(nodes)}")
@@ -780,6 +793,7 @@ def cmd_events(args) -> None:
             return
         cursor = resp.get("last_seq", 0)
         last_dropped = dropped
+        last_epoch = resp.get("epoch", 0)
         print("-- following (Ctrl-C to stop) --")
         while True:
             time.sleep(args.interval)
@@ -789,8 +803,31 @@ def cmd_events(args) -> None:
                 msg["kind"] = args.kind
             try:
                 resp = gcs.call(msg)
-            except (ConnectionError, OSError):
-                print("  (GCS unreachable; retrying)")
+            except (ConnectionError, OSError, RuntimeError):
+                # Re-dial rather than spin on the dead socket: the head may
+                # have restarted or failed over to the standby (a standby
+                # mid-promotion also answers NOT_LEADER, a RuntimeError).
+                print("  (GCS unreachable; re-dialing)")
+                try:
+                    gcs.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    gcs = _gcs_client(args.address)
+                except (ConnectionError, OSError):
+                    pass
+                continue
+            epoch = resp.get("epoch", last_epoch)
+            if epoch != last_epoch:
+                # The event ring is not replicated: the new leader starts a
+                # fresh ring with fresh seqs. Reset the cursor and say so —
+                # never silently splice two leaders' histories together.
+                print(f"  !! leader changed (epoch {last_epoch} -> {epoch});"
+                      f" events recorded before the failover are gone — "
+                      f"resuming from the new leader's ring")
+                last_epoch = epoch
+                cursor = 0
+                last_dropped = 0
                 continue
             oldest = resp.get("oldest_seq")
             if oldest is not None and oldest > cursor + 1:
@@ -839,6 +876,21 @@ def cmd_pgs(args) -> None:
 
 
 def cmd_kill_random_node(args) -> None:
+    if getattr(args, "head", False):
+        # The head-failover drill: SIGKILL the head process recorded by
+        # `cli start`/`cli up`. A running standby (RAY_TPU_GCS_ADDRS /
+        # --standby head) should take over within the lease TTL.
+        from ray_tpu._private import chaos
+
+        pid = _load_session().get("head_pid")
+        if not pid:
+            raise SystemExit("no head_pid in the session file — "
+                             "`kill_random_node --head` only works on a "
+                             "cluster started by `cli start`/`cli up`")
+        if not chaos.kill_process(int(pid)):
+            raise SystemExit(f"could not kill head pid={pid} (already dead?)")
+        print(f"killed head pid={pid}")
+        return
     gcs = _gcs_client(args.address)
     try:
         nodes = [n for n in gcs.call({"type": "list_nodes"})["nodes"]
@@ -1061,6 +1113,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         if name == "status":
             sp.add_argument("-v", "--verbose", action="store_true",
                             help="include per-RPC GCS handler timings")
+        if name == "kill_random_node":
+            sp.add_argument("--head", action="store_true",
+                            help="SIGKILL the head process instead (the "
+                                 "failover drill; needs a session started "
+                                 "by `cli start`/`cli up`)")
         sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("trace", help="per-task straggler report "

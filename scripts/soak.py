@@ -145,6 +145,66 @@ def node_failures(duration_s: float) -> int:
                  setup=setup, teardown=teardown)
 
 
+def head_failover(duration_s: float) -> int:
+    """Kill the GCS leader mid-workload; a warm standby must take over
+    with no lost or doubled work (ISSUE 11 — the head-HA drill). The
+    failover happens once, a few iterations in; the remaining duration
+    soaks the promoted standby as the new leader."""
+    import shutil
+    import socket
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.cluster.testing import Cluster
+
+    @ray_tpu.remote
+    def work(i):
+        return i * i
+
+    def setup():
+        # Pre-pick the standby's port so every process in the cluster can
+        # be born knowing the fallback address.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        sport = s.getsockname()[1]
+        s.close()
+        tmp = tempfile.mkdtemp(prefix="soak_ha_")
+        persist = os.path.join(tmp, "gcs_state.bin")
+        os.environ["RAY_TPU_GCS_ADDRS"] = f"127.0.0.1:{sport}"
+        os.environ.setdefault("RAY_TPU_GCS_LEASE_TTL_S", "1.5")
+        from ray_tpu._private.config import reset_config
+
+        reset_config()  # this driver must also learn the fallback address
+        c = Cluster(head_resources={"CPU": 2}, num_workers=1,
+                    persist_path=persist, head_with_node=False)
+        c.add_node(resources={"CPU": 2}, num_workers=2)
+        c.start_standby(port=sport)
+        ray_tpu.init(address=c.address, ignore_reinit_error=True)
+        return {"cluster": c, "sport": sport, "tmp": tmp, "failed_over": False}
+
+    def body(state, i):
+        out = ray_tpu.get([work.remote(j) for j in range(50)], timeout=120)
+        assert out == [j * j for j in range(50)]
+        if i == 2 and not state["failed_over"]:
+            c = state["cluster"]
+            c.kill_head()
+            c.wait_for_leader(state["sport"], timeout=30)
+            state["failed_over"] = True
+
+    def teardown(state):
+        ray_tpu.shutdown()
+        state["cluster"].shutdown()
+        shutil.rmtree(state["tmp"], ignore_errors=True)
+        os.environ.pop("RAY_TPU_GCS_ADDRS", None)
+        from ray_tpu._private.config import reset_config
+
+        reset_config()
+
+    iters = _loop("head_failover", duration_s, body,
+                  setup=setup, teardown=teardown)
+    return iters
+
+
 _DRIVER_SCRIPT = """
 import sys
 import ray_tpu
@@ -328,13 +388,14 @@ WORKLOADS = {
     "many_drivers": many_drivers,
     "actor_deaths": actor_deaths,
     "node_failures": node_failures,
+    "head_failover": head_failover,
     "serve_failure": serve_failure,
     "lm_serve": lm_serve,
     "pbt": pbt,
 }
 # Workloads that own their cluster; a leftover local-mode runtime would
 # make their cluster connect a silent no-op.
-_STANDALONE = {"node_failures", "many_drivers"}
+_STANDALONE = {"node_failures", "head_failover", "many_drivers"}
 
 
 def main(argv=None):

@@ -308,17 +308,23 @@ def test_flight_recorder_timeseries_cluster_pipeline(tmp_path):
             assert named / total >= 0.8, (comp, info["stacks"])
 
         # Time-series rollups: task throughput + phase series present.
-        deadline = time.time() + 20
+        # Poll until the rollup has folded the WHOLE run — the series is
+        # born mid-run by the 2 s stats ticks, so its first appearance can
+        # still be a partial count on a loaded box.
+        def _done_count(ts):
+            pts = ts.get("series", {}).get("tasks_finished", {})
+            return sum(c["sum"] for _, c in pts.get("points", ()))
+
+        deadline = time.time() + 30
         ts = {}
         while time.time() < deadline:
             ts = core.cluster_timeseries(last=60)
-            if "tasks_finished" in ts.get("series", {}):
+            if _done_count(ts) >= 300:
                 break
             time.sleep(0.5)
         series = ts["series"]
         assert "tasks_finished" in series, sorted(series)
-        done = sum(c["sum"] for _, c in
-                   series["tasks_finished"]["points"])
+        done = _done_count(ts)
         assert done >= 300, series["tasks_finished"]
         assert any(n.startswith("phase_seconds:") for n in series)
         assert ts["bucket_s"] == 10.0

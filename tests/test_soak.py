@@ -28,3 +28,10 @@ def test_soak_node_failures():
 def test_soak_many_drivers():
     # Manages its own Cluster; drivers are subprocesses.
     assert soak.many_drivers(10.0) >= 3
+
+
+@pytest.mark.cluster
+@pytest.mark.slow
+def test_soak_head_failover():
+    # Manages its own Cluster + warm standby; kills the leader mid-run.
+    assert soak.head_failover(25.0) >= 4

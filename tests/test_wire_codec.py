@@ -561,6 +561,37 @@ class TestListTasksCodec:
                 wire.decode(body[:cut])
 
 
+class TestHaCodec:
+    """Head-HA frames (wire v5)."""
+
+    def test_repl_record_round_trip(self):
+        msg = {"type": "repl_record", "epoch": 7, "seq": 123456789,
+               "body": b"\x00\xff" * 64, "rpc_id": 9}
+        assert _rt(msg) == msg
+
+    def test_repl_tail_resp_with_snapshot_resync(self):
+        msg = {"ok": True, "epoch": 3, "last_seq": 42, "resync": True,
+               "snapshot": b"pickled-state" * 10, "snapshot_seq": 40,
+               "records": [], "rpc_id": 5}
+        assert _rt(msg, req_type="repl_tail") == msg
+
+    def test_pre_v5_peer_gets_pickle_fallback(self):
+        assert wire.encode({"type": "repl_tail", "after_seq": 0},
+                           peer_wire=4) is None
+        assert wire.encode({"type": "ha_status"}, peer_wire=4) is None
+        assert wire.encode_response(
+            "ha_status", {"ok": True, "epoch": 1, "is_leader": True,
+                          "role": "leader"}, peer_wire=4) is None
+
+    def test_truncated_ha_frames_raise(self):
+        body = b"".join(wire.encode(
+            {"type": "repl_record", "epoch": 1, "seq": 2,
+             "body": b"abcdef"}))
+        for cut in (5, len(body) - 1):
+            with pytest.raises(wire.WireError):
+                wire.decode(body[:cut])
+
+
 def _coverage_spec_blob():
     return wire.encode_task_spec({
         "task_id": b"T" * 16, "fn_id": b"F" * 16, "name": "f",
@@ -629,6 +660,22 @@ _FRAME_CASES = {
         "type": "list_tasks", "state": "PENDING", "limit": 10}),
     wire.LIST_TASKS_RESP: (("resp", "list_tasks"), lambda: {
         "ok": True, "total": 0, "truncated": False, "tasks": []}),
+    wire.REPL_RECORD: ("req", lambda: {
+        "type": "repl_record", "epoch": 3, "seq": 9,
+        "body": b"opaque-frame-bytes", "rpc_id": 1}),
+    wire.REPL_TAIL: ("req", lambda: {
+        "type": "repl_tail", "after_seq": 5, "max_records": 256,
+        "rpc_id": 2}),
+    wire.REPL_TAIL_RESP: (("resp", "repl_tail"), lambda: {
+        "ok": True, "epoch": 2, "last_seq": 9, "resync": False,
+        "snapshot": None, "snapshot_seq": 0,
+        "records": [b"rec-a", b"rec-b"], "rpc_id": 2}),
+    wire.HA_STATUS: ("req", lambda: {"type": "ha_status", "rpc_id": 3}),
+    wire.HA_STATUS_RESP: (("resp", "ha_status"), lambda: {
+        "ok": True, "epoch": 4, "is_leader": True, "role": "leader",
+        "failover_count": 1, "standby_lag_bytes": 128,
+        "time_to_recover_s": 1.25, "repl_seq": 77,
+        "peers": ["127.0.0.1:7001"], "rpc_id": 3}),
 }
 
 
