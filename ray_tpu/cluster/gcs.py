@@ -2975,6 +2975,9 @@ class GcsServer:
                 for k, (c, t) in sorted(
                     s.handler_stats.items(),
                     key=lambda kv: -kv[1][1])},
+                # Frame-pump attribution: frames/reads >> 1 is the
+                # batched-recv win; native says which splitter ran.
+                "recv_stats": dict(s.recv_stats),
                 "place_perf": self.place_perf_snapshot()}
 
         @s.handler("record_direct_task")

@@ -13,15 +13,43 @@ from __future__ import annotations
 import argparse
 import os
 import pickle
-import queue
 import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 ERR_PREFIX = b"E"
 VAL_PREFIX = b"V"
+
+
+class _Inbox:
+    """Task inbox fed by the reader thread's frame batches: ``put_many``
+    enqueues a whole batch under ONE lock acquisition + ONE wakeup where
+    ``queue.Queue`` pays a mutex round-trip per item. Single consumer
+    (serve_loop), single producer (the RpcClient reader thread)."""
+
+    def __init__(self):
+        self._d: deque = deque()
+        self._cv = threading.Condition()
+
+    def put(self, item: Dict) -> None:
+        with self._cv:
+            self._d.append(item)
+            self._cv.notify()
+
+    def put_many(self, items: List[Dict]) -> None:
+        with self._cv:
+            self._d.extend(items)
+            self._cv.notify()
+
+    # raylint: hotpath — serve_loop blocks here between tasks
+    def get(self) -> Dict:
+        with self._cv:
+            while not self._d:
+                self._cv.wait()
+            return self._d.popleft()
 
 
 def main():
@@ -44,7 +72,7 @@ def main():
     from ray_tpu.cluster.protocol import RpcClient
     from ray_tpu.exceptions import TaskError
 
-    inbox: "queue.Queue[Dict]" = queue.Queue()
+    inbox = _Inbox()
     # Revocation bookkeeping for pipelined executes (the controller may
     # pre-push a second task into this inbox; if the current task blocks,
     # the controller revokes the queued one and re-dispatches it
@@ -85,10 +113,34 @@ def main():
                 inbox_ids.add(msg["task_id"])
         inbox.put(msg)
 
+    # raylint: hotpath — every pushed task enters the worker through here
+    def on_push_batch(msgs: List[Dict]) -> None:
+        """Batched inbox feed (native frame pump): one recv wakeup's worth
+        of pushes lands in the inbox via ONE put_many. Control messages
+        (trace sampling, revokes) keep their per-message handling and
+        their order relative to surrounding executes — earlier executes
+        are flushed first, so a revoke still sees its target queued."""
+        pend: List[Dict] = []
+        for msg in msgs:
+            mtype = msg.get("type")
+            if mtype == "set_trace_sample" or mtype == "revoke_execute":
+                if pend:
+                    inbox.put_many(pend)
+                    pend = []
+                on_push(msg)
+                continue
+            if mtype == "execute_task" and msg.get("task_id") is not None:
+                with revoke_lock:
+                    inbox_ids.add(msg["task_id"])
+            pend.append(msg)
+        if pend:
+            inbox.put_many(pend)
+
     # A dead controller connection must terminate the worker (otherwise a
     # SIGKILL'd controller leaves its workers orphaned on inbox.get forever).
     controller = RpcClient(
         chost, int(cport), push_handler=on_push,
+        push_batch_handler=on_push_batch,
         on_close=lambda: inbox.put({"type": "shutdown"}),
     )
 

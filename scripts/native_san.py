@@ -38,8 +38,9 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NATIVE_LIBS = ("shm_store", "channel", "transfer")
-STRESS_SOURCES = ("stress_shm.cc", "stress_channel.cc")
+NATIVE_LIBS = ("shm_store", "channel", "transfer", "framepump")
+STRESS_SOURCES = ("stress_shm.cc", "stress_channel.cc",
+                  "stress_framepump.cc")
 
 _SAN_FLAGS = {
     "asan": ["-fsanitize=address,undefined"],

@@ -1,0 +1,298 @@
+// ASAN/UBSAN + TSAN stress for the native frame pump (framepump.cc):
+// torn-write churn through the fd-mode pump (writer thread vs pumping
+// reader — the TSAN-visible pairing RpcClient uses), feed-mode splitting
+// at adversarial chunk boundaries, oversize-frame rejection and
+// post-error latching, fp_take partial-drain + compaction cycling, and
+// sendv continuation past the iovec cap over a socketpair.
+//
+// Built and run by scripts/native_san.py under both sanitizers.
+
+#include "../../ray_tpu/_native/src/framepump.cc"
+
+#include <sys/socket.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <thread>
+
+namespace {
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+uint8_t body_byte(uint32_t frame, size_t pos) {
+  return static_cast<uint8_t>(frame * 131u + pos * 31u + 7u);
+}
+
+std::string make_stream(uint32_t n_frames, std::vector<size_t>& lens) {
+  std::string s;
+  for (uint32_t i = 0; i < n_frames; ++i) {
+    size_t len = (i * 977u) % 5000u;  // includes 0-length bodies
+    lens.push_back(len);
+    uint64_t le = len;
+    s.append(reinterpret_cast<const char*>(&le), 8);
+    for (size_t p = 0; p < len; ++p)
+      s.push_back(static_cast<char>(body_byte(i, p)));
+  }
+  return s;
+}
+
+// Drain every buffered frame, verifying bodies against the generator.
+// max_frames per take cycles the partial-drain + compact path.
+void drain_and_check(void* h, uint32_t& next_frame,
+                     const std::vector<size_t>& lens, uint64_t max_take) {
+  while (fp_pending_frames(h) > 0) {
+    uint64_t navail = fp_pending_frames(h);
+    uint64_t n = navail < max_take ? navail : max_take;
+    std::vector<uint8_t> dst(fp_pending_bytes(h) + 1);
+    std::vector<uint64_t> sizes(n);
+    int64_t took = fp_take(h, dst.data(), dst.size(), sizes.data(), n);
+    CHECK(took > 0 && static_cast<uint64_t>(took) <= n);
+    size_t off = 0;
+    for (int64_t i = 0; i < took; ++i) {
+      CHECK(next_frame < lens.size());
+      CHECK(sizes[i] == lens[next_frame]);
+      for (size_t p = 0; p < sizes[i]; ++p)
+        CHECK(dst[off + p] == body_byte(next_frame, p));
+      off += sizes[i];
+      ++next_frame;
+    }
+  }
+}
+
+// ---- 1. fd-mode pump vs torn writer thread ------------------------------
+void fd_churn() {
+  constexpr uint32_t kFrames = 4000;
+  std::vector<size_t> lens;
+  std::string stream = make_stream(kFrames, lens);
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+
+  std::thread writer([&stream, &sv] {
+    size_t i = 0;
+    uint32_t step_seed = 1;
+    while (i < stream.size()) {
+      size_t step = 1 + (step_seed * 2654435761u) % 4096u;
+      if (step > stream.size() - i) step = stream.size() - i;
+      ssize_t n = send(sv[0], stream.data() + i, step, 0);
+      CHECK(n > 0);
+      i += static_cast<size_t>(n);
+      ++step_seed;
+    }
+    CHECK(close(sv[0]) == 0);
+  });
+
+  void* h = fp_create(sv[1], 1 << 20);
+  CHECK(h != nullptr);
+  uint32_t next = 0;
+  for (;;) {
+    int64_t n = fp_pump(h);
+    if (n < 0) break;  // writer hung up after the full stream
+    CHECK(n > 0);
+    drain_and_check(h, next, lens, 7);  // partial takes: compact churns
+  }
+  CHECK(next == kFrames);
+  writer.join();
+  fp_destroy(h);
+  CHECK(close(sv[1]) == 0);
+}
+
+// ---- 2. feed mode at adversarial chunk boundaries -----------------------
+void feed_boundaries() {
+  constexpr uint32_t kFrames = 600;
+  std::vector<size_t> lens;
+  std::string stream = make_stream(kFrames, lens);
+  // 1-byte feeds: every length prefix and body straddles a chunk edge.
+  void* h = fp_create(-1, 1 << 20);
+  CHECK(h != nullptr);
+  uint32_t next = 0;
+  for (size_t i = 0; i < stream.size(); ++i) {
+    int64_t n = fp_feed(
+        h, reinterpret_cast<const uint8_t*>(stream.data()) + i, 1);
+    CHECK(n >= 0);
+    if (n >= 16) drain_and_check(h, next, lens, 1000);
+  }
+  drain_and_check(h, next, lens, 1000);
+  CHECK(next == kFrames);
+  CHECK(fp_pending_bytes(h) == 0);
+  fp_destroy(h);
+}
+
+// ---- 3. oversize rejection latches --------------------------------------
+void oversize_latch() {
+  void* h = fp_create(-1, 64);
+  CHECK(h != nullptr);
+  uint8_t good[8 + 5] = {5, 0, 0, 0, 0, 0, 0, 0, 'h', 'e', 'l', 'l', 'o'};
+  CHECK(fp_feed(h, good, sizeof(good)) == 1);
+  uint64_t sz = 0;
+  uint8_t dst[8];
+  CHECK(fp_take(h, dst, sizeof(dst), &sz, 1) == 1 && sz == 5);
+  uint8_t evil[8] = {65, 0, 0, 0, 0, 0, 0, 0};  // 65 > max_message=64
+  CHECK(fp_feed(h, evil, sizeof(evil)) == -2);
+  CHECK(fp_feed(h, good, sizeof(good)) == -2);  // error latched
+  CHECK(fp_pump(h) == -2);
+  fp_destroy(h);
+}
+
+// ---- 4. sendv continuation past the iovec cap ---------------------------
+void sendv_continuation() {
+  constexpr uint64_t kBufs = 1400;  // > kIovCap=512: multiple sendmsg calls
+  std::vector<std::string> storage;
+  std::vector<const uint8_t*> ptrs;
+  std::vector<uint64_t> lens;
+  std::string want;
+  for (uint64_t i = 0; i < kBufs; ++i) {
+    std::string b;
+    size_t len = 1 + (i * 37) % 300;
+    for (size_t p = 0; p < len; ++p)
+      b.push_back(static_cast<char>(body_byte(i, p)));
+    want += b;
+    storage.push_back(std::move(b));
+  }
+  for (auto& s : storage) {
+    ptrs.push_back(reinterpret_cast<const uint8_t*>(s.data()));
+    lens.push_back(s.size());
+  }
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  std::string got;
+  std::thread reader([&got, &sv] {
+    char buf[65536];
+    for (;;) {
+      ssize_t n = recv(sv[1], buf, sizeof(buf), 0);
+      CHECK(n >= 0);
+      if (n == 0) break;
+      got.append(buf, static_cast<size_t>(n));
+    }
+  });
+  CHECK(fp_sendv(sv[0], ptrs.data(), lens.data(), kBufs) == 0);
+  CHECK(close(sv[0]) == 0);
+  reader.join();
+  CHECK(got == want);
+  CHECK(close(sv[1]) == 0);
+}
+
+// ---- 5. one-call batched takes (fp_pump_take / fp_feed_take) ------------
+// The production entry points: torn writer vs blocking batched pump, and
+// chunked feeds through the combined append+split+copy call, including
+// the -3 too-small-dst contract (nothing consumed on pump, ring drained
+// via fp_take on feed) and the sizes[taken] leftover report.
+void take_batch_paths() {
+  constexpr uint32_t kFrames = 3000;
+  std::vector<size_t> lens;
+  std::string stream = make_stream(kFrames, lens);
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+
+  std::thread writer([&stream, &sv] {
+    size_t i = 0;
+    uint32_t step_seed = 3;
+    while (i < stream.size()) {
+      size_t step = 1 + (step_seed * 2654435761u) % 8192u;
+      if (step > stream.size() - i) step = stream.size() - i;
+      ssize_t n = send(sv[0], stream.data() + i, step, 0);
+      CHECK(n > 0);
+      i += static_cast<size_t>(n);
+      ++step_seed;
+    }
+    CHECK(close(sv[0]) == 0);
+  });
+
+  void* h = fp_create(sv[1], 1 << 20);
+  CHECK(h != nullptr);
+  uint32_t next = 0;
+  // Deliberately small dst (one mid-size frame) so -3 grow-and-drain and
+  // the leftover count in sizes[taken] both trigger under churn.
+  std::vector<uint8_t> dst(2048);
+  uint64_t sizes[9];  // max_frames=8, +1 leftover slot
+  for (;;) {
+    int64_t n = fp_pump_take(h, dst.data(), dst.size(), sizes, 8);
+    if (n == -1) break;  // writer hung up after the full stream
+    if (n == -3) {  // first frame larger than dst: nothing consumed
+      CHECK(fp_pending_frames(h) > 0);
+      drain_and_check(h, next, lens, 8);
+      continue;
+    }
+    CHECK(n > 0 && n <= 8);
+    size_t off = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      CHECK(sizes[i] == lens[next]);
+      for (size_t p = 0; p < sizes[i]; ++p)
+        CHECK(dst[off + p] == body_byte(next, p));
+      off += sizes[i];
+      ++next;
+    }
+    CHECK(sizes[n] == fp_pending_frames(h));
+    if (sizes[n] > 0) drain_and_check(h, next, lens, 8);
+  }
+  CHECK(next == kFrames);
+  writer.join();
+  fp_destroy(h);
+  CHECK(close(sv[1]) == 0);
+
+  // Feed-mode twin: chunked feeds, every frame back through the one-call
+  // path; a too-small dst (-3) leaves the consumed bytes in the ring for
+  // an fp_take drain (the wrapper's grow path), never a refeed.
+  void* f = fp_create(-1, 1 << 20);
+  CHECK(f != nullptr);
+  next = 0;
+  size_t i = 0;
+  uint32_t step_seed = 11;
+  while (i < stream.size()) {
+    size_t step = 1 + (step_seed * 2654435761u) % 6000u;
+    if (step > stream.size() - i) step = stream.size() - i;
+    int64_t n = fp_feed_take(
+        f, reinterpret_cast<const uint8_t*>(stream.data()) + i, step,
+        dst.data(), dst.size(), sizes, 8);
+    i += step;
+    ++step_seed;
+    if (n == -3) {
+      drain_and_check(f, next, lens, 8);
+      continue;
+    }
+    CHECK(n >= 0 && n <= 8);
+    size_t off = 0;
+    for (int64_t k = 0; k < n; ++k) {
+      CHECK(sizes[k] == lens[next]);
+      for (size_t p = 0; p < sizes[k]; ++p)
+        CHECK(dst[off + p] == body_byte(next, p));
+      off += sizes[k];
+      ++next;
+    }
+    CHECK(sizes[n] == fp_pending_frames(f));
+    if (sizes[n] > 0) drain_and_check(f, next, lens, 8);
+  }
+  drain_and_check(f, next, lens, 8);
+  CHECK(next == kFrames);
+  CHECK(fp_pending_bytes(f) == 0);
+  // Oversize latches through the one-call paths too.
+  uint8_t evil[8] = {0xff, 0xff, 0xff, 0xff, 0, 0, 0, 0};
+  CHECK(fp_feed_take(f, evil, sizeof(evil), dst.data(), dst.size(),
+                     sizes, 8) == -2);
+  CHECK(fp_pump_take(f, dst.data(), dst.size(), sizes, 8) == -2);
+  fp_destroy(f);
+}
+
+}  // namespace
+
+int main() {
+  fd_churn();
+  std::printf("fd churn OK\n");
+  feed_boundaries();
+  std::printf("feed boundaries OK\n");
+  oversize_latch();
+  std::printf("oversize latch OK\n");
+  sendv_continuation();
+  std::printf("sendv continuation OK\n");
+  take_batch_paths();
+  std::printf("take batch paths OK\n");
+  std::printf("ALL OK\n");
+  return 0;
+}

@@ -60,6 +60,8 @@ def test_sanitized_library_builds_are_cached_separately():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     out = proc.stdout
     assert "build libshm_store.so: OK" in out
+    assert "build libframepump.so: OK" in out, \
+        "framepump missing from the sanitizer sweep"
     for line in out.splitlines():
         if "-> " in line and "build lib" in line:
             path = line.split("-> ", 1)[1].strip()

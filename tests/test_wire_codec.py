@@ -12,6 +12,7 @@ import random
 import socket
 import struct
 import threading
+import time
 
 import pytest
 
@@ -711,3 +712,235 @@ class TestWireFrameCoverage:
             f"case for 0x{code:02x} encoded as 0x{body[1]:02x}")
         decoded = wire.decode(body)
         assert isinstance(decoded, dict) and decoded
+
+
+# ---------------------------------------------------------------------------
+# Native frame pump (framepump.cc) vs pure-Python framer equivalence
+# ---------------------------------------------------------------------------
+
+from ray_tpu._native import framepump  # noqa: E402
+
+
+def _frames_blob(rng, n_frames, max_body=4096):
+    """n random frames as (bodies, wire_bytes)."""
+    bodies = [bytes(rng.getrandbits(8)
+                    for _ in range(rng.randint(0, max_body)))
+              for _ in range(n_frames)]
+    blob = b"".join(_LEN.pack(len(b)) + b for b in bodies)
+    return bodies, blob
+
+
+def _tear(rng, blob):
+    """Random split of blob into chunks (torn writes), including empty
+    and 1-byte cuts straddling length prefixes."""
+    chunks = []
+    i = 0
+    while i < len(blob):
+        step = rng.choice([1, 2, 3, 7, 8, 9, rng.randint(1, 700)])
+        chunks.append(blob[i:i + step])
+        i += step
+    return chunks
+
+
+def _run_framer(framer, chunks):
+    out = []
+    for c in chunks:
+        out.extend(framer.feed(c))
+    return out
+
+
+class TestFramerEquivalence:
+    """The native splitter and its Python twin must agree byte-for-byte:
+    identical frame streams out of identical inputs under arbitrary
+    tearing, identical silence on truncation, identical rejection of
+    oversize frames. This is the contract the kill switch rides — the
+    two arms may differ in speed, never in behavior."""
+
+    def test_python_framer_random_sequences(self):
+        rng = random.Random(12)
+        for trial in range(30):
+            bodies, blob = _frames_blob(rng, rng.randint(0, 12))
+            framer = framepump.PyFeedFramer(MAX_MESSAGE)
+            assert _run_framer(framer, _tear(rng, blob)) == bodies
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_native_matches_python_random_sequences(self):
+        rng = random.Random(34)
+        for trial in range(30):
+            bodies, blob = _frames_blob(rng, rng.randint(0, 12))
+            # Different tearing per arm on the SAME stream: chunking must
+            # never leak into the frame stream.
+            nat = framepump.NativeFeedFramer(MAX_MESSAGE)
+            py = framepump.PyFeedFramer(MAX_MESSAGE)
+            try:
+                got_nat = _run_framer(nat, _tear(rng, blob))
+                got_py = _run_framer(py, _tear(rng, blob))
+            finally:
+                nat.close()
+            assert got_nat == bodies
+            assert got_py == bodies
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_truncation_yields_no_partial_frame(self):
+        rng = random.Random(56)
+        bodies, blob = _frames_blob(rng, 5)
+        for cut in (1, 7, 8, 9, len(blob) - 1):
+            nat = framepump.NativeFeedFramer(MAX_MESSAGE)
+            py = framepump.PyFeedFramer(MAX_MESSAGE)
+            try:
+                got_nat = _run_framer(nat, _tear(rng, blob[:cut]))
+                got_py = _run_framer(py, _tear(rng, blob[:cut]))
+            finally:
+                nat.close()
+            # Identical PREFIX of complete frames; the torn tail never
+            # surfaces from either arm.
+            assert got_nat == got_py
+            assert all(b in bodies for b in got_nat)
+            assert len(got_nat) < len(bodies)
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_oversize_frame_identical_rejection(self):
+        limit = 1 << 16
+        good = _LEN.pack(5) + b"hello"
+        evil = _LEN.pack(limit + 1) + b"x" * 32
+        for prefix in (b"", good):
+            nat = framepump.NativeFeedFramer(limit)
+            py = framepump.PyFeedFramer(limit)
+            try:
+                if prefix:
+                    assert nat.feed(prefix) == py.feed(prefix) == [b"hello"]
+                with pytest.raises(framepump.FrameError):
+                    nat.feed(evil)
+                with pytest.raises(framepump.FrameError):
+                    py.feed(evil)
+            finally:
+                nat.close()
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_fd_pump_batches_match_stream(self):
+        """fd mode: torn writes from a peer thread; the pump's batched
+        wakeups must reassemble exactly the sent frame stream."""
+        rng = random.Random(78)
+        bodies, blob = _frames_blob(rng, 40, max_body=2000)
+        a, b = socket.socketpair()
+        try:
+            pump = framepump.NativeReaderPump(b.fileno(), MAX_MESSAGE)
+
+            def writer():
+                for chunk in _tear(rng, blob):
+                    a.sendall(chunk)
+                a.close()
+
+            t = threading.Thread(target=writer)
+            t.start()
+            got = []
+            while True:
+                batch = pump.pump()
+                if batch is None:
+                    break
+                got.extend(batch)
+            t.join()
+            pump.close()
+            assert got == bodies
+        finally:
+            b.close()
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_sendv_full_stream_delivery(self, monkeypatch):
+        """Scatter-gather sendv: many buffers (over the iovec cap, so the
+        continuation path runs) arrive byte-identical and in order. Pins
+        the gates on so the native path is exercised even when the suite
+        runs under the kill switch (the =0 A/B arm)."""
+        monkeypatch.delenv("RAY_TPU_NATIVE_FRAMEPUMP", raising=False)
+        monkeypatch.delenv("RAY_TPU_NATIVE_FRAMEPUMP_SITES", raising=False)
+        rng = random.Random(90)
+        bufs = [bytes(rng.getrandbits(8) for _ in range(rng.randint(1, 64)))
+                for _ in range(1300)]  # > kIovCap=512: continuation engages
+        want = b"".join(bufs)
+        a, b = socket.socketpair()
+        try:
+            got = bytearray()
+
+            def reader():
+                while True:
+                    c = b.recv(65536)
+                    if not c:
+                        break
+                    got.extend(c)
+
+            t = threading.Thread(target=reader)
+            t.start()
+            assert framepump.sendv(a.fileno(), bufs) is True
+            a.close()
+            t.join()
+            assert bytes(got) == want
+        finally:
+            b.close()
+
+    @pytest.mark.skipif(not framepump.native_available(),
+                        reason="native framepump not built")
+    def test_sendv_declines_small_lists(self, monkeypatch):
+        """Below the crossover threshold sendv returns False so callers
+        keep CPython's sendmsg, which is faster for short iovec lists."""
+        monkeypatch.delenv("RAY_TPU_NATIVE_FRAMEPUMP", raising=False)
+        monkeypatch.delenv("RAY_TPU_NATIVE_FRAMEPUMP_SITES", raising=False)
+        a, b = socket.socketpair()
+        try:
+            assert framepump.sendv(a.fileno(), [b"x"] * 4) is False
+        finally:
+            a.close()
+            b.close()
+
+
+class TestLateResponseDrop:
+    """A response landing after its call() timed out must be dropped and
+    counted — never handed to the push handler as if the server pushed
+    it, and never left rotting in _responses."""
+
+    @pytest.mark.parametrize("pump_env", ["0", "1"])
+    def test_late_response_dropped_and_counted(self, pump_env, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_NATIVE_FRAMEPUMP", pump_env)
+
+        async def scenario():
+            srv = RpcServer("127.0.0.1", 0)
+
+            @srv.handler("slow")
+            async def slow(msg, conn):
+                await asyncio.sleep(0.4)
+                return {"ok": True, "v": 1}
+
+            @srv.handler("fast")
+            async def fast(msg, conn):
+                return {"ok": True, "v": 2}
+
+            await srv.start()
+
+            def client_side():
+                pushes = []
+                c = RpcClient("127.0.0.1", srv.port,
+                              push_handler=pushes.append)
+                with pytest.raises(TimeoutError):
+                    c.call({"type": "slow"}, timeout=0.05)
+                # The late response arrives ~0.35 s from now; meanwhile
+                # the connection keeps working.
+                assert c.call({"type": "fast"}, timeout=5)["v"] == 2
+                deadline = time.monotonic() + 5
+                while (c.io_stats["late_drops"] == 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert c.io_stats["late_drops"] == 1
+                assert pushes == [], \
+                    "late response leaked to the push handler"
+                assert not c._responses, "late response left in _responses"
+                c.close()
+
+            await asyncio.get_event_loop().run_in_executor(
+                None, client_side)
+            await srv.stop()
+
+        asyncio.run(scenario())
