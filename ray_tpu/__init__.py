@@ -36,6 +36,8 @@ from .exceptions import (  # noqa: F401
     RayTpuError,
     TaskCancelledError,
     TaskError,
+    TaskPoisonedError,
+    TaskTimeoutError,
     WorkerCrashedError,
 )
 from .object_ref import ObjectRef  # noqa: F401
@@ -92,5 +94,7 @@ __all__ = [
     "PlacementGroupError",
     "GetTimeoutError",
     "TaskCancelledError",
+    "TaskTimeoutError",
+    "TaskPoisonedError",
     "WorkerCrashedError",
 ]

@@ -26,8 +26,19 @@ Env knobs (all off by default; read once at :func:`install_from_env`):
     In a head process: SIGSTOP after N seconds, SIGCONT after a further M
     seconds (default 10) — the deposed-leader/split-brain drill: the head
     wakes up believing it still leads and must find its lease stolen.
+``RAY_TPU_CHAOS_KILL_WORKER_EVERY_S``
+    In a controller process: SIGKILL one random live worker process every
+    N seconds (armed controller-side in ``Controller.start``) — the
+    blast-radius drill: blame attribution, collateral re-drive, and the
+    poison-quarantine counters all run under it.
 ``RAY_TPU_CHAOS_SEED``
     Deterministic RNG seed for the drop/delay draws.
+
+Hostile-task helpers (:func:`hostile_hang`, :func:`hostile_segfault`,
+:func:`hostile_oom`) are plain functions meant to be submitted as remote
+tasks by chaos workloads (``scripts/soak.py hostile_workload``, the
+containment test suite): a hanger for the deadline killer, a
+crash-looper for quarantine, an allocator bomb for the OOM guard.
 """
 
 from __future__ import annotations
@@ -114,6 +125,53 @@ def install_from_env() -> Optional[Chaos]:
 def uninstall() -> None:
     global _active
     _active = None
+
+
+# ------------------------------------------------------------ hostile tasks
+# Helpers submitted AS tasks by chaos workloads. Top-level functions so
+# they pickle by reference; each models one blast-radius failure mode.
+
+def hostile_hang(seconds: float = 3600.0) -> str:
+    """Run (far) past any sane deadline — the deadline killer's prey.
+    Returns only if nothing killed it (a containment failure)."""
+    import time as _time
+
+    _time.sleep(seconds)
+    return "hung task survived"
+
+
+def hostile_segfault() -> None:
+    """Die with SIGSEGV, taking the worker process with it — the
+    poison-quarantine counter's prey (3 strikes by default)."""
+    os.kill(os.getpid(), signal.SIGSEGV)
+
+
+def hostile_exit(code: int = 13) -> None:
+    """Hard-exit the worker without a signal (os._exit skips every
+    finally/atexit) — the exit-code blame-classification case."""
+    os._exit(code)
+
+
+def hostile_oom(target_bytes: int = 1 << 30,
+                step_bytes: int = 32 << 20,
+                hold_s: float = 60.0) -> str:
+    """Allocate RSS in steps up to ``target_bytes`` and sit on it — the
+    OOM guard's prey: declare a small ``memory`` resource and grow well
+    past it. Real pages (bytearrays are touched), so the RSS sampler
+    sees the growth."""
+    import time as _time
+
+    hoard = []
+    held = 0
+    while held < target_bytes:
+        block = bytearray(min(step_bytes, target_bytes - held))
+        for i in range(0, len(block), 4096):
+            block[i] = 1  # touch every page: reserved != resident
+        hoard.append(block)
+        held += len(block)
+        _time.sleep(0.01)
+    _time.sleep(hold_s)
+    return f"oom bomb survived holding {held} bytes"
 
 
 # ---------------------------------------------------------------- process

@@ -692,6 +692,26 @@ class LocalRuntime:
     def _execute_callable(self, spec: TaskSpec, call: Callable,
                           pending: Optional[PendingTask] = None):
         t0 = time.monotonic()
+        timer = None
+        if getattr(spec, "timeout_s", None):
+            # Local-mode deadline parity: threads can't be killed, so the
+            # watchdog resolves the refs to TaskTimeoutError at expiry and
+            # the store's first-write-wins makes the late result a no-op.
+            # (The cluster backend actually kills the worker process.)
+            from ..exceptions import TaskTimeoutError
+
+            def _expire():
+                self._store_error(spec, TaskTimeoutError(
+                    task_id=spec.task_id.hex()[:16],
+                    timeout_s=spec.timeout_s))
+                self.events.record(
+                    "task_deadline", spec.function.repr_name,
+                    time.monotonic(), time.monotonic(),
+                    task_id=spec.task_id.hex())
+
+            timer = threading.Timer(float(spec.timeout_s), _expire)
+            timer.daemon = True
+            timer.start()
         try:
             args, kwargs = self._resolve_args_from_spec(spec)
             result = call(args, kwargs)
@@ -719,6 +739,8 @@ class LocalRuntime:
             self._store_error(spec, err)
             self._unpin_args(spec.dependencies())
         finally:
+            if timer is not None:
+                timer.cancel()
             now = time.monotonic()
             self.events.record(
                 "task", spec.function.repr_name, t0, now,

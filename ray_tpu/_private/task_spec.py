@@ -94,6 +94,11 @@ class TaskSpec:
     # carried entirely by the translated group-scoped resource names).
     placement_group_id: Optional[bytes] = None
     placement_group_bundle_index: int = -1
+    # Deadline: the controller kills the task (SIGTERM -> SIGKILL) once it
+    # has executed for timeout_s and fails it with TaskTimeoutError. Deadline
+    # kills don't consume max_retries unless retry_on_timeout opts in.
+    timeout_s: Optional[float] = None
+    retry_on_timeout: bool = False
     metadata: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):

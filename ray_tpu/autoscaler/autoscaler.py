@@ -36,13 +36,21 @@ DEFAULT_CONFIG = {
 
 class StandardAutoscaler:
     def __init__(self, provider: NodeProvider, load_metrics: LoadMetrics,
-                 config: Optional[Dict[str, Any]] = None):
+                 config: Optional[Dict[str, Any]] = None,
+                 drain_fn=None):
         self.provider = provider
         self.load_metrics = load_metrics
         self.config = {**DEFAULT_CONFIG, **(config or {})}
         self.last_idle_since: Dict[str, float] = {}
         self.num_launches = 0
         self.num_terminations = 0
+        # Graceful scale-down hook: drain_fn(node_id) asks the control
+        # plane to drain the node (no new placements, running tasks
+        # finish, sole-copy objects re-home) and returns True once it has
+        # fully retired. Termination is deferred across update() ticks
+        # until then, so a planned scale-down never kills running tasks.
+        self.drain_fn = drain_fn
+        self.pending_drains: Dict[str, float] = {}
 
     def workers(self) -> List[str]:
         return self.provider.non_terminated_nodes(
@@ -106,6 +114,20 @@ class StandardAutoscaler:
         self.num_launches += count
 
     def _terminate(self, node_id: str, reason: str) -> None:
+        if self.drain_fn is not None:
+            try:
+                drained = bool(self.drain_fn(node_id))
+            except Exception:  # noqa: BLE001 - no control plane: hard kill
+                logger.exception("autoscaler: drain hook failed for %s",
+                                 node_id)
+                drained = True
+            if not drained:
+                # Still draining: leave the provider node up; the next
+                # update() tick re-selects it and checks again.
+                self.pending_drains.setdefault(node_id, time.monotonic())
+                logger.info("autoscaler: draining %s (%s)", node_id, reason)
+                return
+            self.pending_drains.pop(node_id, None)
         logger.info("autoscaler: terminating %s (%s)", node_id, reason)
         self.provider.terminate_node(node_id)
         self.last_idle_since.pop(node_id, None)

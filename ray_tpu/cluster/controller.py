@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import os
 import select
+import signal as _signal
 import subprocess
 import sys
 import time
@@ -61,6 +62,12 @@ class WorkerHandle:
         # flight on this worker, keyed by first return id: on worker death
         # every one of them must be failed.
         self.inflight: Dict[bytes, Dict] = {}
+        # Deadline bookkeeping for tasks dispatched with timeout_s:
+        # task_id -> [timeout_s, expiry]. expiry stays None until the task
+        # reaches the head of this worker's inbox (the worker executes
+        # FIFO, so the oldest inflight entry is the running one) — queued
+        # pipeline time never counts against the deadline.
+        self.deadlines: Dict[bytes, list] = {}
 
 
 class NodeController:
@@ -160,6 +167,23 @@ class NodeController:
         self._bg: Set[asyncio.Task] = set()  # strong refs: avoid mid-run GC
         self._shutting_down = False
         self._cancelled: Set[bytes] = set()  # task_ids cancelled pre-dispatch
+        # Blast-radius containment state (see docs/fault_tolerance.md).
+        # Deliberate kills awaiting the reaper, so worker death can be
+        # classified (deadline / oom / cancelled) instead of reported as a
+        # bare crash: pid -> {"cause", "task_id", "detail", ...}.
+        self._kill_causes: Dict[int, Dict] = {}
+        # SIGTERM'd workers in their grace window: pid -> monotonic time at
+        # which the reap loop escalates to SIGKILL.
+        self._term_deadline: Dict[int, float] = {}
+        # OOM guard: pid -> monotonic time its RSS first exceeded the
+        # watermark (the kill waits out the grace window).
+        self._oom_over_since: Dict[int, float] = {}
+        self._oom_watermark = float(os.environ.get(
+            "RAY_TPU_OOM_WATERMARK", "1.0"))
+        self._oom_grace_s = float(os.environ.get(
+            "RAY_TPU_OOM_GRACE_S", "2.0"))
+        self._kill_grace_s = float(os.environ.get(
+            "RAY_TPU_KILL_GRACE_S", "1.0"))
         self._inflight_fetch: Dict[bytes, asyncio.Task] = {}  # pull dedupe
         # Borrower-side holds for actor-call args: actor calls bypass the
         # GCS task table (no dep pins there), so this node registers as
@@ -215,7 +239,36 @@ class NodeController:
             flight_recorder.start("controller")
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
+        chaos_every = float(os.environ.get(
+            "RAY_TPU_CHAOS_KILL_WORKER_EVERY_S", "0") or 0)
+        if chaos_every > 0:
+            self._tasks.append(asyncio.create_task(
+                self._chaos_kill_loop(chaos_every)))
         return port
+
+    async def _chaos_kill_loop(self, every_s: float) -> None:
+        """Chaos harness (RAY_TPU_CHAOS_KILL_WORKER_EVERY_S): SIGKILL one
+        random live worker every period, exercising the reaper's blame
+        attribution and the collateral no-retry-charge path under load."""
+        import random as _random
+
+        while not self._shutting_down:
+            await asyncio.sleep(every_s)
+            live = [p for p, w in self.workers.items()
+                    if w.proc.poll() is None]
+            if not live:
+                continue
+            pid = _random.choice(live)
+            self._gcs_send({
+                "type": "log_event", "kind": "chaos_kill_worker",
+                "node_id": self.node_id, "pid": pid})
+            w = self.workers.get(pid)
+            if w is not None:
+                # cause="chaos": the blamed task retries (the worker really
+                # died) but an injected kill never counts a poison strike —
+                # we know the function isn't at fault.
+                self._record_kill(pid, w, "chaos", None,
+                                  "chaos kill (injected)", force=True)
 
     def _register_with_gcs(self, client) -> None:
         """Send register_node over ``client``. Idempotent on the GCS side
@@ -404,6 +457,13 @@ class NodeController:
                     # piggybacked on the node's GCS connection.
                     last_report = now
                     stats = sampler.sample([os.getpid(), *self.workers])
+                    # OOM guard rides the stats cadence: the sampler just
+                    # read every worker's RSS from /proc, so comparing it
+                    # against the declared memory demand costs nothing
+                    # extra and the controller beats the kernel's
+                    # OOM-killer to the punch (which would take the whole
+                    # node down, not one worker).
+                    self._oom_guard(stats)
                     stats["store"] = self.store.stats()
                     # Consistency-audit inventory: what this node actually
                     # holds (arena + overflow + spill dir + ring health),
@@ -459,6 +519,64 @@ class NodeController:
                                     pass
             except ConnectionError:
                 return
+
+    def _worker_declared_memory(self, w: WorkerHandle) -> float:
+        """Sum of the ``memory`` resource declared by everything in flight
+        on this worker. 0 => the worker declared nothing, the guard skips
+        it (no declared budget to enforce)."""
+        total = 0.0
+        for t in w.inflight.values():
+            total += float((t.get("resources") or {}).get("memory", 0.0))
+        if w.current_task is not None:
+            total += float((w.current_task.get("resources") or {})
+                           .get("memory", 0.0))
+        return total
+
+    def _oom_guard(self, stats: Dict) -> None:
+        """Kill the single worst worker whose RSS exceeds its declared
+        ``memory`` demand (x watermark) for longer than the grace window.
+        One kill per pass: RSS is re-sampled next beat, so a transient
+        spike on a neighbour never turns one OOM into a massacre."""
+        if self._oom_watermark <= 0:
+            return
+        now = time.monotonic()
+        worst = None  # (overage, pid, w, rss, limit)
+        over_pids = set()
+        for went in stats.get("workers", []):
+            pid = went.get("pid")
+            w = self.workers.get(pid)
+            if w is None or pid in self._kill_causes:
+                continue
+            declared = self._worker_declared_memory(w)
+            if declared <= 0:
+                continue
+            rss = float(went.get("rss_bytes") or 0.0)
+            limit = declared * self._oom_watermark
+            if rss <= limit:
+                continue
+            over_pids.add(pid)
+            since = self._oom_over_since.setdefault(pid, now)
+            if now - since < self._oom_grace_s:
+                continue
+            over = rss - limit
+            if worst is None or over > worst[0]:
+                worst = (over, pid, w, rss, limit)
+        for pid in list(self._oom_over_since):
+            if pid not in over_pids:
+                del self._oom_over_since[pid]
+        if worst is None:
+            return
+        _, pid, w, rss, limit = worst
+        self._oom_over_since.pop(pid, None)
+        detail = (f"rss {int(rss)} bytes exceeded the declared memory "
+                  f"budget ({int(limit)} bytes) for {self._oom_grace_s}s")
+        self._gcs_send({
+            "type": "log_event", "kind": "worker_oom_kill",
+            "node_id": self.node_id, "pid": pid,
+            "rss_bytes": int(rss), "limit_bytes": int(limit)})
+        # Straight to SIGKILL: a worker past its memory budget can grow
+        # faster than a SIGTERM grace window.
+        self._record_kill(pid, w, "oom", None, detail, force=True)
 
     def _audit_inventory(self) -> Optional[Dict[str, Any]]:
         """One inventory snapshot for the GCS consistency auditor: every
@@ -538,35 +656,142 @@ class NodeController:
                     except Exception:  # noqa: BLE001 - reaper handles death
                         pass
 
+    def _record_kill(self, pid: int, w: WorkerHandle, cause: str,
+                     task_id: Optional[bytes], detail: str,
+                     timeout_s: Optional[float] = None,
+                     force: bool = False) -> None:
+        """Mark a deliberate worker kill so the reaper classifies the death
+        (deadline / oom / cancelled) instead of reporting a bare crash.
+        SIGTERM first so the worker can exit cleanly; the reap loop
+        escalates to SIGKILL after the grace window. force skips the
+        grace."""
+        self._kill_causes.setdefault(pid, {
+            "cause": cause, "task_id": task_id, "detail": detail,
+            "timeout_s": timeout_s})
+        try:
+            if force:
+                w.proc.kill()
+            else:
+                w.proc.terminate()
+                self._term_deadline[pid] = (
+                    time.monotonic() + self._kill_grace_s)
+        except OSError:
+            pass
+
+    def _enforce_deadlines(self) -> None:
+        """Kill workers whose running task has outlived its timeout_s.
+
+        The worker drains its inbox FIFO, so the oldest inflight entry is
+        the running one; a deadline's clock only starts once its task
+        reaches the head (pipelined queue time doesn't count). Runs on the
+        reap cadence (0.2s), which bounds the start-of-clock lag."""
+        now = time.monotonic()
+        for pid, w in list(self.workers.items()):
+            if w.proc.poll() is not None:
+                continue
+            esc = self._term_deadline.get(pid)
+            if esc is not None:
+                if now >= esc:
+                    self._term_deadline.pop(pid, None)
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                continue
+            if not w.deadlines:
+                continue
+            running = next(iter(w.inflight.values()), None)
+            if running is None:
+                continue
+            tid = running.get("task_id")
+            ent = w.deadlines.get(tid)
+            if ent is None:
+                continue
+            if ent[1] is None:
+                ent[1] = now + ent[0]  # clock starts at the inbox head
+                continue
+            if now < ent[1]:
+                continue
+            self._gcs_send({
+                "type": "log_event", "kind": "task_deadline_kill",
+                "node_id": self.node_id, "pid": pid,
+                "task_id": (tid or b"").hex()[:16],
+                "timeout_s": ent[0]})
+            self._record_kill(
+                pid, w, "deadline", tid,
+                f"exceeded its {ent[0]}s deadline", timeout_s=ent[0])
+
+    def _classify_death(self, pid: int, w: WorkerHandle):
+        """(cause, blamed_task_id, detail) for a dead worker. Deliberate
+        kills were recorded by _record_kill; anything else is a crash,
+        named by signal when the exit status carries one."""
+        info = self._kill_causes.pop(pid, None)
+        self._term_deadline.pop(pid, None)
+        self._oom_over_since.pop(pid, None)
+        rc = w.proc.returncode
+        if info is not None:
+            blamed = info.get("task_id")
+            if blamed is None:
+                # OOM / chaos kill: blame the running task (inbox head).
+                first = next(iter(w.inflight.values()), None)
+                blamed = (first or {}).get("task_id")
+            return info["cause"], blamed, info.get("detail") or info["cause"], \
+                info.get("timeout_s")
+        if rc is not None and rc < 0:
+            try:
+                detail = f"killed by {_signal.Signals(-rc).name}"
+            except ValueError:
+                detail = f"killed by signal {-rc}"
+        else:
+            detail = f"exit code {rc}"
+        first = next(iter(w.inflight.values()), None)
+        return "worker_crash", (first or {}).get("task_id"), detail, None
+
     async def _reap_loop(self):
         """Detect dead worker processes; fail their tasks; respawn."""
         while True:
             await asyncio.sleep(0.2)
             self._rescue_stalled_pipelines()
+            self._enforce_deadlines()
             for pid, w in list(self.workers.items()):
                 if w.proc.poll() is not None:
                     del self.workers[pid]
+                    cause, blamed_tid, detail, timeout_s = \
+                        self._classify_death(pid, w)
                     self._gcs_send({
                         "type": "log_event", "kind": "worker_died",
                         "node_id": self.node_id, "pid": pid,
                         "exit_code": w.proc.returncode,
+                        "cause": cause, "detail": detail,
                         "was_actor": w.actor_id is not None,
                         "inflight": len(w.inflight)})
                     if w.current_task is not None:
                         await self._fail_task(
                             w.current_task,
-                            f"worker died executing task (exit "
-                            f"{w.proc.returncode})", crashed=True,
+                            f"worker died executing task ({detail})",
+                            crashed=True, cause=cause,
                         )
                     for call in list(w.inflight.values()):
+                        # The task at the inbox head takes the blame; the
+                        # pipelined neighbours behind it are collateral and
+                        # must not burn a retry or a quarantine strike.
+                        is_blamed = (blamed_tid is not None
+                                     and call.get("task_id") == blamed_tid)
+                        kw = dict(
+                            crashed=True,
+                            cause=cause if is_blamed else "collateral",
+                            fatal=is_blamed and cause in ("worker_crash",
+                                                          "oom"),
+                            no_retry_charge=not is_blamed,
+                            timeout_s=timeout_s if is_blamed else None,
+                        )
                         if call.get("direct"):
                             # resources={}: the share belongs to the lease;
                             # the GCS record re-drives on the normal path
                             # (max_retries) or serves the terminal error.
                             await self._fail_task(
                                 dict(call, resources={}),
-                                f"leased worker died (exit "
-                                f"{w.proc.returncode})", crashed=True)
+                                f"leased worker died ({detail})", **kw)
                         elif "method" in call:
                             await self._fail_actor_call(call)
                         else:
@@ -575,9 +800,10 @@ class NodeController:
                             # released there).
                             await self._fail_task(
                                 call,
-                                f"worker died executing task (exit "
-                                f"{w.proc.returncode})", crashed=True)
+                                f"worker died executing task ({detail})",
+                                **kw)
                     w.inflight.clear()
+                    w.deadlines.clear()
                     if w.lease_id is not None:
                         # The lease dies with its worker: give back the
                         # local + cluster shares and tell the owner (the
@@ -889,13 +1115,23 @@ class NodeController:
             except asyncio.TimeoutError:
                 pass
 
-    async def _fail_task(self, task: Dict, message: str, crashed: bool = False):
+    async def _fail_task(self, task: Dict, message: str, crashed: bool = False,
+                         cause: Optional[str] = None, fatal: bool = False,
+                         no_retry_charge: bool = False,
+                         timeout_s: Optional[float] = None):
         """Report a failed task to the GCS task table; the GCS decides
         between resubmission (max_retries, reference task_manager.h:57) and
-        terminal failure. Only terminal failures store error blobs here."""
+        terminal failure. Only terminal failures store error blobs here.
+
+        cause classifies the death for forensics and policy: "deadline"
+        fails typed (TaskTimeoutError) without burning a retry, "oom" and
+        "worker_crash" count a quarantine strike when fatal=True, and
+        no_retry_charge re-drives without decrementing retries (collateral
+        victims of a deliberate kill)."""
         import pickle
 
-        from ..exceptions import ClusterUnavailableError, WorkerCrashedError
+        from ..exceptions import (ClusterUnavailableError, TaskTimeoutError,
+                                  WorkerCrashedError)
 
         self._release_local(task)
         will_retry = False
@@ -905,12 +1141,21 @@ class NodeController:
         reported = False
         if task_id is not None and self._gcs is not None:
             try:
-                resp = await asyncio.to_thread(self._gcs.call, {
+                req = {
                     "type": "task_failed", "task_id": task_id,
                     "node_id": self.node_id,
                     "resources": task.get("resources", {}),
                     "error": message,
-                })
+                }
+                if cause is not None:
+                    req["cause"] = cause
+                if fatal:
+                    req["fatal"] = True
+                if no_retry_charge:
+                    req["no_retry_charge"] = True
+                if timeout_s is not None:
+                    req["timeout_s"] = timeout_s
+                resp = await asyncio.to_thread(self._gcs.call, req)
                 reported = True
                 will_retry = resp.get("will_retry", False)
                 error_blob = resp.get("error_blob")
@@ -923,8 +1168,13 @@ class NodeController:
         if will_retry:
             return
         if error_blob is None:
-            err = (WorkerCrashedError(message) if crashed
-                   else ClusterUnavailableError(message))
+            if cause == "deadline":
+                err: Exception = TaskTimeoutError(
+                    task_id=task_id, timeout_s=timeout_s)
+            elif crashed:
+                err = WorkerCrashedError(message)
+            else:
+                err = ClusterUnavailableError(message)
             # Bounded: a bare exception with a short message, not task
             # data.  # raylint: disable=async-blocking
             error_blob = ERR_PREFIX + pickle.dumps(err)
@@ -1056,6 +1306,8 @@ class NodeController:
             coro = self._delete_objects(msg["object_ids"])
         elif mtype == "restore_object":
             coro = self._restore_object(msg["object_id"])
+        elif mtype == "replicate_object":
+            coro = self._replicate_object(msg["object_id"])
         elif mtype in ("pg_reserve", "pg_release"):
             self._loop.call_soon_threadsafe(self._apply_pg_update, msg)
             return
@@ -1164,6 +1416,16 @@ class NodeController:
         if blob is not None:
             self._register_object(oid, len(blob))
 
+    async def _replicate_object(self, oid: bytes) -> None:
+        """Pull a copy of an object onto THIS node (GCS drain evacuation:
+        the only live copy sits on a node being retired). _store_get
+        fetches from the current holder, lands the bytes in the local
+        arena, and registers the new location with the directory."""
+        try:
+            await self._store_get(oid)
+        except Exception:  # noqa: BLE001 - straggler: lineage recovers it
+            pass
+
     async def _delete_objects(self, oids) -> None:
         for oid in oids:
             self.store.delete(oid)
@@ -1176,18 +1438,22 @@ class NodeController:
         (reference: CoreWorker::KillActor/CancelTask semantics — the interrupt
         is process-level; the worker pool respawns)."""
         self._cancelled.add(task_id)
-        for w in self.workers.values():
+        for pid, w in list(self.workers.items()):
+            if w.proc.poll() is not None:
+                continue
             task = w.current_task
-            if task is not None and task.get("task_id") == task_id \
-                    and w.proc.poll() is None:
-                w.proc.kill()
-            elif w.proc.poll() is None and any(
-                    t.get("task_id") == task_id
-                    for t in w.inflight.values()):
+            hit = task is not None and task.get("task_id") == task_id
+            if not hit:
                 # Direct-pushed or pipelined queued task on this worker:
                 # same process-level interrupt; the reaper fails/retries
                 # its inflight set.
-                w.proc.kill()
+                hit = any(t.get("task_id") == task_id
+                          for t in w.inflight.values())
+            if hit:
+                # force=True goes straight to SIGKILL; otherwise SIGTERM
+                # with the reap loop escalating after the grace window.
+                self._record_kill(pid, w, "cancelled", task_id,
+                                  "cancelled by owner", force=force)
 
     # -------------------------------------------------------------- handlers
     def _register_handlers(self):
@@ -1242,6 +1508,7 @@ class NodeController:
                 if t.get("task_id") == tid and not t.get("direct") \
                         and "method" not in t:
                     del w.inflight[rid]
+                    w.deadlines.pop(tid, None)
                     self._unclaim_queued(w)
                     self._release_local(t)
                     t.pop("_revoke_sent", None)
@@ -1284,6 +1551,7 @@ class NodeController:
                     done = w.inflight.pop(rid, None)
                     if done is None:
                         continue
+                    w.deadlines.pop(done.get("task_id"), None)
                     if done.get("direct"):
                         # Finish the direct task's lineage record; resources
                         # are empty — the lease keeps holding the share.
@@ -1387,6 +1655,9 @@ class NodeController:
                 return None
             if msg.get("return_ids"):
                 w.inflight[msg["return_ids"][0]] = task
+                if task.get("timeout_s"):
+                    w.deadlines[task.get("task_id")] = [
+                        float(task["timeout_s"]), None]
             try:
                 await w.conn.send(dict(task, type="execute_task"))
             except Exception:  # noqa: BLE001 - worker died under the send
@@ -1395,6 +1666,7 @@ class NodeController:
                 # the owner — don't leave it to the death reaper alone.
                 if msg.get("return_ids"):
                     w.inflight.pop(msg["return_ids"][0], None)
+                    w.deadlines.pop(task.get("task_id"), None)
                 try:
                     await conn.send({"type": "lease_lost",
                                      "lease_id": msg["lease_id"]})
@@ -1518,6 +1790,30 @@ class NodeController:
                 await self._release(task)
             return {"ok": True}
 
+        @s.handler("kill_worker")
+        async def kill_worker(msg, conn):
+            """Chaos / drill hook (`cli kill_random_node --worker`): SIGKILL
+            one worker process — a specific pid, or a random live one —
+            and let the containment machinery classify and recover."""
+            import random as _random
+
+            pid = msg.get("pid")
+            if pid is None:
+                live = [p for p, w in self.workers.items()
+                        if w.proc.poll() is None]
+                if not live:
+                    return {"ok": False, "error": "no live workers"}
+                pid = _random.choice(live)
+            w = self.workers.get(pid)
+            if w is None or w.proc.poll() is not None:
+                return {"ok": False, "error": f"no live worker pid {pid}"}
+            self._gcs_send({
+                "type": "log_event", "kind": "chaos_kill_worker",
+                "node_id": self.node_id, "pid": pid})
+            self._record_kill(pid, w, "chaos", None,
+                              "chaos kill (drill)", force=True)
+            return {"ok": True, "pid": pid}
+
         @s.handler("stats")
         async def stats(msg, conn):
             st = self.store.stats()
@@ -1637,6 +1933,12 @@ class NodeController:
         rids = task.get("return_ids") or []
         if rids:
             worker.inflight[rids[0]] = task
+            if task.get("timeout_s"):
+                # The deadline clock arms once the task reaches the inbox
+                # head (see _enforce_deadlines) — not here, where pipelined
+                # queue time would count against it.
+                worker.deadlines[task.get("task_id")] = [
+                    float(task["timeout_s"]), None]
         try:
             worker.conn.send_nowait(dict(task, type="execute_task"))
         except Exception:  # noqa: BLE001 - worker died under the send:

@@ -796,6 +796,13 @@ class ClusterCoreWorker:
             "deps": deps, "pin_refs": pins, "return_ids": return_ids,
             "resources": resources, "max_retries": spec.max_retries,
         }
+        if getattr(spec, "timeout_s", None) is not None:
+            # Deadline fields ride the spec (wire: v3 header extension) so
+            # the controller can enforce expiry; deadline-free tasks keep
+            # the v1/v2 bytes.
+            payload["timeout_s"] = float(spec.timeout_s)
+            if spec.retry_on_timeout:
+                payload["retry_on_timeout"] = True
         self._phase_add("driver_serialize", time.perf_counter() - t0)
         if trace is not None:
             # Trace context rides inside the spec (wire: v2 header

@@ -32,7 +32,8 @@ _REASON_GAUGE_NAMES = ("waiting-for-deps", "waiting-for-capacity",
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "resources", "available", "last_heartbeat",
-                 "alive", "index", "store_name", "transfer_port", "label")
+                 "alive", "index", "store_name", "transfer_port", "label",
+                 "draining")
 
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float], index: int,
@@ -50,6 +51,10 @@ class NodeEntry:
         # Provider-assigned node id (autoscaler namespace); "" for nodes the
         # autoscaler didn't launch.
         self.label = label
+        # Graceful drain (cli drain / autoscaler scale-down): a draining
+        # node is masked out of every placement pass but keeps serving its
+        # running tasks and objects until _drain_worker retires it.
+        self.draining = False
 
 
 class _ReplayConnection:
@@ -252,6 +257,15 @@ class GcsServer:
         self._place_warming: set = set()
         self._tasks: List[asyncio.Task] = []
         self._bg: Set[asyncio.Task] = set()
+        # ---- blast-radius containment: poison-task quarantine. Worker-
+        # FATAL failures (crash/oom, never deadline or cancel) are counted
+        # per function fingerprint; at the threshold the function is
+        # quarantined and every submission/retry fails fast with
+        # TaskPoisonedError until `cli quarantine --clear`.
+        self._fn_strikes: Dict[bytes, Dict[str, Any]] = {}
+        self.quarantined: Dict[bytes, Dict[str, Any]] = {}
+        self._poison_threshold = int(_os.environ.get(
+            "RAY_TPU_POISON_THRESHOLD", "3"))
         # ---- head HA (replication log + lease-based leadership). With no
         # persistent store there is nothing to replicate against or lease
         # from: the server is unconditionally "leader" and every HA hook
@@ -494,9 +508,12 @@ class GcsServer:
                 {"node_id": n.node_id, "address": list(n.address),
                  "resources": n.resources, "available": n.available,
                  "alive": n.alive, "store_name": n.store_name,
-                 "transfer_port": n.transfer_port, "label": n.label}
+                 "transfer_port": n.transfer_port, "label": n.label,
+                 "draining": n.draining}
                 for n in (self.nodes[nid] for nid in self._node_order)
             ],
+            "quarantine": c(self.quarantined),
+            "fn_strikes": c(self._fn_strikes),
             "actors": c(self.actors),
             "named_actors": c(self.named_actors),
             "objects": c(self.objects),
@@ -557,6 +574,7 @@ class GcsServer:
                 label=n.get("label", ""))
             entry.available = n["available"]
             entry.alive = n["alive"]
+            entry.draining = bool(n.get("draining", False))
             # Fresh heartbeat deadline: restored nodes must re-prove
             # liveness, but get a full timeout window to do so.
             self.nodes[n["node_id"]] = entry
@@ -570,6 +588,8 @@ class GcsServer:
         self.lineage = state.get("lineage", {})
         self.error_objects = state.get("error_objects", {})
         self.placement_groups = state.get("placement_groups", {})
+        self.quarantined = state.get("quarantine", {})
+        self._fn_strikes = state.get("fn_strikes", {})
         for rec in self.placement_groups.values():
             rec["waiters"] = []
         for oid in self.error_objects:
@@ -632,6 +652,7 @@ class GcsServer:
         "object_spilled", "free_objects", "remove_object_locations",
         "remove_object_location", "put_function", "kv_put", "set_resource",
         "create_placement_group", "remove_placement_group",
+        "drain_node", "clear_quarantine",
     })
 
     def _install_replication(self) -> None:
@@ -1364,6 +1385,19 @@ class GcsServer:
             self.lineage[oid] = task_id
             # A resubmitted/restarted producer supersedes any old error.
             self.error_objects.pop(oid, None)
+        if kind == "task":
+            q = self.quarantined.get(payload.get("fn_id"))
+            if q is not None:
+                # Poisoned function: fail fast BEFORE placement — a
+                # crash-looper must not keep taking workers down while an
+                # operator decides whether to clear it.
+                from ..exceptions import TaskPoisonedError
+
+                rec["failure_cause"] = "poisoned"
+                self._fail_record(rec, TaskPoisonedError(
+                    fn_id=payload.get("fn_id"), name=q.get("name"),
+                    strikes=q.get("strikes", 0)))
+                return rec
         if self._replay_mode:
             # Replay records state only; the post-replay re-drive pass
             # spawns _drive_task for every surviving PENDING record.
@@ -1636,6 +1670,50 @@ class GcsServer:
         from ..exceptions import TaskCancelledError
 
         return TaskCancelledError(rec["task_id"].hex()[:16])
+
+    def _poison_strike(self, fn_id: bytes, rec: Dict[str, Any],
+                       error_s: str) -> None:
+        """Count one worker-fatal failure against ``fn_id``; quarantine the
+        function once it accumulates RAY_TPU_POISON_THRESHOLD strikes.
+
+        Only deaths the controller classified worker-fatal (crash signal,
+        nonzero exit, oom) count — deadline kills and cancellations never
+        do, so a slow-but-honest function can't be poisoned by its own
+        timeouts."""
+        name = (rec.get("payload") or {}).get("name") or ""
+        ent = self._fn_strikes.setdefault(
+            fn_id, {"count": 0, "name": name, "last_error": "",
+                    "last_ts": 0.0})
+        ent["count"] += 1
+        ent["name"] = name or ent["name"]
+        ent["last_error"] = error_s
+        ent["last_ts"] = time.time()
+        if fn_id in self.quarantined:
+            self.quarantined[fn_id]["strikes"] = ent["count"]
+            return
+        if ent["count"] >= self._poison_threshold:
+            self.quarantined[fn_id] = {
+                "fn_id": fn_id.hex(), "name": ent["name"],
+                "strikes": ent["count"], "ts": time.time(),
+                "last_error": error_s,
+            }
+            self.record_event("task_quarantined",
+                              fn_id=fn_id.hex()[:16],
+                              name=ent["name"],
+                              strikes=ent["count"],
+                              error=error_s)
+            self._quarantine_gauge()
+
+    def _quarantine_gauge(self) -> None:
+        try:
+            from ..metrics import Gauge, get_or_create
+
+            get_or_create(
+                Gauge, "quarantined_functions",
+                description="Functions currently quarantined as poison",
+            ).record(float(len(self.quarantined)))
+        except Exception:  # noqa: BLE001 - metrics must never break policy
+            pass
 
     def _fail_record(self, rec: Dict[str, Any],
                      err: Optional[BaseException] = None,
@@ -1928,6 +2006,91 @@ class GcsServer:
                     node.alive = False
                     await self._on_node_death(node)
 
+    # ------------------------------------------------------------------ drain
+    def _has_other_copy(self, entry: Dict[str, Any], node_id: str) -> bool:
+        """Does any live node besides ``node_id`` hold a copy (in-store or
+        spilled) of this object?"""
+        return any(
+            n != node_id and n in self.nodes and self.nodes[n].alive
+            for n in (*entry["locations"], *self._spilled_set(entry)))
+
+    async def _evacuate_objects(self, node_id: str, deadline: float) -> int:
+        """Re-home objects whose ONLY live copy sits on the draining node:
+        ask other nodes to pull a replica (their fetch path registers the
+        new location), then wait until every sole-copy object has a second
+        home or the deadline passes. Returns how many were still sole-copy
+        at the end (stragglers are reconstructable from lineage)."""
+        rescuers = [nid for nid in self._node_order
+                    if nid != node_id and nid in self.nodes
+                    and self.nodes[nid].alive
+                    and not self.nodes[nid].draining
+                    and nid in self._node_conns]
+        sole = []
+        for oid, entry in list(self.objects.items()):
+            if entry.get("inline") is not None:
+                continue  # the directory itself holds the bytes
+            holders = {*entry["locations"], *self._spilled_set(entry)}
+            if node_id in holders and not self._has_other_copy(
+                    entry, node_id):
+                sole.append(oid)
+        if not sole or not rescuers:
+            return len(sole)
+        for i, oid in enumerate(sole):
+            conn = self._node_conns.get(rescuers[i % len(rescuers)])
+            if conn is None:
+                continue
+            try:
+                conn.send_nowait(
+                    {"type": "replicate_object", "object_id": oid})
+            except Exception:  # noqa: BLE001 - straggler: lineage recovers
+                pass
+        self.record_event("drain_evacuate", node_id=node_id,
+                          objects=len(sole))
+        while time.monotonic() < deadline:
+            remaining = 0
+            for oid in sole:
+                entry = self.objects.get(oid)
+                if entry is not None and not self._has_other_copy(
+                        entry, node_id):
+                    remaining += 1
+            if remaining == 0:
+                return 0
+            await asyncio.sleep(0.2)
+        return remaining
+
+    async def _drain_worker(self, node: NodeEntry, timeout_s: float):
+        """Background drain: placement already masks the node out (its
+        ``draining`` bit), so no new work lands. Wait for the running tasks
+        to finish, re-home sole-copy objects, then retire the node through
+        the ordinary death path — stragglers past the timeout relocate via
+        the existing retry/reconstruction machinery."""
+        node_id = node.node_id
+        start = time.monotonic()
+        deadline = start + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            if not node.alive or not node.draining:
+                return  # died, or drain was cancelled by a re-register
+            running = sum(
+                1 for rec in self.task_table.values()
+                if rec["state"] == "DISPATCHED"
+                and rec["node_id"] == node_id)
+            if running == 0:
+                break
+            await asyncio.sleep(0.2)
+        # Object evacuation gets a small floor even when task-wait consumed
+        # the whole budget: losing a sole copy forces lineage re-execution.
+        left_behind = await self._evacuate_objects(
+            node_id, max(deadline, time.monotonic() + 5.0))
+        if not node.alive or not node.draining:
+            return
+        timed_out = time.monotonic() >= deadline
+        node.alive = False
+        self.record_event("node_drained", node_id=node_id,
+                          duration_s=round(time.monotonic() - start, 3),
+                          timed_out=timed_out,
+                          sole_copy_left=left_behind)
+        await self._on_node_death(node)
+
     async def _on_node_death(self, node: NodeEntry):
         # Drop object locations on the dead node; recover/retry what it
         # was running; restart actors homed there.
@@ -2006,17 +2169,24 @@ class GcsServer:
 
     # -------------------------------------------------------------- placement
     def _avail_matrix(self, custom_names: Tuple[str, ...] = ()
-                      ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
-        """(available-load clamped at 0, totals, node order). available can
-        go negative under queue-at-node overcommit; the kernel sees 0."""
+                      ) -> Tuple[np.ndarray, np.ndarray, List[str],
+                                 np.ndarray]:
+        """(available-load clamped at 0, totals, node order, schedulable
+        mask). available can go negative under queue-at-node overcommit;
+        the kernel sees 0. Draining nodes stay in the matrix (their running
+        tasks still hold shares the accounting must see) but the mask hides
+        them from every placement decision — it feeds the kernel's
+        node_mask input."""
         order = [nid for nid in self._node_order if self.nodes[nid].alive]
         if not order:
             empty = np.zeros((0, NUM_PREDEFINED + len(custom_names)), np.int64)
-            return empty, empty, []
+            return empty, empty, [], np.zeros(0, bool)
         sets = [ResourceSet.from_dict(self.nodes[nid].available) for nid in order]
         totals = [ResourceSet.from_dict(self.nodes[nid].resources) for nid in order]
         avail = np.maximum(dense_matrix(sets, custom_names), 0)
-        return avail, dense_matrix(totals, custom_names), order
+        mask = np.array([not self.nodes[nid].draining for nid in order],
+                        dtype=bool)
+        return avail, dense_matrix(totals, custom_names), order, mask
 
     async def _placement_loop(self):
         """Batch placement: drain both queues each tick.
@@ -2045,7 +2215,8 @@ class GcsServer:
                 continue
             t_place0 = time.monotonic()
             alive = [nid for nid in self._node_order
-                     if self.nodes[nid].alive]
+                     if self.nodes[nid].alive
+                     and not self.nodes[nid].draining]
             if not alive:
                 self._classify_unplaced([(d, rec) for d, _, _, rec
                                          in entries])
@@ -2125,12 +2296,16 @@ class GcsServer:
         custom_names = tuple(sorted(
             {name for d, _, _, _ in batch for name in d.custom}
         ))
-        avail, totals, order = self._avail_matrix(custom_names)
-        if not order:
+        avail, totals, order, mask = self._avail_matrix(custom_names)
+        if not order or not mask.any():
             self._classify_unplaced([(d, rec) for d, _, _, rec in batch])
             for _, _, sink, _ in batch:
                 self._grant(sink, None)
             return
+        # All-schedulable ticks pass None: the kernel keeps its unmasked
+        # trace (and jit cache key) — the mask variant only compiles when
+        # a node is actually draining.
+        node_mask = mask if not mask.all() else None
         index_of = {nid: i for i, nid in enumerate(order)}
         demand = dense_matrix([d for d, _, _, _ in batch], custom_names)
         locality = np.array(
@@ -2148,10 +2323,11 @@ class GcsServer:
         choice = self._choose_place_backend(demand.shape[0])
         if choice == "numpy":
             placement = self._place_with(
-                "numpy", demand, avail, locality)
+                "numpy", demand, avail, locality, node_mask)
         else:
             placement = await asyncio.to_thread(
-                self._place_with, "kernel", demand, avail, locality)
+                self._place_with, "kernel", demand, avail, locality,
+                node_mask)
         # Queue-at-node fallback (reference: tasks the per-tick policy
         # can't admit queue at a raylet, which admits locally when
         # resources free — node_manager DispatchTasks). A task the
@@ -2168,7 +2344,7 @@ class GcsServer:
                 continue
             if node_idx < 0:
                 d = dense_matrix([dset], custom_names)[0]
-                feas = (d <= totals).all(axis=1)
+                feas = (d <= totals).all(axis=1) & mask
                 if feas.any():
                     req = d > 0
                     if req.any():
@@ -2238,7 +2414,11 @@ class GcsServer:
         names = ("placed",) + _REASON_GAUGE_NAMES
         custom_names = tuple(sorted(
             {name for d, _ in work for name in d.custom}))
-        _, totals, _ = self._avail_matrix(custom_names)
+        _, totals, _, cmask = self._avail_matrix(custom_names)
+        # A demand only feasible on a draining node is waiting-for-capacity
+        # (the node is leaving), not infeasible: classify against the
+        # schedulable rows only.
+        totals = totals[cmask] if len(totals) else totals
         demand = dense_matrix([d for d, _ in work], custom_names)
         T = demand.shape[0]
         placement = np.full(T, -1, np.int32)
@@ -2337,7 +2517,7 @@ class GcsServer:
             try:
                 from ..scheduler.kernel import BatchScheduler
 
-                avail, _, order = self._avail_matrix(())
+                avail, _, order, _m = self._avail_matrix(())
                 if not order:
                     return
                 # Install as the serving scheduler when none exists (or
@@ -2409,15 +2589,18 @@ class GcsServer:
                 for (path, bucket), c in sorted(self._place_perf.items())}
 
     def _place_with(self, choice: str, demand: np.ndarray, avail: np.ndarray,
-                    locality: np.ndarray) -> np.ndarray:
+                    locality: np.ndarray,
+                    node_mask: Optional[np.ndarray] = None) -> np.ndarray:
         """One tick of the placement spec on the head with the given
         backend ("numpy" spec or jax "kernel" with power-of-two bucket
         padding); the caller (the placement loop) picks the backend via
-        _choose_place_backend and offloads kernel ticks to a thread."""
+        _choose_place_backend and offloads kernel ticks to a thread.
+        ``node_mask`` (None = all schedulable) hides draining nodes."""
         T = demand.shape[0]
         t0 = time.perf_counter()
         if choice == "numpy":
-            out = _place_numpy(demand, avail, locality, self._seed)
+            out = _place_numpy(demand, avail, locality, self._seed,
+                               node_mask=node_mask)
             self._record_place_perf("numpy", T, time.perf_counter() - t0)
             return out
         try:
@@ -2441,7 +2624,8 @@ class GcsServer:
                 import jax.numpy as jnp  # noqa: PLC0415
 
                 sched.avail = jnp.asarray(avail.astype(np.int32))
-            out = sched.place(demand.astype(np.int32), locality)[:T]
+            out = sched.place(demand.astype(np.int32), locality,
+                              node_mask=node_mask)[:T]
             self._record_place_perf("kernel", T, time.perf_counter() - t0)
             return out
         except Exception as exc:  # noqa: BLE001 - jax unavailable: numpy spec
@@ -2457,7 +2641,8 @@ class GcsServer:
                 print(f"[gcs] placement kernel unavailable, using numpy "
                       f"spec: {exc!r}", file=_sys.stderr)
             t0 = time.perf_counter()
-            out = _place_numpy(demand[:T], avail, locality[:T], self._seed)
+            out = _place_numpy(demand[:T], avail, locality[:T], self._seed,
+                               node_mask=node_mask)
             self._record_place_perf("numpy", T, time.perf_counter() - t0)
             return out
 
@@ -2646,8 +2831,15 @@ class GcsServer:
         custom_names = tuple(sorted(
             {name for rec in pending for b in rec["bundles"]
              for name in ResourceSet.from_dict(b).custom}))
-        avail, totals, order = self._avail_matrix(custom_names)
-        if not order:
+        avail, totals, order, mask = self._avail_matrix(custom_names)
+        if mask.any() and not mask.all():
+            # Gang admission never lands a bundle on a draining node: hide
+            # the masked rows entirely (bundle indices map through the
+            # filtered order).
+            avail = avail[mask]
+            totals = totals[mask]
+            order = [nid for nid, ok in zip(order, mask) if ok]
+        if not order or not mask.any():
             for rec in pending:
                 rec["reason"] = "waiting-for-capacity"
             return
@@ -2843,6 +3035,71 @@ class GcsServer:
                 await self._on_node_death(node)
             return {"ok": True}
 
+        @s.handler("drain_node")
+        async def drain_node(msg, conn):
+            """Graceful retirement: mask the node out of placement, let its
+            running tasks finish (bounded by timeout), re-home sole-copy
+            objects, then retire it through the node-death path — so a
+            planned scale-down loses zero tasks (stragglers past the
+            timeout relocate via the ordinary retry path)."""
+            import os as _os
+
+            want = msg.get("node_id", "")
+            node = None
+            for nid, n in self.nodes.items():
+                if nid == want or nid.startswith(want):
+                    node = n
+                    break
+            if node is None:
+                return {"ok": False, "error": f"no such node: {want!r}"}
+            if not node.alive:
+                return {"ok": False,
+                        "error": f"node {node.node_id} is not alive"}
+            already = node.draining
+            node.draining = True
+            if not already:
+                self.record_event("node_draining", node_id=node.node_id)
+                timeout_s = float(
+                    msg.get("timeout_s")
+                    or _os.environ.get("RAY_TPU_DRAIN_TIMEOUT_S", "60"))
+                if not self._replay_mode:
+                    self._spawn(self._drain_worker(node, timeout_s))
+            return {"ok": True, "node_id": node.node_id,
+                    "already_draining": already}
+
+        @s.handler("list_quarantine")
+        async def list_quarantine(msg, conn):
+            return {"ok": True,
+                    "quarantined": list(self.quarantined.values()),
+                    "strikes": [
+                        {"fn_id": fid.hex(), "count": ent["count"],
+                         "name": ent.get("name", ""),
+                         "last_error": ent.get("last_error", "")}
+                        for fid, ent in self._fn_strikes.items()
+                    ],
+                    "threshold": self._poison_threshold}
+
+        @s.handler("clear_quarantine")
+        async def clear_quarantine(msg, conn):
+            """Lift quarantine (all functions, or those matching a fn_id
+            hex prefix) and reset their strike counters."""
+            prefix = (msg.get("fn_id") or "").lower()
+            cleared = []
+            for fid in list(self.quarantined):
+                if not prefix or fid.hex().startswith(prefix):
+                    ent = self.quarantined.pop(fid)
+                    self._fn_strikes.pop(fid, None)
+                    cleared.append(ent)
+            if not prefix:
+                # A full clear also forgives sub-threshold strikes.
+                self._fn_strikes.clear()
+            for ent in cleared:
+                self.record_event("quarantine_cleared",
+                                  fn_id=ent.get("fn_id", "")[:16],
+                                  name=ent.get("name", ""))
+            self._quarantine_gauge()
+            return {"ok": True, "cleared": cleared}
+
         @s.handler("heartbeat")
         async def heartbeat(msg, conn):
             node = self.nodes.get(msg["node_id"])
@@ -2861,6 +3118,7 @@ class GcsServer:
         async def list_nodes(msg, conn):
             return {"ok": True, "nodes": [
                 {"NodeID": n.node_id, "Alive": n.alive,
+                 "Draining": n.draining,
                  "Resources": n.resources, "Available": n.available,
                  "Address": n.address, "StoreName": n.store_name,
                  "TransferPort": n.transfer_port, "Label": n.label}
@@ -3313,7 +3571,17 @@ class GcsServer:
         async def task_failed(msg, conn):
             """A node reports a task it was running failed (worker death or
             dispatch failure). Decide retry (owner-side max_retries,
-            task_manager.h:57) or produce the terminal error blob."""
+            task_manager.h:57) or produce the terminal error blob.
+
+            The controller classifies worker deaths into ``cause``
+            (deadline / oom / cancelled / worker_crash / collateral) for
+            forensics and retry policy: a deadline kill fails typed without
+            burning a retry (unless the spec opted into retry_on_timeout),
+            ``fatal=True`` counts a quarantine strike against the function,
+            and ``no_retry_charge`` re-drives a collateral victim of a
+            deliberate kill for free."""
+            from ..exceptions import TaskPoisonedError, TaskTimeoutError
+
             self._release(msg["node_id"], msg.get("resources", {}))
             rec = self.task_table.get(msg.get("task_id"))
             if rec is None:
@@ -3337,10 +3605,59 @@ class GcsServer:
             if rec["kind"] == "actor":
                 # Restart decision happens on the update_actor DEAD path.
                 return {"ok": True, "will_retry": False}
+            cause = msg.get("cause")
+            error_s = str(msg.get("error", ""))[:200]
+            if cause:
+                rec["failure_cause"] = cause
+            rec["failure_error"] = error_s
+            fn_id = (rec.get("payload") or {}).get("fn_id")
+            if msg.get("fatal") and fn_id is not None:
+                # Worker-fatal death (crash signal / exit / oom) blamed on
+                # this function: one strike; quarantine at the threshold.
+                self._poison_strike(fn_id, rec, error_s)
             if rec["cancelled"]:
+                rec["failure_cause"] = "cancelled"
                 self._fail_record(rec, self._cancel_error(rec))
                 blob = self.error_objects.get(rec["return_ids"][0])                     if rec["return_ids"] else None
                 return {"ok": True, "will_retry": False, "error_blob": blob}
+            if cause == "deadline" and \
+                    not (rec.get("payload") or {}).get("retry_on_timeout"):
+                # Deadline kills are terminal and typed by default — they
+                # never consume max_retries (retry_on_timeout opts into the
+                # ordinary retry path below instead).
+                self.record_event("task_deadline",
+                                  task_id=rec["task_id"].hex()[:16],
+                                  node_id=msg["node_id"],
+                                  timeout_s=msg.get("timeout_s"))
+                self._fail_record(rec, TaskTimeoutError(
+                    task_id=rec["task_id"].hex()[:16],
+                    timeout_s=msg.get("timeout_s")))
+                blob = self.error_objects.get(rec["return_ids"][0])                     if rec["return_ids"] else None
+                return {"ok": True, "will_retry": False, "error_blob": blob}
+            q = self.quarantined.get(fn_id) if fn_id is not None else None
+            if q is not None:
+                # The function crossed the poison threshold (possibly on
+                # this very report): stop the crash loop here rather than
+                # burning through the remaining retries.
+                rec["failure_cause"] = "poisoned"
+                self._fail_record(rec, TaskPoisonedError(
+                    fn_id=fn_id, name=q.get("name"),
+                    strikes=q.get("strikes", 0)))
+                blob = self.error_objects.get(rec["return_ids"][0])                     if rec["return_ids"] else None
+                return {"ok": True, "will_retry": False, "error_blob": blob}
+            if msg.get("no_retry_charge"):
+                # Collateral victim of a deliberate kill (deadline / oom /
+                # cancel / chaos aimed at a neighbour in the same worker
+                # inbox): it never started executing, so re-drive it
+                # without decrementing retries_left.
+                rec["state"] = "PENDING"
+                rec["node_id"] = None
+                self.record_event("task_requeued",
+                                  task_id=rec["task_id"].hex()[:16],
+                                  reason="collateral_worker_death",
+                                  node_id=msg["node_id"])
+                self._spawn(self._drive_task(rec))
+                return {"ok": True, "will_retry": True}
             if rec["retries_left"] != 0:
                 if rec["retries_left"] > 0:
                     rec["retries_left"] -= 1
@@ -3350,15 +3667,16 @@ class GcsServer:
                                   task_id=rec["task_id"].hex()[:16],
                                   reason="worker_failed",
                                   node_id=msg["node_id"],
-                                  error=str(msg.get("error", ""))[:200])
+                                  error=error_s)
                 self._spawn(self._drive_task(rec))
                 return {"ok": True, "will_retry": True}
             rec["state"] = "FAILED"
             self.record_event("task_failed",
                               task_id=rec["task_id"].hex()[:16],
                               reason="retries_exhausted",
+                              cause=cause or "",
                               node_id=msg["node_id"],
-                              error=str(msg.get("error", ""))[:200])
+                              error=error_s)
             return {"ok": True, "will_retry": False}
 
         @s.handler("cancel_task")
@@ -3941,6 +4259,8 @@ class GcsServer:
                 "ts_submit": float(r.get("ts_submit") or 0.0),
                 "ts_dispatch": float(r.get("ts_dispatch") or 0.0),
                 "ts_finish": float(r.get("ts_finish") or 0.0),
+                "failure_cause": r.get("failure_cause") or "",
+                "failure_error": r.get("failure_error") or "",
             }
 
         @s.handler("list_tasks")
@@ -4030,7 +4350,11 @@ class GcsServer:
                 "resources": dict(r.get("resources") or {}),
                 "max_retries": r["payload"].get("max_retries", 0),
                 "direct_dispatch": bool(r.get("direct_dispatch")),
+                "timeout_s": r["payload"].get("timeout_s"),
             })
+            fn_id = r["payload"].get("fn_id")
+            if fn_id is not None and fn_id in self.quarantined:
+                row["quarantined_fn"] = dict(self.quarantined[fn_id])
             return {"ok": True, "task": row}
 
         @s.handler("run_audit")
@@ -4194,12 +4518,17 @@ class GcsServer:
 
 
 def _place_numpy(demand: np.ndarray, avail: np.ndarray, locality: np.ndarray,
-                 seed: int) -> np.ndarray:
-    """Numpy fallback of one placement tick (same spec as the kernel)."""
+                 seed: int,
+                 node_mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """Numpy fallback of one placement tick (same spec as the kernel).
+    ``node_mask`` (None = all True) removes nodes from feasibility — NOT
+    by zeroing their avail, which would still admit zero-demand tasks."""
     rng = np.random.default_rng(seed)
     T = demand.shape[0]
     N = avail.shape[0]
     feas = (demand[:, None, :] <= avail[None, :, :]).all(-1)  # [T, N]
+    if node_mask is not None:
+        feas &= np.asarray(node_mask, bool)[None, :]
     cnt = feas.sum(-1)
     placement = np.full(T, -1, np.int32)
     prefix = np.zeros_like(avail)

@@ -54,11 +54,13 @@ MAGIC = 0xBF
 # _LOC_INLINE location flag); v3 adds the PROFILE_STACKS stats frame; v4
 # adds the state-API frames (LIST_TASKS / LIST_TASKS_RESP); v5 adds the
 # head-HA frames (REPL_RECORD / REPL_TAIL / REPL_TAIL_RESP / HA_STATUS /
-# HA_STATUS_RESP).
+# HA_STATUS_RESP); v6 adds the cancellation frame (CANCEL_TASK), the
+# deadline fields of task-spec v3, and the forensics task-row frame
+# (LIST_TASKS_RESP2).
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 5
+WIRE_VERSION = 6
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -106,6 +108,15 @@ REPL_TAIL = 0x17
 REPL_TAIL_RESP = 0x18
 HA_STATUS = 0x19
 HA_STATUS_RESP = 0x1A
+# Cancellation frame (v6): driver->GCS carries the object id of the ref
+# being cancelled; GCS->controller carries the resolved task id. Framed so
+# a cancel storm (a driver tearing down a large batch) doesn't re-enter
+# pickle on the control path.
+CANCEL_TASK = 0x1B
+# v6 twin of LIST_TASKS_RESP: each row additionally carries the failure
+# forensics pair (failure_cause, failure_error) — who killed the task and
+# why, attributed by the containment machinery.
+LIST_TASKS_RESP2 = 0x1C
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -139,6 +150,8 @@ FRAME_MIN_WIRE = {
     REPL_TAIL_RESP: 5,
     HA_STATUS: 5,
     HA_STATUS_RESP: 5,
+    CANCEL_TASK: 6,
+    LIST_TASKS_RESP2: 6,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -148,9 +161,16 @@ _TASK_KINDS = ("task", "actor")
 
 # Task-spec versions. v1 is the base header; v2 appends a trace context
 # (sampled tasks only — unsampled specs still encode as v1, so the hot
-# path's bytes are unchanged and pre-tracing decoders keep reading them).
+# path's bytes are unchanged and pre-tracing decoders keep reading them);
+# v3 appends the deadline fields (timeout_s + retry_on_timeout), emitted
+# only for tasks that set a deadline — deadline-free specs keep their v1/v2
+# bytes so pre-v6 decoders and the hot path are unchanged.
 SPEC_VERSION = 1
 SPEC_VERSION_TRACED = 2
+SPEC_VERSION_DEADLINE = 3
+# v3 flag bits.
+SPEC_F_TRACE = 1
+SPEC_F_RETRY_ON_TIMEOUT = 2
 
 # Hard caps, enforced on decode: a corrupt count/length field must fail the
 # frame instead of driving a multi-GB allocation.
@@ -316,8 +336,15 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
     the args; args/kwargs blobs are appended verbatim. A sampled task's
     trace context rides as a versioned header extension (v2)."""
     trace = p.get("trace")
+    timeout_s = p.get("timeout_s")
+    if timeout_s is not None:
+        ver = SPEC_VERSION_DEADLINE
+    elif trace:
+        ver = SPEC_VERSION_TRACED
+    else:
+        ver = SPEC_VERSION
     parts = [
-        _U8.pack(SPEC_VERSION_TRACED if trace else SPEC_VERSION),
+        _U8.pack(ver),
         _b8(p["task_id"]),
         _b8(p.get("fn_id", b"")),
         _s(p.get("name", "") or ""),
@@ -327,7 +354,14 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
         _oids(p.get("pin_refs", ())),
         _resources(p.get("resources", {})),
     ]
-    if trace:
+    if ver == SPEC_VERSION_DEADLINE:
+        flags = (SPEC_F_TRACE if trace else 0) \
+            | (SPEC_F_RETRY_ON_TIMEOUT if p.get("retry_on_timeout") else 0)
+        parts.append(_U8.pack(flags))
+        parts.append(_F64.pack(float(timeout_s)))
+        if trace:
+            parts.append(_b8(trace))
+    elif trace:
         parts.append(_b8(trace))
     args = p.get("args", ())
     parts.append(_U16.pack(len(args)))
@@ -347,7 +381,7 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
 
 def _decode_spec_header(r: _Reader) -> Dict[str, Any]:
     ver = r.u8()
-    if ver not in (SPEC_VERSION, SPEC_VERSION_TRACED):
+    if ver not in (SPEC_VERSION, SPEC_VERSION_TRACED, SPEC_VERSION_DEADLINE):
         raise WireError(f"unknown task-spec version {ver}")
     out = {
         "task_id": r.b8(),
@@ -359,7 +393,14 @@ def _decode_spec_header(r: _Reader) -> Dict[str, Any]:
         "pin_refs": _read_oids(r),
         "resources": _read_resources(r),
     }
-    if ver == SPEC_VERSION_TRACED:
+    if ver == SPEC_VERSION_DEADLINE:
+        flags = r.u8()
+        out["timeout_s"] = r.f64()
+        if flags & SPEC_F_RETRY_ON_TIMEOUT:
+            out["retry_on_timeout"] = True
+        if flags & SPEC_F_TRACE:
+            out["trace"] = r.b8()
+    elif ver == SPEC_VERSION_TRACED:
         out["trace"] = r.b8()
     return out
 
@@ -842,8 +883,12 @@ def _enc_list_tasks_resp(msg, peer_wire: int = WIRE_VERSION
                          ) -> Optional[List[bytes]]:
     if peer_wire < 4:
         return None
+    # v6 peers get the forensics twin (failure_cause/failure_error per
+    # row); v4-v5 peers still parse the original layout.
+    forensic = peer_wire >= 6
+    code = LIST_TASKS_RESP2 if forensic else LIST_TASKS_RESP
     tasks = msg.get("tasks", ())
-    out = [_head(LIST_TASKS_RESP, msg.get("rpc_id")),
+    out = [_head(code, msg.get("rpc_id")),
            _U32.pack(int(msg.get("total", 0))),
            _U8.pack(1 if msg.get("truncated") else 0),
            _U32.pack(len(tasks))]
@@ -865,10 +910,14 @@ def _enc_list_tasks_resp(msg, peer_wire: int = WIRE_VERSION
         out.append(_F64.pack(float(t.get("ts_submit", 0.0))))
         out.append(_F64.pack(float(t.get("ts_dispatch", 0.0))))
         out.append(_F64.pack(float(t.get("ts_finish", 0.0))))
+        if forensic:
+            out.append(_s(t.get("failure_cause") or ""))
+            out.append(_s(t.get("failure_error") or ""))
     return out
 
 
-def _dec_list_tasks_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+def _dec_list_tasks_resp_rows(r: _Reader, rpc_id, forensic: bool
+                              ) -> Dict[str, Any]:
     total = r.u32()
     truncated = bool(r.u8())
     n = r.count(r.u32())
@@ -879,17 +928,29 @@ def _dec_list_tasks_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
         state = r.u8()
         if kind >= len(_TASK_KINDS) or state >= len(_TASK_STATES):
             raise WireError("bad task kind/state code")
-        tasks.append({
+        row = {
             "task_id": tid.hex(), "kind": _TASK_KINDS[kind],
             "state": _TASK_STATES[state], "name": r.s(),
             "node_id": r.s(), "pending_reason": r.s(),
             "retries_left": r.i32(), "cancelled": bool(r.u8()),
             "ts_submit": r.f64(), "ts_dispatch": r.f64(),
             "ts_finish": r.f64(),
-        })
+        }
+        if forensic:
+            row["failure_cause"] = r.s()
+            row["failure_error"] = r.s()
+        tasks.append(row)
     r.done()
     return {"ok": True, "tasks": tasks, "total": total,
             "truncated": truncated, "rpc_id": rpc_id}
+
+
+def _dec_list_tasks_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_list_tasks_resp_rows(r, rpc_id, forensic=False)
+
+
+def _dec_list_tasks_resp2(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_list_tasks_resp_rows(r, rpc_id, forensic=True)
 
 
 def _enc_pg_status_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
@@ -1068,6 +1129,42 @@ def _dec_ha_status_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
             "repl_seq": repl_seq, "peers": peers, "rpc_id": rpc_id}
 
 
+# CANCEL_TASK field-presence flags.
+_CANCEL_TASK_ID = 1
+_CANCEL_OBJECT_ID = 2
+_CANCEL_FORCE = 4
+
+
+def _enc_cancel_task(msg, peer_wire: int = WIRE_VERSION
+                     ) -> Optional[List[bytes]]:
+    if peer_wire < 6:
+        return None  # pre-v6 peer can't parse 0x1B: pickle carries it
+    task_id = msg.get("task_id")
+    object_id = msg.get("object_id")
+    flags = ((_CANCEL_TASK_ID if task_id is not None else 0)
+             | (_CANCEL_OBJECT_ID if object_id is not None else 0)
+             | (_CANCEL_FORCE if msg.get("force") else 0))
+    out = [_head(CANCEL_TASK, msg.get("rpc_id")), _U8.pack(flags)]
+    if task_id is not None:
+        out.append(_b8(task_id))
+    if object_id is not None:
+        out.append(_b8(object_id))
+    return out
+
+
+def _dec_cancel_task(r: _Reader, rpc_id) -> Dict[str, Any]:
+    flags = r.u8()
+    out: Dict[str, Any] = {"type": "cancel_task",
+                           "force": bool(flags & _CANCEL_FORCE),
+                           "rpc_id": rpc_id}
+    if flags & _CANCEL_TASK_ID:
+        out["task_id"] = r.b8()
+    if flags & _CANCEL_OBJECT_ID:
+        out["object_id"] = r.b8()
+    r.done()
+    return out
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -1086,6 +1183,7 @@ _ENCODERS = {
     "repl_record": _enc_repl_record,
     "repl_tail": _enc_repl_tail,
     "ha_status": _enc_ha_status,
+    "cancel_task": _enc_cancel_task,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -1123,11 +1221,13 @@ _DECODERS = {
     PROFILE_STACKS: _dec_profile_stacks,
     LIST_TASKS: _dec_list_tasks,
     LIST_TASKS_RESP: _dec_list_tasks_resp,
+    LIST_TASKS_RESP2: _dec_list_tasks_resp2,
     REPL_RECORD: _dec_repl_record,
     REPL_TAIL: _dec_repl_tail,
     REPL_TAIL_RESP: _dec_repl_tail_resp,
     HA_STATUS: _dec_ha_status,
     HA_STATUS_RESP: _dec_ha_status_resp,
+    CANCEL_TASK: _dec_cancel_task,
 }
 
 

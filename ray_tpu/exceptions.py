@@ -105,6 +105,43 @@ class TaskCancelledError(RayTpuError):
         return (type(self), (self.task_id,))
 
 
+class TaskTimeoutError(RayTpuError):
+    """A task exceeded its ``.options(timeout_s=...)`` deadline and was
+    killed by the controller (SIGTERM, then SIGKILL). Deadline kills are the
+    workload's fault, so they do NOT consume ``max_retries`` unless the task
+    opted in with ``retry_on_timeout=True``."""
+
+    def __init__(self, task_id=None, timeout_s=None):
+        self.task_id = task_id
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"Task exceeded its deadline of {timeout_s}s and was killed "
+            f"(task_id={task_id})")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id, self.timeout_s))
+
+
+class TaskPoisonedError(RayTpuError):
+    """The function fingerprint was quarantined after repeated worker-fatal
+    failures (``RAY_TPU_POISON_THRESHOLD`` strikes); submissions fail fast
+    instead of churning worker respawns. Clear with
+    ``cli quarantine --clear <fingerprint>``."""
+
+    def __init__(self, fn_id=None, name=None, strikes=0):
+        self.fn_id = fn_id
+        self.name = name
+        self.strikes = strikes
+        super().__init__(
+            f"Function {name or '?'} (fingerprint="
+            f"{fn_id.hex() if isinstance(fn_id, bytes) else fn_id}) is "
+            f"quarantined after {strikes} worker-fatal failures; clear with "
+            f"`cli quarantine --clear`")
+
+    def __reduce__(self):
+        return (type(self), (self.fn_id, self.name, self.strikes))
+
+
 class ActorExitError(BaseException):
     """Control-flow exception raised by ``exit_actor()`` — intentionally a
     BaseException so user ``except Exception`` blocks can't swallow it
@@ -138,6 +175,8 @@ __all__ = [
     "NodeDiedError",
     "GetTimeoutError",
     "TaskCancelledError",
+    "TaskTimeoutError",
+    "TaskPoisonedError",
     "RuntimeEnvError",
     "ClusterUnavailableError",
 ]

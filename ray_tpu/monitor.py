@@ -225,7 +225,8 @@ class Monitor:
         self.gcs = RpcClient(host, int(port))
         self.load_metrics = LoadMetrics()
         self.autoscaler = StandardAutoscaler(
-            provider, self.load_metrics, autoscaler_config)
+            provider, self.load_metrics, autoscaler_config,
+            drain_fn=self._drain_node)
         self.update_interval_s = update_interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -241,6 +242,22 @@ class Monitor:
         self.slo_results: List[Dict[str, Any]] = []
         self._slo_last = 0.0
         self.slo_interval_s = 10.0
+
+    def _drain_node(self, provider_node_id: str) -> bool:
+        """Autoscaler scale-down hook: start (or check) a graceful drain of
+        the GCS node backing this provider node. Returns True once the
+        node has fully retired (or was never registered), so the
+        autoscaler can terminate the provider instance; False while the
+        drain is still in progress."""
+        nodes = self.gcs.call({"type": "list_nodes"})["nodes"]
+        row = next((n for n in nodes
+                    if (n.get("Label") or n["NodeID"]) == provider_node_id),
+                   None)
+        if row is None or not row["Alive"]:
+            return True  # never joined, or already retired
+        if not row.get("Draining"):
+            self.gcs.call({"type": "drain_node", "node_id": row["NodeID"]})
+        return False
 
     def poll_once(self) -> None:
         nodes = self.gcs.call({"type": "list_nodes"})["nodes"]

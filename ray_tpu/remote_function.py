@@ -46,13 +46,20 @@ class RemoteFunction:
                 resources: Optional[Dict[str, float]] = None,
                 max_retries: Optional[int] = None, name: Optional[str] = None,
                 placement_group=None,
-                placement_group_bundle_index: int = -1):
+                placement_group_bundle_index: int = -1,
+                timeout_s: Optional[float] = None,
+                retry_on_timeout: bool = False):
         """Per-call-site overrides; returns a submit-only wrapper.
 
         ``placement_group`` pins the task into a reserved bundle: its
         demand is rewritten to the group-scoped resource names, so it can
         only run on the bundle's node, consuming the bundle's reservation
-        (``placement_group_bundle_index=-1`` = any bundle of the group)."""
+        (``placement_group_bundle_index=-1`` = any bundle of the group).
+
+        ``timeout_s`` sets an execution deadline: the controller kills the
+        task (SIGTERM, then SIGKILL) once it has run that long and the ref
+        resolves to ``TaskTimeoutError``. Deadline kills don't consume
+        ``max_retries`` unless ``retry_on_timeout=True``."""
         parent = self
 
         class _Options:
@@ -63,6 +70,7 @@ class RemoteFunction:
                     resources=resources, max_retries=max_retries, name=name,
                     placement_group=placement_group,
                     placement_group_bundle_index=placement_group_bundle_index,
+                    timeout_s=timeout_s, retry_on_timeout=retry_on_timeout,
                 )
 
         return _Options()
@@ -72,7 +80,8 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs, *, num_returns=None, num_cpus=None,
                 num_tpus=None, resources=None, max_retries=None, name=None,
-                placement_group=None, placement_group_bundle_index=-1):
+                placement_group=None, placement_group_bundle_index=-1,
+                timeout_s=None, retry_on_timeout=False):
         worker = global_worker()
         worker.check_connected()
         core = worker.core
@@ -110,6 +119,8 @@ class RemoteFunction:
             placement_group_id=(placement_group.id
                                 if placement_group is not None else None),
             placement_group_bundle_index=placement_group_bundle_index,
+            timeout_s=timeout_s,
+            retry_on_timeout=retry_on_timeout,
         )
         refs = core.submit_task(self._function, spec)
         if spec.num_returns == 1:

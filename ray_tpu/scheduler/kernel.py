@@ -93,10 +93,16 @@ def schedule_dag(
     avail: jax.Array,       # [N, R] int32 per-node available resources
     key: jax.Array,         # threefry PRNGKey
     locality: Optional[jax.Array] = None,  # [T] int32 preferred node or -1
+    node_mask: Optional[jax.Array] = None,  # [N] bool, False = unschedulable
     chunk: int = 8192,
     max_rounds: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Schedule a whole DAG; returns (placement [T], num_rounds)."""
+    """Schedule a whole DAG; returns (placement [T], num_rounds).
+
+    ``node_mask`` hides nodes from every placement decision without
+    removing their rows (a draining node's held shares must stay visible
+    to the residual accounting): a False node is infeasible for every
+    task. ``None`` keeps the unmasked trace (and its jit cache entry)."""
     T, R = demand.shape
     N = avail.shape[0]
     if max_rounds <= 0:
@@ -110,8 +116,13 @@ def schedule_dag(
 
     # Tasks that cannot fit on any idle node are permanently infeasible
     # (reference: INFEASIBLE queue, scheduling_queue.h:31-68). Their
-    # descendants simply never become ready.
-    feas_any = (demand[:, None, :] <= avail[None, :, :]).all(-1).any(-1)
+    # descendants simply never become ready. A draining (masked) node is
+    # treated as unable to fit anything; the control plane reclassifies
+    # such tasks against schedulable totals, so the code is transient.
+    feas_any = (demand[:, None, :] <= avail[None, :, :]).all(-1)
+    if node_mask is not None:
+        feas_any = feas_any & node_mask.astype(bool)[None, :]
+    feas_any = feas_any.any(-1)
     placement0 = jnp.where(feas_any, NO_PLACEMENT, INFEASIBLE).astype(jnp.int32)
 
     # Pad one sentinel row so gathers with index T are harmless.
@@ -137,6 +148,8 @@ def schedule_dag(
         d = demand_p[idx]                                              # [C, R]
 
         feas = (d[:, None, :] <= avail[None, :, :]).all(-1) & valid[:, None]  # [C, N]
+        if node_mask is not None:
+            feas = feas & node_mask.astype(bool)[None, :]
         cnt = feas.sum(-1)                                             # [C]
 
         bits = task_bits(key, round_idx, idx)
@@ -547,8 +560,12 @@ class BatchScheduler:
         self._check_overflow_bound()
 
     def place(self, demand: np.ndarray,
-              locality: Optional[np.ndarray] = None) -> np.ndarray:
-        """Place one tick's pending tasks; returns node index or -1 each."""
+              locality: Optional[np.ndarray] = None,
+              node_mask: Optional[np.ndarray] = None) -> np.ndarray:
+        """Place one tick's pending tasks; returns node index or -1 each.
+
+        ``node_mask`` (bool [N], False = draining/unschedulable) hides
+        nodes from this tick; ``None`` keeps the unmasked jit cache key."""
         T = demand.shape[0]
         parents = jnp.full((T, 1), -1, jnp.int32)
         key = jax.random.fold_in(self.key, self._tick)
@@ -556,6 +573,8 @@ class BatchScheduler:
         placement, _ = schedule_dag(
             jnp.asarray(demand, jnp.int32), parents, self.avail, key,
             locality=None if locality is None else jnp.asarray(locality, jnp.int32),
+            node_mask=None if node_mask is None
+            else jnp.asarray(np.asarray(node_mask, bool)),
             chunk=self.chunk, max_rounds=1,
         )
         return np.asarray(placement)

@@ -38,6 +38,7 @@ def schedule_dag_reference(
     avail: np.ndarray,
     key,
     locality: Optional[np.ndarray] = None,
+    node_mask: Optional[np.ndarray] = None,
     chunk: int = 8192,
     max_rounds: int = 0,
 ) -> Tuple[np.ndarray, int]:
@@ -50,8 +51,13 @@ def schedule_dag_reference(
         max_rounds = T + 1
     if locality is None:
         locality = np.full(T, -1, dtype=np.int64)
+    # Schedulable-node mask (False = draining): a masked node is
+    # infeasible for every task, same spec as the kernel's node_mask.
+    mask = (np.ones(N, dtype=bool) if node_mask is None
+            else np.asarray(node_mask, dtype=bool))
 
-    feas_any = (demand[:, None, :] <= avail[None, :, :]).all(-1).any(-1)
+    feas_any = ((demand[:, None, :] <= avail[None, :, :]).all(-1)
+                & mask[None, :]).any(-1)
     placement = np.where(feas_any, NO_PLACEMENT, INFEASIBLE).astype(np.int64)
 
     round_idx = 0
@@ -75,7 +81,7 @@ def schedule_dag_reference(
         survivors = []  # (pick, demand_sum, j, t) for deferred tasks
         used = np.zeros((N, R), dtype=np.int64)
         for j, t in enumerate(ready_idx):
-            feas = (demand[t] <= avail).all(axis=1)
+            feas = (demand[t] <= avail).all(axis=1) & mask
             cnt = int(feas.sum())
             if cnt == 0:
                 continue

@@ -296,8 +296,9 @@ def cmd_status(args) -> None:
         res = gcs.call({"type": "cluster_resources"})
         print(f"nodes: {sum(n['Alive'] for n in nodes)} alive / {len(nodes)}")
         for n in nodes:
-            state = "ALIVE" if n["Alive"] else "DEAD"
-            print(f"  {n['NodeID'][:12]} {state:<6} {n['Resources']}")
+            state = ("DRAINING" if n["Alive"] and n.get("Draining")
+                     else "ALIVE" if n["Alive"] else "DEAD")
+            print(f"  {n['NodeID'][:12]} {state:<8} {n['Resources']}")
         print(f"total resources:     {res['total']}")
         print(f"available resources: {res['available']}")
         groups = gcs.call({"type": "list_placement_groups"})["groups"]
@@ -479,6 +480,18 @@ def cmd_task(args) -> None:
             reason = t["pending_reason"] or "unclassified"
             why = _WHY_PENDING.get(reason, "")
             print(f"why pending: {reason} — {why}")
+        if t.get("timeout_s"):
+            print(f"deadline {t['timeout_s']}s")
+        if t.get("failure_cause"):
+            line = f"failure cause: {t['failure_cause']}"
+            if t.get("failure_error"):
+                line += f" — {t['failure_error']}"
+            print(line)
+        q = t.get("quarantined_fn")
+        if q:
+            print(f"function QUARANTINED after {q.get('strikes', 0)} "
+                  f"worker-fatal strikes "
+                  f"(clear: cli quarantine --clear {q.get('fn_id', '')[:16]})")
     finally:
         gcs.close()
 
@@ -895,11 +908,100 @@ def cmd_kill_random_node(args) -> None:
     try:
         nodes = [n for n in gcs.call({"type": "list_nodes"})["nodes"]
                  if n["Alive"]]
+        if getattr(args, "worker", False):
+            # Worker-scoped chaos: SIGKILL one worker process on a random
+            # node via its controller — exercises blame attribution and
+            # the collateral re-drive path instead of whole-node death.
+            from ray_tpu.cluster.protocol import RpcClient
+
+            if not nodes:
+                raise SystemExit("no alive node to pick a worker from")
+            victim = random.choice(nodes)
+            host, port = victim["Address"]
+            ctrl = RpcClient(host, int(port))
+            try:
+                resp = ctrl.call({"type": "kill_worker"})
+            finally:
+                ctrl.close()
+            print(f"killed worker pid={resp.get('pid')} "
+                  f"on node {victim['NodeID'][:12]}")
+            return
         if len(nodes) <= 1:
             raise SystemExit("refusing: would kill the only alive node")
         victim = random.choice(nodes[1:])  # never the head's first node
         gcs.call({"type": "report_node_dead", "node_id": victim["NodeID"]})
         print(f"marked node dead: {victim['NodeID'][:12]}")
+    finally:
+        gcs.close()
+
+
+def cmd_drain(args) -> None:
+    """Gracefully retire a node: mask it out of placement, wait for its
+    running tasks, re-home sole-copy objects, then remove it — a planned
+    scale-down with zero task failures (vs kill_random_node's crash)."""
+    gcs = _gcs_client(args.address)
+    try:
+        msg = {"type": "drain_node", "node_id": args.node}
+        if args.timeout is not None:
+            msg["timeout_s"] = args.timeout
+        resp = gcs.call(msg)
+        node_id = resp["node_id"]
+        if resp.get("already_draining"):
+            print(f"node {node_id[:12]} is already draining")
+        else:
+            print(f"draining node {node_id[:12]} ...")
+        if args.no_wait:
+            return
+        # The GCS drains in the background; poll until the node retires.
+        deadline = time.monotonic() + (args.timeout or 60.0) + 30.0
+        while time.monotonic() < deadline:
+            rows = gcs.call({"type": "list_nodes"})["nodes"]
+            row = next((n for n in rows if n["NodeID"] == node_id), None)
+            if row is None or not row["Alive"]:
+                print(f"node {node_id[:12]} drained and removed")
+                return
+            time.sleep(0.5)
+        raise SystemExit(f"timed out waiting for {node_id[:12]} to drain")
+    finally:
+        gcs.close()
+
+
+def cmd_quarantine(args) -> None:
+    """Show (or clear) the poison-task quarantine: functions whose workers
+    died fatally RAY_TPU_POISON_THRESHOLD times; their submissions fail
+    fast with TaskPoisonedError instead of crashing more workers."""
+    gcs = _gcs_client(args.address)
+    try:
+        if args.clear is not None:
+            msg = {"type": "clear_quarantine"}
+            if args.clear:  # empty string = clear everything
+                msg["fn_id"] = args.clear
+            cleared = gcs.call(msg)["cleared"]
+            if not cleared:
+                print("nothing matched — no quarantine entries cleared")
+                return
+            for ent in cleared:
+                print(f"cleared {ent.get('fn_id', '')[:16]} "
+                      f"{ent.get('name', '')}")
+            return
+        resp = gcs.call({"type": "list_quarantine"})
+        rows = resp.get("quarantined", [])
+        print(f"{len(rows)} quarantined function(s) "
+              f"(threshold {resp.get('threshold')})")
+        for ent in rows:
+            print(f"  {ent.get('fn_id', '')[:16]:<18} "
+                  f"strikes={ent.get('strikes', 0)} "
+                  f"{ent.get('name', '')}")
+            if ent.get("last_error"):
+                print(f"      last: {ent['last_error'][:120]}")
+        strikes = [srow for srow in resp.get("strikes", [])
+                   if not any(q.get("fn_id") == srow["fn_id"]
+                              for q in rows)]
+        if strikes:
+            print(f"{len(strikes)} function(s) with sub-threshold strikes:")
+            for srow in strikes:
+                print(f"  {srow['fn_id'][:16]:<18} "
+                      f"count={srow['count']} {srow.get('name', '')}")
     finally:
         gcs.close()
 
@@ -1118,7 +1220,34 @@ def main(argv: Optional[List[str]] = None) -> None:
                             help="SIGKILL the head process instead (the "
                                  "failover drill; needs a session started "
                                  "by `cli start`/`cli up`)")
+            sp.add_argument("--worker", action="store_true",
+                            help="SIGKILL one worker process on a random "
+                                 "node instead of the whole node (the "
+                                 "blame-attribution drill)")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("drain", help="gracefully retire a node: no new "
+                                      "placements, wait for running tasks, "
+                                      "re-home sole-copy objects, remove")
+    sp.add_argument("node", help="node id (hex prefix accepted)")
+    sp.add_argument("--address")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="seconds to wait for running tasks before "
+                         "relocating them (default RAY_TPU_DRAIN_TIMEOUT_S "
+                         "or 60)")
+    sp.add_argument("--no-wait", action="store_true",
+                    help="issue the drain and return without polling")
+    sp.set_defaults(fn=cmd_drain)
+
+    sp = sub.add_parser("quarantine",
+                        help="poison-task quarantine table "
+                             "(functions that keep killing workers)")
+    sp.add_argument("--address")
+    sp.add_argument("--clear", nargs="?", const="", default=None,
+                    metavar="FN_ID",
+                    help="lift quarantine: --clear <fn_id prefix>, or "
+                         "--clear with no value for all entries")
+    sp.set_defaults(fn=cmd_quarantine)
 
     sp = sub.add_parser("trace", help="per-task straggler report "
                                       "(sampled trace table)")

@@ -205,6 +205,100 @@ def head_failover(duration_s: float) -> int:
     return iters
 
 
+def hostile_workload(duration_s: float) -> int:
+    """~2% hostile task mix under steady load (ISSUE 14 — the blast-radius
+    drill): hangers shot by the deadline killer, crash-loopers quarantined
+    after three strikes, allocator bombs shot by the OOM guard, and a
+    random worker SIGKILLed every 10s — while the healthy majority
+    completes with zero loss and the consistency auditor stays clean."""
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.exceptions import (
+        TaskPoisonedError, TaskTimeoutError, WorkerCrashedError)
+
+    MB = 1 << 20
+
+    @ray_tpu.remote
+    def work(i):
+        return i * i
+
+    hang = ray_tpu.remote(chaos.hostile_hang)
+    segv = ray_tpu.remote(chaos.hostile_segfault)
+    oom = ray_tpu.remote(chaos.hostile_oom)
+
+    def setup():
+        c = Cluster(
+            head_resources={"CPU": 2, "memory": 2048 * MB}, num_workers=2,
+            extra_env={
+                # Injected kills: blamed tasks retry but never count a
+                # poison strike (cause="chaos"); 10s cadence keeps the
+                # odds of 4 consecutive hits on one task negligible.
+                "RAY_TPU_CHAOS_KILL_WORKER_EVERY_S": "10",
+                "RAY_TPU_OOM_GRACE_S": "1.0",
+            })
+        ray_tpu.init(address=c.address, ignore_reinit_error=True)
+        return {"cluster": c, "poisoned": False, "iters": 0}
+
+    def body(state, i):
+        state["iters"] = i + 1
+        healthy = [work.remote(j) for j in range(96)]
+        h_ref = hang.options(timeout_s=1.5).remote(600.0)
+        s_ref = segv.options(max_retries=0).remote()
+        o_refs = []
+        if i % 3 == 0:
+            o_refs.append(oom.options(
+                max_retries=0, resources={"memory": 48 * MB}).remote(
+                    target_bytes=256 * MB, hold_s=30.0))
+        # zero healthy loss, exact results, despite sharing workers with
+        # every hostile task above (collateral deaths re-drive for free)
+        out = ray_tpu.get(healthy, timeout=120)
+        assert out == [j * j for j in range(96)]
+        try:
+            ray_tpu.get(h_ref, timeout=60)
+            raise RuntimeError("hostile hang escaped its deadline")
+        except TaskTimeoutError:
+            pass
+        try:
+            ray_tpu.get(s_ref, timeout=60)
+            raise RuntimeError("segfaulting task returned a value")
+        except (WorkerCrashedError, TaskPoisonedError) as e:
+            state["poisoned"] |= isinstance(e, TaskPoisonedError)
+        for r in o_refs:
+            try:
+                ray_tpu.get(r, timeout=90)
+                raise RuntimeError("oom bomb escaped the guard")
+            except (WorkerCrashedError, TaskPoisonedError):
+                pass
+
+    def teardown(state):
+        try:
+            # Three strikes land within the first three iterations, so any
+            # run long enough must have flipped to fail-fast poisoning.
+            if state["iters"] >= 5 and not state["poisoned"]:
+                raise RuntimeError(
+                    "crash-looper was never quarantined "
+                    f"({state['iters']} iterations)")
+            if state["iters"] >= 2:
+                from ray_tpu.cluster.protocol import RpcClient
+
+                time.sleep(2.0)  # let the reaper settle the last kills
+                resp = RpcClient(
+                    "127.0.0.1", state["cluster"].gcs_port).call(
+                        {"type": "run_audit", "verify": True}, timeout=180.0)
+                findings = resp.get("findings", [])
+                if findings:
+                    raise RuntimeError(
+                        f"doctor found {len(findings)} inconsistencies "
+                        f"after the hostile soak: {findings[:5]}")
+        finally:
+            ray_tpu.shutdown()
+            state["cluster"].shutdown()
+
+    return _loop("hostile_workload", duration_s, body,
+                 setup=setup, teardown=teardown)
+
+
 _DRIVER_SCRIPT = """
 import sys
 import ray_tpu
@@ -389,13 +483,15 @@ WORKLOADS = {
     "actor_deaths": actor_deaths,
     "node_failures": node_failures,
     "head_failover": head_failover,
+    "hostile_workload": hostile_workload,
     "serve_failure": serve_failure,
     "lm_serve": lm_serve,
     "pbt": pbt,
 }
 # Workloads that own their cluster; a leftover local-mode runtime would
 # make their cluster connect a silent no-op.
-_STANDALONE = {"node_failures", "head_failover", "many_drivers"}
+_STANDALONE = {"node_failures", "head_failover", "many_drivers",
+               "hostile_workload"}
 
 
 def main(argv=None):

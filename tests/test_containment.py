@@ -1,0 +1,409 @@
+"""Blast-radius containment (ISSUE 14): task deadlines & cancellation,
+poison-task quarantine, the worker OOM guard, and graceful node drain.
+
+Modeled on the reference's test_cancel / test_failure suites plus the
+node-drain path of test_autoscaler: every containment mechanism is driven
+end-to-end against real processes, and each failure must stay typed,
+attributed, and contained to the offending task.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu.cluster.testing import Cluster
+from ray_tpu.exceptions import (
+    TaskPoisonedError,
+    TaskTimeoutError,
+    WorkerCrashedError,
+)
+
+MB = 1 << 20
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_resources={"CPU": 2, "memory": 2048 * MB},
+                num_workers=2,
+                extra_env={"RAY_TPU_OOM_GRACE_S": "0.5"})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _gcs():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().core.gcs
+
+
+def _events(kind, timeout=0.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        evs = [e for e in _gcs().call(
+            {"type": "get_events", "limit": 500})["events"]
+            if e.get("kind") == kind]
+        if evs or time.monotonic() >= deadline:
+            return evs
+        time.sleep(0.2)
+
+
+def _attempt_marker():
+    """A path whose file accumulates one line per task attempt."""
+    return tempfile.mktemp(prefix="ray_tpu_attempts_")
+
+
+def _attempts(path):
+    if not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        return len(f.readlines())
+
+
+def _make_hang_after_marking():
+    @ray_tpu.remote
+    def hang_after_marking(path, seconds=300.0):
+        with open(path, "a") as f:
+            f.write("attempt\n")
+        time.sleep(seconds)
+        return "survived"
+
+    return hang_after_marking
+
+
+# --------------------------------------------------------------- deadlines
+
+class TestDeadlines:
+    def test_deadline_kills_hung_task(self, cluster):
+        marker = _attempt_marker()
+        ref = _make_hang_after_marking().options(
+            timeout_s=1.0).remote(marker)
+        with pytest.raises(TaskTimeoutError) as ei:
+            ray_tpu.get(ref, timeout=90)
+        assert ei.value.timeout_s == 1.0
+        assert _events("task_deadline_kill")
+
+    def test_deadline_does_not_consume_retries(self, cluster):
+        """Without retry_on_timeout, a deadline kill fails the ref on the
+        FIRST expiry — max_retries budget notwithstanding."""
+        marker = _attempt_marker()
+        ref = _make_hang_after_marking().options(
+            timeout_s=1.0, max_retries=3).remote(marker)
+        with pytest.raises(TaskTimeoutError):
+            ray_tpu.get(ref, timeout=90)
+        time.sleep(1.0)  # a buggy retry would re-run and re-mark by now
+        assert _attempts(marker) == 1
+
+    def test_retry_on_timeout_consumes_retries(self, cluster):
+        """retry_on_timeout=True opts the deadline into the ordinary retry
+        budget: the hung first attempt is killed, the retry succeeds."""
+        marker = _attempt_marker()
+
+        @ray_tpu.remote
+        def hang_first_attempt(path):
+            with open(path, "a") as f:
+                f.write("attempt\n")
+            with open(path) as f:
+                if len(f.readlines()) == 1:
+                    time.sleep(300)
+            return "second attempt wins"
+
+        ref = hang_first_attempt.options(
+            timeout_s=2.0, retry_on_timeout=True, max_retries=2,
+        ).remote(marker)
+        assert ray_tpu.get(ref, timeout=120) == "second attempt wins"
+        assert _attempts(marker) == 2
+
+    def test_deadline_failure_attributed_in_task_table(self, cluster):
+        marker = _attempt_marker()
+        ref = _make_hang_after_marking().options(
+            timeout_s=1.0).remote(marker)
+        with pytest.raises(TaskTimeoutError):
+            ray_tpu.get(ref, timeout=90)
+        rows = _gcs().call({"type": "list_tasks", "limit": 500})["tasks"]
+        mine = [r for r in rows if "hang_after_marking" in r["name"]]
+        assert mine, rows
+        assert mine[0]["failure_cause"] == "deadline"
+        assert "deadline" in mine[0]["failure_error"]
+
+    def test_deadline_never_counts_a_poison_strike(self, cluster):
+        """Slowness is not poison: repeated deadline kills of one function
+        must never trip quarantine."""
+        hang = ray_tpu.remote(chaos.hostile_hang)
+        for _ in range(4):  # past RAY_TPU_POISON_THRESHOLD=3
+            with pytest.raises(TaskTimeoutError):
+                ray_tpu.get(hang.options(timeout_s=0.5).remote(300.0),
+                            timeout=90)
+        resp = _gcs().call({"type": "list_quarantine"})
+        assert resp["quarantined"] == []
+
+
+def test_local_mode_deadline(local_ray):
+    """Local mode can't kill a thread, but the watchdog must still resolve
+    the ref to the same typed error at expiry."""
+    hang = ray_tpu.remote(chaos.hostile_hang)
+    ref = hang.options(timeout_s=0.5).remote(30.0)
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=30)
+
+
+# -------------------------------------------------------------- quarantine
+
+class TestQuarantine:
+    def test_crash_looper_quarantined_then_cleared(self, cluster):
+        segv = ray_tpu.remote(chaos.hostile_segfault)
+
+        # Two fatal strikes, each a plain worker crash...
+        for _ in range(2):
+            with pytest.raises(WorkerCrashedError):
+                ray_tpu.get(segv.options(max_retries=0).remote(),
+                            timeout=90)
+        # ...the third strike trips the breaker: its own report comes back
+        # poisoned (the circuit stops the crash loop at the threshold).
+        with pytest.raises(TaskPoisonedError):
+            ray_tpu.get(segv.options(max_retries=0).remote(), timeout=90)
+        # ...and with the circuit open, submissions fail fast: no worker
+        # is sacrificed, so the error arrives in single-digit seconds.
+        t0 = time.monotonic()
+        with pytest.raises(TaskPoisonedError) as ei:
+            ray_tpu.get(segv.options(max_retries=0).remote(), timeout=90)
+        assert time.monotonic() - t0 < 10.0
+        assert ei.value.strikes >= 3
+
+        resp = _gcs().call({"type": "list_quarantine"})
+        assert len(resp["quarantined"]) == 1
+        assert _events("task_quarantined")
+
+        # clear_quarantine closes the circuit again: the next submission
+        # reaches a worker (and crashes it honestly).
+        _gcs().call({"type": "clear_quarantine"})
+        assert _gcs().call({"type": "list_quarantine"})["quarantined"] == []
+        with pytest.raises(WorkerCrashedError):
+            ray_tpu.get(segv.options(max_retries=0).remote(), timeout=90)
+
+    def test_collateral_neighbors_not_charged(self, cluster):
+        """A crasher sharing the cluster with healthy tasks must not cost
+        them results or retries — collateral deaths re-drive for free."""
+        segv = ray_tpu.remote(chaos.hostile_segfault)
+
+        @ray_tpu.remote
+        def healthy(i):
+            time.sleep(0.05)
+            return i * i
+
+        refs = [healthy.remote(i) for i in range(40)]
+        crash_refs = [segv.options(max_retries=0).remote()
+                      for _ in range(2)]
+        assert ray_tpu.get(refs, timeout=120) == \
+            [i * i for i in range(40)]
+        for r in crash_refs:
+            with pytest.raises((WorkerCrashedError, TaskPoisonedError)):
+                ray_tpu.get(r, timeout=90)
+
+
+# --------------------------------------------------------------- oom guard
+
+@pytest.mark.slow
+class TestOomGuard:
+    def test_oom_offender_killed_neighbor_spared(self, cluster):
+        """The hog (declared 32MB, resident ~256MB) dies; the neighbor with
+        an honest declaration finishes untouched."""
+        oom = ray_tpu.remote(chaos.hostile_oom)
+
+        @ray_tpu.remote
+        def neighbor():
+            time.sleep(8.0)
+            return "spared"
+
+        n_ref = neighbor.options(
+            resources={"CPU": 1, "memory": 1024 * MB}).remote()
+        hog = oom.options(
+            max_retries=0, resources={"CPU": 1, "memory": 32 * MB},
+        ).remote(target_bytes=256 * MB, hold_s=120.0)
+
+        with pytest.raises(WorkerCrashedError) as ei:
+            ray_tpu.get(hog, timeout=120)
+        assert "memory budget" in str(ei.value)
+        assert ray_tpu.get(n_ref, timeout=120) == "spared"
+        assert _events("worker_oom_kill")
+
+    def test_oom_attributed_in_task_table(self, cluster):
+        oom = ray_tpu.remote(chaos.hostile_oom)
+        ref = oom.options(
+            max_retries=0, resources={"CPU": 1, "memory": 32 * MB},
+        ).remote(target_bytes=256 * MB, hold_s=120.0)
+        with pytest.raises(WorkerCrashedError):
+            ray_tpu.get(ref, timeout=120)
+        rows = _gcs().call({"type": "list_tasks", "limit": 500})["tasks"]
+        mine = [r for r in rows if "hostile_oom" in r["name"]]
+        assert mine and mine[0]["failure_cause"] == "oom"
+
+
+# ------------------------------------------------------------------- drain
+
+@pytest.mark.slow
+class TestDrain:
+    def test_drain_waits_for_running_tasks(self, cluster):
+        """Drain mid-batch: every task pinned to the draining node must
+        still return; the node retires only afterwards."""
+        cluster.add_node(resources={"CPU": 2, "pin": 4}, num_workers=2)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"CPU": 1, "pin": 1})
+        def pinned(i):
+            time.sleep(1.5)
+            return i * 3
+
+        refs = [pinned.remote(i) for i in range(4)]
+        time.sleep(0.5)  # let the first wave start running
+        nodes = _gcs().call({"type": "list_nodes"})["nodes"]
+        target = next(n for n in nodes
+                      if n["Resources"].get("pin"))
+        resp = _gcs().call({"type": "drain_node",
+                            "node_id": target["NodeID"],
+                            "timeout_s": 60.0})
+        assert resp["ok"]
+
+        # zero task failures despite the planned retirement
+        assert ray_tpu.get(refs, timeout=120) == [i * 3 for i in range(4)]
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = _gcs().call({"type": "list_nodes"})["nodes"]
+            row = next((n for n in rows
+                        if n["NodeID"] == target["NodeID"]), None)
+            if row is None or not row["Alive"]:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("drained node never retired")
+        assert _events("node_drained")
+
+    def test_drain_masks_new_placements(self, cluster):
+        """While draining, the node is invisible to the placement kernel:
+        fresh work lands only on the survivors."""
+        cluster.add_node(resources={"CPU": 2, "pin": 2}, num_workers=2)
+        cluster.wait_for_nodes(2)
+        nodes = _gcs().call({"type": "list_nodes"})["nodes"]
+        target = next(n for n in nodes if n["Resources"].get("pin"))
+        hold = _gcs().call({"type": "drain_node",
+                            "node_id": target["NodeID"],
+                            "timeout_s": 30.0})
+        assert hold["ok"]
+        rows = _gcs().call({"type": "list_nodes"})["nodes"]
+        row = next(n for n in rows if n["NodeID"] == target["NodeID"])
+        assert row["Draining"] is True
+
+        @ray_tpu.remote
+        def post_drain_unit(i):
+            return i + 1
+
+        refs = [post_drain_unit.remote(i) for i in range(20)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(1, 21))
+        placed = [r for r in _gcs().call(
+            {"type": "list_tasks", "limit": 1000})["tasks"]
+            if "post_drain_unit" in r["name"]]
+        assert len(placed) == 20
+        assert all(r["node_id"] != target["NodeID"] for r in placed)
+
+    def test_drain_rescues_sole_copy_objects(self, cluster):
+        """The draining node holds the only copy of a result; drain must
+        re-home it rather than force a lineage re-execution."""
+        cluster.add_node(resources={"CPU": 2, "pin": 1}, num_workers=1)
+        cluster.wait_for_nodes(2)
+        marker = _attempt_marker()
+
+        @ray_tpu.remote(resources={"pin": 1})
+        def produce(path):
+            with open(path, "a") as f:
+                f.write("attempt\n")
+            return list(range(5000))
+
+        ref = produce.remote(marker)
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+        assert ready
+        nodes = _gcs().call({"type": "list_nodes"})["nodes"]
+        target = next(n for n in nodes if n["Resources"].get("pin"))
+        assert _gcs().call({"type": "drain_node",
+                            "node_id": target["NodeID"],
+                            "timeout_s": 30.0})["ok"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = _gcs().call({"type": "list_nodes"})["nodes"]
+            row = next((n for n in rows
+                        if n["NodeID"] == target["NodeID"]), None)
+            if row is None or not row["Alive"]:
+                break
+            time.sleep(0.3)
+        assert ray_tpu.get(ref, timeout=120) == list(range(5000))
+        assert _attempts(marker) == 1, "object was re-executed, not rescued"
+
+    def test_drain_status_and_idempotence(self, cluster):
+        cluster.add_node(resources={"CPU": 1, "pin": 1}, num_workers=1)
+        cluster.wait_for_nodes(2)
+        nodes = _gcs().call({"type": "list_nodes"})["nodes"]
+        target = next(n for n in nodes if n["Resources"].get("pin"))
+        r1 = _gcs().call({"type": "drain_node",
+                          "node_id": target["NodeID"][:12],
+                          "timeout_s": 30.0})
+        assert r1["ok"] and not r1["already_draining"]
+        # second call is a no-op: still draining (already_draining) or the
+        # drain already finished and the node is no longer alive (refused,
+        # which the rpc client surfaces as RuntimeError).
+        try:
+            r2 = _gcs().call({"type": "drain_node",
+                              "node_id": target["NodeID"][:12],
+                              "timeout_s": 30.0})
+            assert r2["already_draining"]
+        except RuntimeError as e:
+            assert "not alive" in str(e)
+        with pytest.raises(RuntimeError, match="no such node"):
+            _gcs().call({"type": "drain_node", "node_id": "zz-none"})
+
+
+# ---------------------------------------------------------------- overhead
+
+
+@pytest.mark.slow
+def test_containment_overhead_smoke():
+    """Guards the hot path: arming a deadline on EVERY task (spec v3
+    encode + controller arm/disarm bookkeeping, with the OOM guard and
+    quarantine checks always on) must cost < 2% warm batched throughput
+    vs plain submissions.
+
+    Deadline arming is driver+controller-side state on the same warm
+    cluster, so both arms run interleaved inside ONE cluster (the
+    cross-cluster variance dwarfs the budget). Best-of-4 windows per arm
+    damps co-tenant noise, mirroring test_tracing_overhead_smoke."""
+    c = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        armed = noop.options(timeout_s=60.0)
+        ray_tpu.get([noop.remote() for _ in range(20)], timeout=60)
+        ray_tpu.get([noop.remote() for _ in range(500)], timeout=120)
+
+        def window(fn) -> float:
+            t0 = time.perf_counter()
+            ray_tpu.get([fn.remote() for _ in range(1000)], timeout=120)
+            return 1000 / (time.perf_counter() - t0)
+
+        best = {"off": 0.0, "on": 0.0}
+        for _ in range(4):
+            best["off"] = max(best["off"], window(noop))
+            best["on"] = max(best["on"], window(armed))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+    off, on = best["off"], best["on"]
+    assert on >= 0.98 * off, (
+        f"per-task deadline arming cost {(1 - on / off) * 100:.1f}% warm "
+        f"throughput (off={off:.0f}/s on={on:.0f}/s, budget 2%)")

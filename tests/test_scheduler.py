@@ -23,15 +23,18 @@ from ray_tpu.scheduler.dag import chain_rounds_dag, fanout_dag
 from ray_tpu.scheduler.kernel import INFEASIBLE, NO_PLACEMENT
 
 
-def run_both(demand, parents, avail, seed=0, locality=None, chunk=256):
+def run_both(demand, parents, avail, seed=0, locality=None, node_mask=None,
+             chunk=256):
     key = jax.random.PRNGKey(seed)
     kp, kr = schedule_dag(
         np.asarray(demand), np.asarray(parents), np.asarray(avail), key,
         locality=None if locality is None else np.asarray(locality),
+        node_mask=None if node_mask is None else np.asarray(node_mask),
         chunk=chunk,
     )
     rp, rr = schedule_dag_reference(
-        demand, parents, avail, key, locality=locality, chunk=chunk
+        demand, parents, avail, key, locality=locality,
+        node_mask=node_mask, chunk=chunk
     )
     return np.asarray(kp), int(kr), rp, rr
 
@@ -71,6 +74,46 @@ class TestKernelVsReference:
         avail = uniform_cluster(4, cpu=8)
         kp, kr, rp, rr = run_both(demand, parents, avail, seed=7, chunk=128)
         np.testing.assert_array_equal(kp, rp)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_node_mask_bit_identical(self, seed):
+        """Drain masking (ISSUE 14): schedule_dag with a random node_mask
+        must stay bit-identical to schedule_dag_reference — masked and
+        unmasked tasks alike."""
+        rng = np.random.default_rng(seed)
+        demand, parents = random_dag(1500, seed=seed)
+        n_nodes = 12
+        avail = uniform_cluster(n_nodes)
+        mask = rng.random(n_nodes) < 0.7
+        mask[int(rng.integers(n_nodes))] = True  # never mask everything
+        kp, kr, rp, rr = run_both(demand, parents, avail, seed=seed,
+                                  node_mask=mask)
+        np.testing.assert_array_equal(kp, rp)
+        assert kr == rr
+
+    def test_node_mask_with_locality_bit_identical(self):
+        """Locality hints pointing AT a masked node must resolve the same
+        way in schedule_dag and schedule_dag_reference."""
+        demand, parents = random_dag(800, seed=9)
+        avail = uniform_cluster(8)
+        rng = np.random.default_rng(9)
+        locality = rng.integers(-1, 8, size=800).astype(np.int32)
+        mask = np.ones(8, dtype=bool)
+        mask[[2, 5]] = False
+        kp, kr, rp, rr = run_both(demand, parents, avail, seed=9,
+                                  locality=locality, node_mask=mask)
+        np.testing.assert_array_equal(kp, rp)
+
+    def test_none_mask_matches_all_true_mask(self):
+        """node_mask=None (the hot path, cached jit entry) and an all-True
+        mask are the same schedule."""
+        demand, parents = random_dag(600, seed=11)
+        avail = uniform_cluster(6)
+        kp0, kr0, _, _ = run_both(demand, parents, avail, seed=11)
+        kp1, kr1, _, _ = run_both(demand, parents, avail, seed=11,
+                                  node_mask=np.ones(6, dtype=bool))
+        np.testing.assert_array_equal(kp0, kp1)
+        assert kr0 == kr1
 
 
 class TestSchedulingProperties:
@@ -130,6 +173,26 @@ class TestSchedulingProperties:
         p3, _ = schedule_dag(demand, parents, avail, jax.random.PRNGKey(43))
         assert not np.array_equal(np.asarray(p1), np.asarray(p3))
 
+    def test_masked_nodes_get_nothing(self):
+        # A draining node is invisible to placement: nothing lands on it,
+        # and the surviving nodes absorb the full batch.
+        demand, parents = fanout_dag(200)
+        avail = uniform_cluster(4, cpu=64)
+        mask = np.array([True, False, True, False])
+        placement, _ = schedule_dag(demand, parents, avail,
+                                    jax.random.PRNGKey(0), node_mask=mask)
+        placement = np.asarray(placement)
+        assert (placement >= 0).all()
+        assert not np.isin(placement, [1, 3]).any()
+
+    def test_all_masked_is_infeasible(self):
+        demand, parents = fanout_dag(5)
+        avail = uniform_cluster(3, cpu=8)
+        placement, _ = schedule_dag(
+            demand, parents, avail, jax.random.PRNGKey(0),
+            node_mask=np.zeros(3, dtype=bool))
+        assert (np.asarray(placement) == INFEASIBLE).all()
+
     def test_spread(self):
         # uniform tasks should spread across nodes roughly evenly
         demand, parents = fanout_dag(1024)
@@ -154,6 +217,14 @@ class TestBatchScheduler:
         demand[:, 0] = KILO
         placement = sched.place(demand)
         assert 1 <= (placement >= 0).sum() <= 2  # capacity 2
+
+    def test_place_with_node_mask(self):
+        sched = BatchScheduler(uniform_cluster(2, cpu=8), seed=0)
+        demand = np.zeros((6, 4), dtype=np.int32)
+        demand[:, 0] = KILO
+        placement = sched.place(demand,
+                                node_mask=np.array([False, True]))
+        assert (placement == 1).all()  # node 0 is draining
 
     def test_update_node(self):
         sched = BatchScheduler(uniform_cluster(2, cpu=1), seed=0)

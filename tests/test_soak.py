@@ -35,3 +35,13 @@ def test_soak_many_drivers():
 def test_soak_head_failover():
     # Manages its own Cluster + warm standby; kills the leader mid-run.
     assert soak.head_failover(25.0) >= 4
+
+
+@pytest.mark.cluster
+@pytest.mark.slow
+def test_soak_hostile_workload():
+    # Manages its own Cluster; ~2% hostile task mix (hangers, segfault
+    # loopers, oom bombs) plus a 10s random worker killer. The workload
+    # itself asserts zero healthy loss, the right typed error per hostile
+    # task, quarantine engagement, and a clean consistency audit.
+    assert soak.hostile_workload(30.0) >= 4

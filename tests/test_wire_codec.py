@@ -85,6 +85,49 @@ class TestTaskSpecCodec:
             with pytest.raises(wire.WireError):
                 wire.decode_task_spec(blob[:cut])
 
+    def test_deadline_spec_v3_round_trip(self):
+        """timeout_s promotes the spec to v3; retry_on_timeout rides the
+        flags byte; both survive the full and header-only decodes."""
+        rng = random.Random(10)
+        for i in range(30):
+            spec = _rand_spec(rng, i)
+            spec["timeout_s"] = rng.choice([0.25, 30.0, 3600.0])
+            if i % 2:
+                spec["retry_on_timeout"] = True
+            blob = wire.encode_task_spec(spec)
+            assert blob[0] == wire.SPEC_VERSION_DEADLINE
+            for out in (wire.decode_task_spec(blob),
+                        wire.decode_task_spec_header(blob)):
+                assert out["timeout_s"] == spec["timeout_s"]
+                assert bool(out.get("retry_on_timeout")) == bool(i % 2)
+                assert out["task_id"] == spec["task_id"]
+
+    def test_deadline_spec_carries_trace(self):
+        """v3 must not lose the v2 trace extension: both ride together."""
+        spec = _rand_spec(random.Random(11), 0)
+        spec["timeout_s"] = 5.0
+        spec["trace"] = b"\x01" * 16
+        out = wire.decode_task_spec(wire.encode_task_spec(spec))
+        assert out["timeout_s"] == 5.0 and out["trace"] == spec["trace"]
+
+    def test_no_deadline_stays_v1(self):
+        """The common path must not pay the v3 bytes: absent timeout_s
+        encodes the old version and decodes with no deadline keys."""
+        spec = _rand_spec(random.Random(12), 0)
+        blob = wire.encode_task_spec(spec)
+        assert blob[0] == wire.SPEC_VERSION
+        out = wire.decode_task_spec(blob)
+        assert "timeout_s" not in out and "retry_on_timeout" not in out
+
+    def test_truncated_deadline_spec_raises(self):
+        spec = _rand_spec(random.Random(13), 0)
+        spec["timeout_s"] = 1.0
+        spec["retry_on_timeout"] = True
+        blob = wire.encode_task_spec(spec)
+        for cut in (1, 18, len(blob) // 2, len(blob) - 1):
+            with pytest.raises(wire.WireError):
+                wire.decode_task_spec(blob[:cut])
+
 
 class TestMessageRoundTrips:
     def test_submit_batch(self):
@@ -521,12 +564,31 @@ class TestListTasksCodec:
                  "state": "DISPATCHED", "name": f"fn-{i}", "node_id": "n",
                  "pending_reason": "", "retries_left": -1,
                  "cancelled": bool(i % 2), "ts_submit": 1000.5 + i,
-                 "ts_dispatch": 1001.5 + i, "ts_finish": 0.0}
+                 "ts_dispatch": 1001.5 + i, "ts_finish": 0.0,
+                 "failure_cause": "deadline" if i % 2 else "",
+                 "failure_error": f"err-{i}" if i % 2 else ""}
                 for i in range(4)]
         msg = {"ok": True, "tasks": rows, "total": 9, "truncated": True,
                "rpc_id": 7}
         out = _rt(msg, req_type="list_tasks")
         assert out == msg
+
+    def test_list_tasks_resp_v5_peer_gets_pre_forensics_layout(self):
+        """A v5 peer can't parse LIST_TASKS_RESP2: it must receive the
+        original 0x15 layout with the failure columns dropped."""
+        row = {"task_id": (b"\x02" * 16).hex(), "kind": "task",
+               "state": "FAILED", "name": "f", "node_id": "n",
+               "pending_reason": "", "retries_left": 0,
+               "cancelled": False, "ts_submit": 1.0, "ts_dispatch": 2.0,
+               "ts_finish": 3.0, "failure_cause": "oom",
+               "failure_error": "rss over budget"}
+        body = b"".join(wire.encode_response(
+            "list_tasks", {"ok": True, "tasks": [row], "total": 1,
+                           "truncated": False}, peer_wire=5))
+        assert body[1] == wire.LIST_TASKS_RESP
+        out = wire.decode(body)
+        assert "failure_cause" not in out["tasks"][0]
+        assert out["tasks"][0]["state"] == "FAILED"
 
     def test_list_tasks_resp_pending_reason_survives(self):
         row = {"task_id": (b"\x05" * 16).hex(),
@@ -589,6 +651,39 @@ class TestHaCodec:
             {"type": "repl_record", "epoch": 1, "seq": 2,
              "body": b"abcdef"}))
         for cut in (5, len(body) - 1):
+            with pytest.raises(wire.WireError):
+                wire.decode(body[:cut])
+
+
+class TestCancelFrame:
+    """CANCEL_TASK (0x1B, wire v6): field-presence flags carry any mix of
+    task_id / object_id plus the force bit."""
+
+    def test_cancel_round_trips(self):
+        for msg in (
+            {"type": "cancel_task", "task_id": b"T" * 16, "force": False,
+             "rpc_id": 1},
+            {"type": "cancel_task", "object_id": b"R" * 24, "force": True,
+             "rpc_id": 2},
+            {"type": "cancel_task", "task_id": b"t" * 16,
+             "object_id": b"r" * 24, "force": True, "rpc_id": 3},
+        ):
+            out = _rt(dict(msg))
+            for k, v in msg.items():
+                assert out[k] == v, k
+            assert ("task_id" in out) == ("task_id" in msg)
+            assert ("object_id" in out) == ("object_id" in msg)
+
+    def test_pre_v6_peer_gets_pickle_fallback(self):
+        assert wire.encode({"type": "cancel_task", "task_id": b"T" * 16},
+                           peer_wire=5) is None
+
+    def test_truncated_cancel_frames_raise(self):
+        body = b"".join(wire.encode(
+            {"type": "cancel_task", "task_id": b"T" * 16,
+             "object_id": b"R" * 24, "force": True}))
+        assert body[1] == wire.CANCEL_TASK
+        for cut in (10, 11, len(body) // 2, len(body) - 1):
             with pytest.raises(wire.WireError):
                 wire.decode(body[:cut])
 
@@ -659,8 +754,15 @@ _FRAME_CASES = {
         "stacks": {"a.py:f;b.py:g": 2}}),
     wire.LIST_TASKS: ("req", lambda: {
         "type": "list_tasks", "state": "PENDING", "limit": 10}),
-    wire.LIST_TASKS_RESP: (("resp", "list_tasks"), lambda: {
+    wire.LIST_TASKS_RESP: (("resp", "list_tasks", 5), lambda: {
         "ok": True, "total": 0, "truncated": False, "tasks": []}),
+    wire.LIST_TASKS_RESP2: (("resp", "list_tasks"), lambda: {
+        "ok": True, "total": 1, "truncated": False, "tasks": [{
+            "task_id": "00" * 16, "kind": "task", "state": "FAILED",
+            "name": "f", "node_id": "n", "pending_reason": "",
+            "retries_left": 0, "cancelled": False, "ts_submit": 0.0,
+            "ts_dispatch": 0.0, "ts_finish": 0.0,
+            "failure_cause": "deadline", "failure_error": "e"}]}),
     wire.REPL_RECORD: ("req", lambda: {
         "type": "repl_record", "epoch": 3, "seq": 9,
         "body": b"opaque-frame-bytes", "rpc_id": 1}),
@@ -671,6 +773,9 @@ _FRAME_CASES = {
         "ok": True, "epoch": 2, "last_seq": 9, "resync": False,
         "snapshot": None, "snapshot_seq": 0,
         "records": [b"rec-a", b"rec-b"], "rpc_id": 2}),
+    wire.CANCEL_TASK: ("req", lambda: {
+        "type": "cancel_task", "task_id": b"T" * 16,
+        "object_id": b"R" * 24, "force": True, "rpc_id": 5}),
     wire.HA_STATUS: ("req", lambda: {"type": "ha_status", "rpc_id": 3}),
     wire.HA_STATUS_RESP: (("resp", "ha_status"), lambda: {
         "ok": True, "epoch": 4, "is_leader": True, "role": "leader",
@@ -704,7 +809,10 @@ class TestWireFrameCoverage:
         if kind == "req":
             bufs = wire.encode(msg)
         else:
-            bufs = wire.encode_response(kind[1], msg)
+            # optional third element pins the peer wire version (frames
+            # whose modern twin would otherwise supersede them).
+            pw = kind[2] if len(kind) > 2 else wire.WIRE_VERSION
+            bufs = wire.encode_response(kind[1], msg, peer_wire=pw)
         assert bufs is not None, f"no binary encoding for 0x{code:02x}"
         body = b"".join(bufs)
         assert body[0] == wire.MAGIC
