@@ -243,6 +243,7 @@ class LocalActor:
         self.thread.start()
 
     def _fail_spec(self, spec: TaskSpec, error: BaseException):
+        self.runtime._stamp_terminal(spec, "FAILED")
         for oid in spec.return_ids():
             self.runtime.store.put(oid, StoredObject(error=error))
         self.runtime._unpin_args(spec.dependencies())
@@ -252,6 +253,8 @@ class LocalActor:
         creation_spec, cls, args, kwargs = self._creation
         _LOCAL.ctx = WorkerContext(creation_spec.job_id, creation_spec.task_id)
         t0 = time.monotonic()
+        w0 = time.time()
+        self.runtime._stamp_dispatch(creation_spec)
         try:
             resolved_args, resolved_kwargs = self.runtime._resolve_args(args, kwargs)
             self.instance = cls(*resolved_args, **resolved_kwargs)
@@ -264,6 +267,9 @@ class LocalActor:
             )
         except BaseException as e:  # noqa: BLE001 - creation failure is data
             self.creation_error = e
+            self.runtime._stamp_terminal(
+                creation_spec, "FAILED", (w0, time.time()),
+                time.monotonic() - t0)
             err = TaskError(f"{cls.__name__}.__init__", e)
             self.runtime.store.put(creation_spec.return_ids()[0], StoredObject(error=err))
             with self.cv:
@@ -287,6 +293,9 @@ class LocalActor:
                 "actor_creation", cls.__name__, t0, time.monotonic(),
                 actor_id=self.actor_id.hex(),
             )
+        self.runtime._stamp_terminal(
+            creation_spec, "FINISHED", (w0, time.time()),
+            time.monotonic() - t0)
         self.created.set()
 
         if self.is_asyncio:
@@ -388,6 +397,8 @@ class LocalActor:
 
         method = getattr(self.instance, spec.function.qualname)
         t0 = time.monotonic()
+        w0 = time.time()
+        self.runtime._stamp_dispatch(spec)
         try:
             args, kwargs = self.runtime._resolve_args_from_spec(spec)
             result = method(*args, **kwargs)
@@ -402,6 +413,8 @@ class LocalActor:
         except BaseException as e:  # noqa: BLE001
             self.runtime._store_error(spec, TaskError(spec.function.repr_name, e))
         finally:
+            self.runtime._stamp_terminal(
+                spec, "FINISHED", (w0, time.time()), time.monotonic() - t0)
             self.runtime._unpin_args(spec.dependencies())
             self.runtime.events.record(
                 "actor_task", spec.function.repr_name, t0, time.monotonic(),
@@ -526,6 +539,15 @@ class LocalRuntime:
         self._thread_scope_counter = itertools.count(1 << 31)
         self._shutdown = False
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0, "tasks_failed": 0}
+        # Local-mode task records: the same lifecycle/exec stamps the GCS
+        # task table keeps (ts_submit/ts_dispatch/ts_exec_start/
+        # ts_exec_end/ts_finish + the pending-reason ledger), so
+        # state.tasks() and the job profiler work identically in local
+        # runs instead of silently reading zeros. Bounded like GCS
+        # lineage: oldest terminal records evicted past the cap.
+        self._task_records: Dict[str, Dict[str, Any]] = {}
+        self._task_order: deque = deque()
+        self._task_records_max = 20_000
 
         # Reference counting (reference: core_worker/reference_count.h:33).
         # Local python refs = live ObjectRef instances; pins = in-flight task
@@ -599,6 +621,94 @@ class LocalRuntime:
                 out.setdefault(oid.hex(), {})["task_arg_pins"] = n
             return out
 
+    # ---------------------------------------------------------- task records
+    def _task_record(self, spec: TaskSpec) -> Dict[str, Any]:
+        """Get-or-create the lifecycle record for a spec (cluster task-
+        table row shape). Actor methods arrive here lazily from the
+        dispatch thread; plain tasks are created at submit."""
+        tid = spec.task_id.hex()
+        rec = self._task_records.get(tid)
+        if rec is not None:
+            return rec
+        if spec.is_actor_creation:
+            kind = "actor_creation"
+        elif spec.is_actor_task:
+            kind = "actor_method"
+        else:
+            kind = "task"
+        rec = {
+            "task_id": tid,
+            "name": spec.function.repr_name,
+            "kind": kind,
+            "state": "PENDING",
+            "node_id": self.node_id.hex(),
+            "pending_reason": "",
+            "ts_submit": time.time(),
+            "ts_dispatch": 0.0, "ts_exec_start": 0.0,
+            "ts_exec_end": 0.0, "ts_finish": 0.0,
+            "exec_s": 0.0,
+            "reason_s": {},
+            "deps": [oid.binary()[:16].hex()
+                     for oid in spec.dependencies()],
+        }
+        with self._lock:
+            self._task_records[tid] = rec
+            self._task_order.append(tid)
+            while len(self._task_order) > self._task_records_max:
+                self._task_records.pop(self._task_order.popleft(), None)
+        return rec
+
+    def _stamp_ready(self, spec: TaskSpec) -> None:
+        """Deps satisfied → the record's waiting-for-deps stretch closes
+        and the capacity wait opens (PR 7 reason taxonomy)."""
+        rec = self._task_records.get(spec.task_id.hex())
+        if rec is None or rec["state"] != "PENDING":
+            return
+        now = time.time()
+        if rec["pending_reason"] == "waiting-for-deps":
+            ledger = rec["reason_s"]
+            ledger["waiting-for-deps"] = ledger.get(
+                "waiting-for-deps", 0.0) + max(0.0, now - rec["ts_submit"])
+        rec["pending_reason"] = "waiting-for-capacity"
+        rec["_ready_ts"] = now
+
+    def _stamp_dispatch(self, spec: TaskSpec) -> None:
+        rec = self._task_record(spec)
+        if rec["state"] != "PENDING":
+            return
+        now = time.time()
+        t0 = rec.pop("_ready_ts", 0.0)
+        if rec["pending_reason"] == "waiting-for-capacity" and t0:
+            ledger = rec["reason_s"]
+            ledger["waiting-for-capacity"] = ledger.get(
+                "waiting-for-capacity", 0.0) + max(0.0, now - t0)
+        rec["pending_reason"] = ""
+        rec["state"] = "DISPATCHED"
+        rec["ts_dispatch"] = now
+
+    def _stamp_terminal(self, spec: TaskSpec, state: str,
+                        exec_win: Tuple[float, float] = (0.0, 0.0),
+                        exec_s: float = 0.0) -> None:
+        """Terminal stamp — used by EVERY end-of-life path (finish, task
+        error, cancel, deadline expiry, dead-actor fast-fail) so
+        durations never silently read 0. First terminal wins the state
+        and ts_finish; a later exec window (deadline zombie finishing
+        after the watchdog already failed the task) still lands."""
+        rec = self._task_record(spec)
+        if exec_win[1] > 0.0:
+            rec["ts_exec_start"], rec["ts_exec_end"] = exec_win
+            rec["exec_s"] = exec_s
+        if rec["state"] in ("FINISHED", "FAILED"):
+            return
+        rec["state"] = state
+        rec["pending_reason"] = ""
+        rec["ts_finish"] = time.time()
+
+    def task_rows(self) -> List[Dict[str, Any]]:
+        """Snapshot every record (state.tasks()' local-mode source)."""
+        with self._lock:
+            return [dict(rec) for rec in self._task_records.values()]
+
     # ------------------------------------------------------------------ tasks
     def submit_task(self, fn: Callable, spec: TaskSpec) -> List[ObjectRef]:
         from . import tracing
@@ -613,6 +723,9 @@ class LocalRuntime:
         pending = PendingTask(spec, fn, retries_left=spec.max_retries)
         deps = spec.dependencies()
         self._pin_args(deps)
+        rec = self._task_record(spec)
+        if deps:
+            rec["pending_reason"] = "waiting-for-deps"
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("runtime is shut down")
@@ -634,6 +747,7 @@ class LocalRuntime:
         self._enqueue_ready(pending)
 
     def _enqueue_ready(self, pending: PendingTask):
+        self._stamp_ready(pending.spec)
         with self._lock:
             klass = pending.spec.resources.key()
             dq = self._ready.get(klass)
@@ -692,6 +806,9 @@ class LocalRuntime:
     def _execute_callable(self, spec: TaskSpec, call: Callable,
                           pending: Optional[PendingTask] = None):
         t0 = time.monotonic()
+        w0 = time.time()
+        self._stamp_dispatch(spec)
+        final_state = "FINISHED"
         timer = None
         if getattr(spec, "timeout_s", None):
             # Local-mode deadline parity: threads can't be killed, so the
@@ -729,8 +846,12 @@ class LocalRuntime:
             if (isinstance(e, WorkerCrashedError) and pending is not None
                     and pending.retries_left > 0):
                 pending.retries_left -= 1
+                rec = self._task_records.get(spec.task_id.hex())
+                if rec is not None:  # retried: back to the pending state
+                    rec["state"] = "PENDING"
                 self._enqueue_ready(pending)
                 return
+            final_state = "FAILED"
             self.stats["tasks_failed"] += 1
             if isinstance(e, (TaskError, ActorDiedError)):
                 err = e  # propagate the original failure through chains
@@ -742,6 +863,10 @@ class LocalRuntime:
             if timer is not None:
                 timer.cancel()
             now = time.monotonic()
+            # Exec window + terminal stamps (ts_finish already set if
+            # the deadline watchdog or a cancel got there first).
+            self._stamp_terminal(spec, final_state,
+                                 (w0, time.time()), now - t0)
             self.events.record(
                 "task", spec.function.repr_name, t0, now,
                 task_id=spec.task_id.hex(),
@@ -813,6 +938,10 @@ class LocalRuntime:
         self._gc_if_unreferenced(spec, oids)
 
     def _store_error(self, spec: TaskSpec, error: BaseException):
+        # Every error path is a terminal lifecycle transition — cancel,
+        # deadline expiry, task exception, dead-actor fail — so the
+        # record is stamped here, at the single sink they all share.
+        self._stamp_terminal(spec, "FAILED")
         oids = spec.return_ids()
         for oid in oids:
             self.store.put(oid, StoredObject(error=error))
@@ -878,10 +1007,12 @@ class LocalRuntime:
             actor = self._actors.get(spec.actor_id)
             seq = self._actor_seq.get(spec.actor_id)
         if actor is None:
+            self._stamp_terminal(spec, "FAILED")
             for oid in spec.return_ids():
                 self.store.put(oid, StoredObject(error=ActorDiedError(spec.actor_id)))
             self._unpin_args(spec.dependencies())
             return refs
+        self._task_record(spec)  # ts_submit at enqueue, not dispatch
         actor.submit(next(seq), spec)
         return refs
 

@@ -1235,16 +1235,18 @@ class NodeController:
                               "lease lost before dispatch", crashed=True)
 
     async def _release(self, task: Dict, exec_s: float = 0.0,
-                       reg_s: float = 0.0, added: Optional[list] = None):
+                       reg_s: float = 0.0, added: Optional[list] = None,
+                       ts_exec: Tuple[float, float] = (0.0, 0.0)):
         if task.get("released"):
             return
         task["released"] = True
         self._report_done(task.get("task_id"), task.get("resources", {}),
-                          exec_s, reg_s, added)
+                          exec_s, reg_s, added, ts_exec)
 
     def _report_done(self, task_id, resources, exec_s: float = 0.0,
                      reg_s: float = 0.0,
-                     added: Optional[list] = None) -> None:
+                     added: Optional[list] = None,
+                     ts_exec: Tuple[float, float] = (0.0, 0.0)) -> None:
         """Coalesce task_done reports into one task_done_batch oneway per
         event-loop pass (mirror of the GCS's assign_batch: at fan-out
         rates the per-task socket write dominated both ends' CPU). The
@@ -1253,6 +1255,8 @@ class NodeController:
         completion + directory updates, not one per object."""
         self._done_buf.append({"task_id": task_id, "resources": resources,
                                "exec_s": exec_s, "reg_s": reg_s,
+                               "ts_exec_start": ts_exec[0],
+                               "ts_exec_end": ts_exec[1],
                                "added": added or []})
         if len(self._done_buf) == 1:
             self._spawn_bg(self._flush_done())
@@ -1542,6 +1546,11 @@ class NodeController:
             w = self.workers.get(pid)
             exec_s = float(msg.get("exec_s") or 0.0)
             reg_s = float(msg.get("reg_s") or 0.0)
+            # Wall-clock execution window, stamped by the worker on every
+            # completion (wire v7): rides the done item to the GCS task
+            # table for the job profiler's timeline.
+            ts_exec = (float(msg.get("ts_exec_start") or 0.0),
+                       float(msg.get("ts_exec_end") or 0.0))
             reported = False
             for rid in msg.get("return_ids", []):
                 self._unborrow_call_refs(rid)
@@ -1558,7 +1567,8 @@ class NodeController:
                         # Coalesced with queued-task completions.
                         self._report_done(done.get("task_id"), {},
                                           exec_s, reg_s,
-                                          None if reported else added)
+                                          None if reported else added,
+                                          ts_exec)
                         reported = True
                     elif "method" not in done:
                         # Queued task: return the pipeline claim + local
@@ -1567,7 +1577,8 @@ class NodeController:
                         self._release_local(done)
                         if not done.get("released"):
                             await self._release(done, exec_s, reg_s,
-                                                None if reported else added)
+                                                None if reported else added,
+                                                ts_exec)
                             reported = True
                 task = w.current_task
                 w.current_task = None
@@ -1584,7 +1595,8 @@ class NodeController:
                     self._release_local(task)
                     if not task.get("released"):
                         await self._release(task, exec_s, reg_s,
-                                            None if reported else added)
+                                            None if reported else added,
+                                            ts_exec)
                         reported = True
             if not reported:
                 # Actor-method completion (or an unknown worker): no done

@@ -2035,6 +2035,24 @@ class ClusterCoreWorker:
         return self.gcs.call({"type": "run_audit", "verify": verify},
                              timeout=timeout)
 
+    def list_jobs(self) -> Dict[str, Any]:
+        """Per-job rollup over the GCS task table: {jobs: [...]}, each
+        row task/state counts, submit/finish bounds, and — for jobs the
+        profiler tick already analyzed — efficiency figures."""
+        return self.gcs.call({"type": "list_jobs"})
+
+    def job_profile(self, job_id: Optional[str] = None,
+                    include_rows: bool = False,
+                    timeout: float = 120.0) -> Dict[str, Any]:
+        """Critical-path profile of one job (hex prefix accepted;
+        omitted = the only job): {profile, rows?}. ``include_rows``
+        pulls every task row too — the Chrome-trace export's input."""
+        msg: Dict[str, Any] = {"type": "job_profile",
+                               "include_rows": bool(include_rows)}
+        if job_id:
+            msg["job_id"] = str(job_id)
+        return self.gcs.call(msg, timeout=timeout)
+
     def shutdown(self):
         self._flush_submits()
         self._release_all_leases()

@@ -223,6 +223,17 @@ class GcsServer:
         self._audit_seen: Set[Tuple] = set()
         self._audit_seen_order: Any = _deque()
         self._last_audit: Dict[str, Any] = {}
+        # ---- Job profiler (critical-path / blocked-time attribution).
+        # _job_profiles: job hex -> last computed profile (bounded,
+        # oldest-evicted); _jobs_to_profile: jobs that went fully
+        # terminal and await a profile pass (drained a few per tick, and
+        # only once the warm scheduler import has landed so the tick
+        # never triggers the jax module chain on the event loop).
+        self._job_profiles: Dict[str, Dict[str, Any]] = {}
+        self._jobs_to_profile: Set[str] = set()
+        self._jobs_nonterminal_prev: Set[str] = set()
+        self._jobs_seen_ever: Set[str] = set()
+        self._last_job_profile: Optional[Dict[str, Any]] = None
         # ---- Placement groups (all-or-nothing gang scheduling). Each
         # record: pg_id, bundles, strategy, state (PENDING -> CREATED ->
         # REMOVED / RESCHEDULING), per-bundle node ids, pending reason
@@ -1069,6 +1080,7 @@ class GcsServer:
         if self._last_audit:
             self.timeseries.add_gauge("audit_findings",
                                       self._last_audit.get("total", 0))
+        self._tick_job_gauges()
         # Head-HA series: leadership epoch, standby replication lag (as
         # observed by the leader serving repl_tail), promotions, and the
         # last failover's time-to-recover — the SLO engine and `cli top`
@@ -1094,6 +1106,19 @@ class GcsServer:
                 description="replication-ring bytes the standby has not "
                             "fetched yet").record(
                 float(self._standby_lag_bytes))
+            if self._last_job_profile:
+                from ..metrics import job_profiler_metrics
+
+                jm = job_profiler_metrics()
+                prof = self._last_job_profile
+                jm["efficiency"].record(float(prof["efficiency"]))
+                jm["makespan"].record(float(prof["makespan_s"]))
+                jm["critical_exec"].record(
+                    float(prof["critical_exec_s"]))
+                for bucket, secs in (prof.get("blocked_s")
+                                     or {}).items():
+                    jm["blocked"].record(float(secs),
+                                         tags={"bucket": bucket})
         except Exception:  # noqa: BLE001 - metrics never fail rollups
             pass
 
@@ -1120,6 +1145,108 @@ class GcsServer:
                 import traceback
 
                 traceback.print_exc()
+
+    # ----------------------------------------------- job profiler
+    @staticmethod
+    def _job_of(tid: bytes) -> str:
+        """Job hex of a task id (TaskID = lineage-hash[:12] + job/actor(4);
+        _private/ids.py). Empty for malformed ids."""
+        return tid[12:16].hex() if len(tid) >= 16 else ""
+
+    def _job_rows(self, job: str) -> List[Dict[str, Any]]:
+        """Snapshot one job's task rows in the state-API shape
+        ``scheduler.critical_path.profile_rows`` consumes. Dep object
+        ids collapse to their producing task (``oid[:16]``), and a
+        still-open pending stretch is folded into the reason ledger
+        virtually so in-flight jobs attribute correctly too."""
+        now_mono = time.monotonic()
+        rows: List[Dict[str, Any]] = []
+        for tid, r in self.task_table.items():
+            if self._job_of(tid) != job:
+                continue
+            ledger = dict(r.get("reason_s") or {})
+            reason = r.get("pending_reason") or ""
+            t0 = r.get("_reason_mono0", 0.0)
+            if reason and t0:
+                ledger[reason] = ledger.get(reason, 0.0) + \
+                    max(0.0, now_mono - t0)
+            rows.append({
+                "task_id": tid.hex(), "kind": r["kind"],
+                "state": r["state"],
+                "name": r["payload"].get("name") or "",
+                "node_id": r["node_id"] or "",
+                "pending_reason": reason,
+                "ts_submit": float(r.get("ts_submit") or 0.0),
+                "ts_dispatch": float(r.get("ts_dispatch") or 0.0),
+                "ts_finish": float(r.get("ts_finish") or 0.0),
+                "ts_exec_start": float(r.get("ts_exec_start") or 0.0),
+                "ts_exec_end": float(r.get("ts_exec_end") or 0.0),
+                "exec_s": float(r.get("exec_s") or 0.0),
+                "reason_s": ledger,
+                "deps": [o[:16].hex()
+                         for o in r["payload"].get("deps", [])],
+            })
+        return rows
+
+    def _cache_job_profile(self, job: str,
+                           profile: Dict[str, Any]) -> None:
+        self._job_profiles.pop(job, None)
+        self._job_profiles[job] = profile
+        self._last_job_profile = profile
+        while len(self._job_profiles) > 32:
+            self._job_profiles.pop(next(iter(self._job_profiles)))
+
+    def _tick_job_gauges(self) -> None:
+        """Per-tick job accounting: the active-jobs gauge, detection of
+        jobs that just went fully terminal (queued for a profile pass),
+        and the `job_*` gauges off the freshest completed-job profile —
+        the stream the scheduler-efficiency SLO floor reads."""
+        import sys
+
+        nonterminal: Set[str] = set()
+        seen: Set[str] = set()
+        for tid, rec in self.task_table.items():
+            job = self._job_of(tid)
+            if not job:
+                continue
+            seen.add(job)
+            if rec["state"] not in ("FINISHED", "FAILED"):
+                nonterminal.add(job)
+        self.timeseries.add_gauge("jobs_active", len(nonterminal))
+        done = seen - nonterminal
+        for job in done:
+            if job not in self._job_profiles and (
+                    job in self._jobs_nonterminal_prev
+                    or job not in self._jobs_seen_ever):
+                self._jobs_to_profile.add(job)
+        self._jobs_nonterminal_prev = nonterminal
+        self._jobs_seen_ever |= seen
+        # Drain a bounded number of profile passes per tick, and only
+        # after the warm scheduler import landed — profiling must never
+        # be the thing that pulls the jax module chain onto the loop.
+        if self._jobs_to_profile and "ray_tpu.scheduler" in sys.modules:
+            for job in sorted(self._jobs_to_profile)[:4]:
+                self._jobs_to_profile.discard(job)
+                try:
+                    from ..scheduler import critical_path as _cp
+
+                    rows = self._job_rows(job)
+                    if 0 < len(rows) <= 50_000:
+                        self._cache_job_profile(
+                            job, _cp.profile_rows(rows, job_id=job,
+                                                  now=time.time()))
+                except Exception:  # noqa: BLE001 - never kills the tick
+                    pass
+        prof = self._last_job_profile
+        if prof:
+            self.timeseries.add_gauge("job_sched_efficiency",
+                                      prof["efficiency"])
+            self.timeseries.add_gauge("job_makespan_s",
+                                      prof["makespan_s"])
+            self.timeseries.add_gauge("job_critical_exec_s",
+                                      prof["critical_exec_s"])
+            self.timeseries.add_gauge("job_blocked_s",
+                                      prof["blocked_total_s"])
 
     # ----------------------------------------------- consistency auditor
     # Every finding kind the reconciliation pass can emit (the Prometheus
@@ -1447,7 +1574,7 @@ class GcsServer:
                 # Explainability: the record is held OUT of the placement
                 # queue here, so the per-tick classifier never sees it —
                 # attribute the wait directly (cleared on dispatch).
-                rec["pending_reason"] = "waiting-for-deps"
+                self._set_reason(rec, "waiting-for-deps")
                 if rec["cancelled"]:
                     self._fail_record(rec, self._cancel_error(rec))
                     return False
@@ -1499,7 +1626,7 @@ class GcsServer:
                 rec["state"] = "DISPATCHED"
                 rec["direct_dispatch"] = False  # this dispatch holds a share
                 rec["ts_dispatch"] = time.time()
-                rec["pending_reason"] = ""
+                self._set_reason(rec, "")
                 self._trace_placed(rec)
                 if await self._dispatch_to_node(nid, rec):
                     return
@@ -1580,7 +1707,7 @@ class GcsServer:
         rec["state"] = "DISPATCHED"
         rec["direct_dispatch"] = False
         rec["ts_dispatch"] = time.time()
-        rec["pending_reason"] = ""
+        self._set_reason(rec, "")
         self._trace_placed(rec)
         self._queue_assign(nid, rec["payload"])
 
@@ -1715,13 +1842,32 @@ class GcsServer:
         except Exception:  # noqa: BLE001 - metrics must never break policy
             pass
 
+    @staticmethod
+    def _set_reason(rec: Dict[str, Any], name: str) -> None:
+        """Transition a record's pending_reason, folding the outgoing
+        stretch into its per-reason blocked-time ledger (``reason_s``) —
+        the attribution the job profiler buckets a task's queue wait by.
+        Durations are monotonic; the ledger key set is the PR 7 taxonomy
+        (waiting-for-deps / waiting-for-capacity / infeasible /
+        waiting-for-pg / quota-throttled)."""
+        now = time.monotonic()
+        prev = rec.get("pending_reason") or ""
+        t0 = rec.get("_reason_mono0", 0.0)
+        if prev and t0:
+            ledger = rec.get("reason_s")
+            if ledger is None:
+                ledger = rec["reason_s"] = {}
+            ledger[prev] = ledger.get(prev, 0.0) + max(0.0, now - t0)
+        rec["pending_reason"] = name
+        rec["_reason_mono0"] = now if name else 0.0
+
     def _fail_record(self, rec: Dict[str, Any],
                      err: Optional[BaseException] = None,
                      blob: Optional[bytes] = None) -> None:
         """Terminal failure: serve the error straight from the directory."""
         rec["state"] = "FAILED"
         rec["ts_finish"] = time.time()
-        rec["pending_reason"] = ""
+        self._set_reason(rec, "")
         self._unpin_deps(rec)
         if blob is None:
             blob = b"E" + pickle.dumps(err)
@@ -1738,7 +1884,7 @@ class GcsServer:
             return
         rec["state"] = "FINISHED"
         rec["ts_finish"] = time.time()
-        rec["pending_reason"] = ""
+        self._set_reason(rec, "")
         if rec["kind"] == "actor":
             # The creation record doubles as restart lineage; it is dropped
             # when the actor goes terminally DEAD, not by the eviction cap —
@@ -2444,7 +2590,7 @@ class GcsServer:
             name = names[int(code)]
             counts[name] = counts.get(name, 0) + 1
             if rec is not None and rec["state"] == "PENDING":
-                rec["pending_reason"] = name
+                self._set_reason(rec, name)
                 rec["_reason_mono"] = now_mono
         for name, n in counts.items():
             self._stat_add(f"reason:{name}", 0.0, n)
@@ -3534,6 +3680,16 @@ class GcsServer:
             # stale report from a node we already declared dead (and whose
             # task was re-driven elsewhere) must not flip the state.
             if rec is not None and rec["node_id"] == msg["node_id"]:
+                # Worker wall-clock execution window (wire v7, stamped on
+                # every completion): the job profiler's per-task timeline
+                # joins these against ts_submit/ts_dispatch/ts_finish.
+                ts1 = float(msg.get("ts_exec_end") or 0.0)
+                if ts1 > 0.0:
+                    rec["ts_exec_start"] = \
+                        float(msg.get("ts_exec_start") or 0.0)
+                    rec["ts_exec_end"] = ts1
+                if "exec_s" in msg:
+                    rec["exec_s"] = float(msg.get("exec_s") or 0.0)
                 self._finish_record(msg["task_id"])
             elif rec is None and msg.get("task_id"):
                 # Completion beat the owner's direct-task record here:
@@ -3671,6 +3827,14 @@ class GcsServer:
                 self._spawn(self._drive_task(rec))
                 return {"ok": True, "will_retry": True}
             rec["state"] = "FAILED"
+            # Full terminal stamping (lifecycle-gap fix): this path skips
+            # _fail_record because the CONTROLLER stores the error blobs
+            # for a retries-exhausted task, but the record must still get
+            # its ts_finish / reason / dep-pin transitions or state-API
+            # durations read 0 and dep pins leak until eviction.
+            rec["ts_finish"] = time.time()
+            self._set_reason(rec, "")
+            self._unpin_deps(rec)
             self.record_event("task_failed",
                               task_id=rec["task_id"].hex()[:16],
                               reason="retries_exhausted",
@@ -4259,6 +4423,9 @@ class GcsServer:
                 "ts_submit": float(r.get("ts_submit") or 0.0),
                 "ts_dispatch": float(r.get("ts_dispatch") or 0.0),
                 "ts_finish": float(r.get("ts_finish") or 0.0),
+                "ts_exec_start": float(r.get("ts_exec_start") or 0.0),
+                "ts_exec_end": float(r.get("ts_exec_end") or 0.0),
+                "exec_s": float(r.get("exec_s") or 0.0),
                 "failure_cause": r.get("failure_cause") or "",
                 "failure_error": r.get("failure_error") or "",
             }
@@ -4356,6 +4523,84 @@ class GcsServer:
             if fn_id is not None and fn_id in self.quarantined:
                 row["quarantined_fn"] = dict(self.quarantined[fn_id])
             return {"ok": True, "task": row}
+
+        @s.handler("list_jobs")
+        async def list_jobs(msg, conn):
+            """One-scan per-job rollup of the task table (`cli jobs`,
+            dashboard jobs panel): task/state counts, submit/finish
+            bounds, plus the cached profile's efficiency figures for
+            jobs the tick already analyzed."""
+            jobs: Dict[str, Dict[str, Any]] = {}
+            for tid, r in self.task_table.items():
+                job = self._job_of(tid)
+                if not job:
+                    continue
+                row = jobs.setdefault(job, {
+                    "job_id": job, "tasks": 0, "states": {},
+                    "ts_first_submit": 0.0, "ts_last_finish": 0.0})
+                row["tasks"] += 1
+                st = r["state"]
+                row["states"][st] = row["states"].get(st, 0) + 1
+                ts = float(r.get("ts_submit") or 0.0)
+                if ts > 0.0 and (row["ts_first_submit"] == 0.0
+                                 or ts < row["ts_first_submit"]):
+                    row["ts_first_submit"] = ts
+                row["ts_last_finish"] = max(
+                    row["ts_last_finish"],
+                    float(r.get("ts_finish") or 0.0))
+            for job, row in jobs.items():
+                row["active"] = any(
+                    st not in ("FINISHED", "FAILED")
+                    for st in row["states"])
+                prof = self._job_profiles.get(job)
+                if prof:
+                    row["efficiency"] = prof["efficiency"]
+                    row["makespan_s"] = prof["makespan_s"]
+                    row["critical_len"] = prof["critical_len"]
+                    row["critical_exec_s"] = prof["critical_exec_s"]
+            out = sorted(jobs.values(),
+                         key=lambda j: j["ts_first_submit"])
+            return {"ok": True, "jobs": out}
+
+        @s.handler("job_profile")
+        async def job_profile(msg, conn):
+            """Full critical-path profile of one job (hex prefix
+            accepted; omitted = the only job). Detached + off-thread:
+            row assembly snapshots plain values on the loop, then the
+            longest-path passes run in a worker thread so a 20k-task
+            DAG never stalls reads. ``include_rows`` additionally
+            returns every task row — the Chrome-trace export's input."""
+            want = str(msg.get("job_id") or "").lower()
+            all_jobs = sorted({self._job_of(tid)
+                               for tid in self.task_table} - {""})
+            matches = [j for j in all_jobs if j.startswith(want)] \
+                if want else all_jobs
+            if not matches:
+                return {"ok": False,
+                        "error": f"no job matching {want!r}"}
+            if len(matches) > 1:
+                return {"ok": False,
+                        "error": f"{len(matches)} jobs match {want!r}",
+                        "candidates": matches}
+            job = matches[0]
+            rows = self._job_rows(job)
+            if not rows:
+                return {"ok": False, "error": f"job {job} has no tasks"}
+            include_rows = bool(msg.get("include_rows"))
+
+            async def work():
+                from ..scheduler import critical_path as _cp
+
+                profile = await asyncio.to_thread(
+                    _cp.profile_rows, rows, job, time.time())
+                self._cache_job_profile(job, profile)
+                out = {"ok": True, "profile": profile}
+                if include_rows:
+                    out["rows"] = rows
+                return out
+
+            self._detach(msg, conn, work())
+            return None
 
         @s.handler("run_audit")
         async def run_audit(msg, conn):

@@ -56,11 +56,15 @@ MAGIC = 0xBF
 # head-HA frames (REPL_RECORD / REPL_TAIL / REPL_TAIL_RESP / HA_STATUS /
 # HA_STATUS_RESP); v6 adds the cancellation frame (CANCEL_TASK), the
 # deadline fields of task-spec v3, and the forensics task-row frame
-# (LIST_TASKS_RESP2).
+# (LIST_TASKS_RESP2); v7 adds the exec-stamp completion twins
+# (TASK_DONE3 / TASK_DONE_BATCH3): every completion carries worker-side
+# wall-clock ts_exec_start/ts_exec_end so the job profiler can attribute
+# queue vs exec vs registration time exactly, not just on the 1/64 trace
+# sample.
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 6
+WIRE_VERSION = 7
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -117,6 +121,17 @@ CANCEL_TASK = 0x1B
 # forensics pair (failure_cause, failure_error) — who killed the task and
 # why, attributed by the containment machinery.
 LIST_TASKS_RESP2 = 0x1C
+# v7 twins of the completion frames: every completion additionally carries
+# the worker's wall-clock execution window (ts_exec_start/ts_exec_end, two
+# f64 epoch stamps) so per-job timeline assembly is exact on all tasks.
+# Both use the v2 "added" item layout (has-blob flag), so they subsume the
+# inline-result twins when the peer speaks v7.
+TASK_DONE3 = 0x1D
+TASK_DONE_BATCH3 = 0x1E
+# v7 twin of LIST_TASKS_RESP2: each row additionally carries the exec
+# window (ts_exec_start/ts_exec_end f64 pair) and exec_s, so the state
+# API and the job profiler see worker-side stamps without pickle.
+LIST_TASKS_RESP3 = 0x1F
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -152,6 +167,9 @@ FRAME_MIN_WIRE = {
     HA_STATUS_RESP: 5,
     CANCEL_TASK: 6,
     LIST_TASKS_RESP2: 6,
+    TASK_DONE3: 7,
+    TASK_DONE_BATCH3: 7,
+    LIST_TASKS_RESP3: 7,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -512,33 +530,43 @@ def _dec_added_v2(r: _Reader) -> list:
 
 def _enc_task_done_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     items = msg["items"]
+    v3 = any(float(it.get("ts_exec_end") or 0.0) > 0.0 for it in items)
+    if v3 and peer_wire < 7:
+        return None  # pre-v7 peer can't parse exec stamps: pickle carries it
     v2 = any(_added_has_blob(it.get("added") or ()) for it in items)
     if v2 and peer_wire < 2:
         return None  # v1 peer can't parse inline items: pickle carries it
-    code = TASK_DONE_BATCH2 if v2 else TASK_DONE_BATCH
+    code = TASK_DONE_BATCH3 if v3 \
+        else (TASK_DONE_BATCH2 if v2 else TASK_DONE_BATCH)
     out = [_head(code, msg.get("rpc_id")), _s(msg["node_id"]),
            _U32.pack(len(items))]
-    enc_added = _enc_added_v2 if v2 else _enc_added_v1
+    enc_added = _enc_added_v2 if (v2 or v3) else _enc_added_v1
     for it in items:
         out.append(_b8(it.get("task_id") or b""))
         out.append(_resources(it.get("resources") or {}))
         out.append(_F32.pack(float(it.get("exec_s", 0.0))))
         out.append(_F32.pack(float(it.get("reg_s", 0.0))))
+        if v3:
+            out.append(_F64.pack(float(it.get("ts_exec_start") or 0.0)))
+            out.append(_F64.pack(float(it.get("ts_exec_end") or 0.0)))
         enc_added(out, it.get("added") or ())
     return out
 
 
-def _dec_task_done_batch(r: _Reader, rpc_id, v2: bool = False
-                         ) -> Dict[str, Any]:
+def _dec_task_done_batch(r: _Reader, rpc_id, v2: bool = False,
+                         v3: bool = False) -> Dict[str, Any]:
     node_id = r.s()
     n = r.count(r.u32())
-    dec_added = _dec_added_v2 if v2 else _dec_added_v1
+    dec_added = _dec_added_v2 if (v2 or v3) else _dec_added_v1
     items = []
     for _ in range(n):
         tid = r.b8()
         item = {"task_id": tid or None,
                 "resources": _read_resources(r),
                 "exec_s": r.f32(), "reg_s": r.f32()}
+        if v3:
+            item["ts_exec_start"] = r.f64()
+            item["ts_exec_end"] = r.f64()
         item["added"] = dec_added(r)
         items.append(item)
     r.done()
@@ -548,6 +576,10 @@ def _dec_task_done_batch(r: _Reader, rpc_id, v2: bool = False
 
 def _dec_task_done_batch2(r: _Reader, rpc_id) -> Dict[str, Any]:
     return _dec_task_done_batch(r, rpc_id, v2=True)
+
+
+def _dec_task_done_batch3(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_task_done_batch(r, rpc_id, v3=True)
 
 
 def _enc_locations_batch(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
@@ -730,32 +762,48 @@ def _dec_execute_task(r: _Reader, rpc_id) -> Dict[str, Any]:
 
 def _enc_task_done(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
     added = msg.get("added", ())
+    v3 = float(msg.get("ts_exec_end") or 0.0) > 0.0
+    if v3 and peer_wire < 7:
+        return None  # pre-v7 peer can't parse exec stamps: pickle carries it
     v2 = _added_has_blob(added)
     if v2 and peer_wire < 2:
         return None  # v1 peer can't parse inline items: pickle carries it
-    out = [_head(TASK_DONE2 if v2 else TASK_DONE, msg.get("rpc_id")),
+    code = TASK_DONE3 if v3 else (TASK_DONE2 if v2 else TASK_DONE)
+    out = [_head(code, msg.get("rpc_id")),
            _U32.pack(int(msg.get("pid", 0))),
            _oids(msg.get("return_ids", ()))]
-    (_enc_added_v2 if v2 else _enc_added_v1)(out, added)
+    (_enc_added_v2 if (v2 or v3) else _enc_added_v1)(out, added)
     out.append(_F32.pack(float(msg.get("exec_s", 0.0))))
     out.append(_F32.pack(float(msg.get("reg_s", 0.0))))
+    if v3:
+        out.append(_F64.pack(float(msg.get("ts_exec_start") or 0.0)))
+        out.append(_F64.pack(float(msg.get("ts_exec_end") or 0.0)))
     return out
 
 
-def _dec_task_done(r: _Reader, rpc_id, v2: bool = False) -> Dict[str, Any]:
+def _dec_task_done(r: _Reader, rpc_id, v2: bool = False,
+                   v3: bool = False) -> Dict[str, Any]:
     pid = r.u32()
     return_ids = _read_oids(r)
-    added = (_dec_added_v2 if v2 else _dec_added_v1)(r)
+    added = (_dec_added_v2 if (v2 or v3) else _dec_added_v1)(r)
     exec_s = r.f32()
     reg_s = r.f32()
+    out = {"type": "task_done", "pid": pid, "return_ids": return_ids,
+           "added": added, "exec_s": exec_s, "reg_s": reg_s,
+           "rpc_id": rpc_id}
+    if v3:
+        out["ts_exec_start"] = r.f64()
+        out["ts_exec_end"] = r.f64()
     r.done()
-    return {"type": "task_done", "pid": pid, "return_ids": return_ids,
-            "added": added, "exec_s": exec_s, "reg_s": reg_s,
-            "rpc_id": rpc_id}
+    return out
 
 
 def _dec_task_done2(r: _Reader, rpc_id) -> Dict[str, Any]:
     return _dec_task_done(r, rpc_id, v2=True)
+
+
+def _dec_task_done3(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_task_done(r, rpc_id, v3=True)
 
 
 def _enc_pg_create(msg, peer_wire: int = WIRE_VERSION) -> Optional[List[bytes]]:
@@ -883,10 +931,17 @@ def _enc_list_tasks_resp(msg, peer_wire: int = WIRE_VERSION
                          ) -> Optional[List[bytes]]:
     if peer_wire < 4:
         return None
-    # v6 peers get the forensics twin (failure_cause/failure_error per
-    # row); v4-v5 peers still parse the original layout.
+    # v7 peers get the exec-window twin (ts_exec_start/ts_exec_end/exec_s
+    # per row); v6 peers get the forensics twin (failure_cause/
+    # failure_error); v4-v5 peers still parse the original layout.
     forensic = peer_wire >= 6
-    code = LIST_TASKS_RESP2 if forensic else LIST_TASKS_RESP
+    stamped = peer_wire >= 7
+    if stamped:
+        code = LIST_TASKS_RESP3
+    elif forensic:
+        code = LIST_TASKS_RESP2
+    else:
+        code = LIST_TASKS_RESP
     tasks = msg.get("tasks", ())
     out = [_head(code, msg.get("rpc_id")),
            _U32.pack(int(msg.get("total", 0))),
@@ -913,11 +968,15 @@ def _enc_list_tasks_resp(msg, peer_wire: int = WIRE_VERSION
         if forensic:
             out.append(_s(t.get("failure_cause") or ""))
             out.append(_s(t.get("failure_error") or ""))
+        if stamped:
+            out.append(_F64.pack(float(t.get("ts_exec_start", 0.0))))
+            out.append(_F64.pack(float(t.get("ts_exec_end", 0.0))))
+            out.append(_F64.pack(float(t.get("exec_s", 0.0))))
     return out
 
 
-def _dec_list_tasks_resp_rows(r: _Reader, rpc_id, forensic: bool
-                              ) -> Dict[str, Any]:
+def _dec_list_tasks_resp_rows(r: _Reader, rpc_id, forensic: bool,
+                              stamped: bool = False) -> Dict[str, Any]:
     total = r.u32()
     truncated = bool(r.u8())
     n = r.count(r.u32())
@@ -939,6 +998,10 @@ def _dec_list_tasks_resp_rows(r: _Reader, rpc_id, forensic: bool
         if forensic:
             row["failure_cause"] = r.s()
             row["failure_error"] = r.s()
+        if stamped:
+            row["ts_exec_start"] = r.f64()
+            row["ts_exec_end"] = r.f64()
+            row["exec_s"] = r.f64()
         tasks.append(row)
     r.done()
     return {"ok": True, "tasks": tasks, "total": total,
@@ -951,6 +1014,10 @@ def _dec_list_tasks_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
 
 def _dec_list_tasks_resp2(r: _Reader, rpc_id) -> Dict[str, Any]:
     return _dec_list_tasks_resp_rows(r, rpc_id, forensic=True)
+
+
+def _dec_list_tasks_resp3(r: _Reader, rpc_id) -> Dict[str, Any]:
+    return _dec_list_tasks_resp_rows(r, rpc_id, forensic=True, stamped=True)
 
 
 def _enc_pg_status_resp(msg, peer_wire: int = WIRE_VERSION) -> List[bytes]:
@@ -1212,7 +1279,9 @@ _DECODERS = {
     EXECUTE_TASK: _dec_execute_task,
     TASK_DONE: _dec_task_done,
     TASK_DONE2: _dec_task_done2,
+    TASK_DONE3: _dec_task_done3,
     TASK_DONE_BATCH2: _dec_task_done_batch2,
+    TASK_DONE_BATCH3: _dec_task_done_batch3,
     PG_CREATE: _dec_pg_create,
     PG_REMOVE: _dec_pg_remove,
     PG_STATUS: _dec_pg_status,
@@ -1222,6 +1291,7 @@ _DECODERS = {
     LIST_TASKS: _dec_list_tasks,
     LIST_TASKS_RESP: _dec_list_tasks_resp,
     LIST_TASKS_RESP2: _dec_list_tasks_resp2,
+    LIST_TASKS_RESP3: _dec_list_tasks_resp3,
     REPL_RECORD: _dec_repl_record,
     REPL_TAIL: _dec_repl_tail,
     REPL_TAIL_RESP: _dec_repl_tail_resp,

@@ -272,9 +272,16 @@ def main():
     # thread: concurrent actor methods (max_concurrency/asyncio) each
     # accumulate their own adds.
     _pending_adds: Dict[int, list] = {}
-    # Per-thread [exec_s, reg_s] for the task being finished: the phase
-    # profiler's worker-side samples, carried inside task_done.
+    # Per-thread [exec_s, reg_s, ts_exec_start, ts_exec_end] for the task
+    # being finished: monotonic phase durations (the phase profiler's
+    # worker-side samples) plus the wall-clock execution window that the
+    # job profiler joins against the GCS submit/dispatch/finish stamps —
+    # carried inside task_done on EVERY completion, not just traced ones.
     _phase_times: Dict[int, list] = {}
+    # Kill switch (RAY_TPU_EXEC_STAMPS=0): suppress the wall-clock window
+    # so completions ride the pre-v7 frames — the operational escape hatch
+    # and the "off" arm of the stamping-overhead A/B smoke.
+    _exec_stamps_on = os.environ.get("RAY_TPU_EXEC_STAMPS", "1") != "0"
 
     def _store_blob(oid: bytes, blob: bytes) -> None:
         """Result store on the new data plane (see ARCHITECTURE.md
@@ -372,6 +379,11 @@ def main():
                 "added": _pending_adds.pop(threading.get_ident(), []),
                 # Phase profiler samples (execution / result-store wall).
                 "exec_s": phases[0], "reg_s": phases[1],
+                # Wall-clock execution window (job profiler timeline).
+                "ts_exec_start": (phases[2] if len(phases) > 2
+                                  and _exec_stamps_on else 0.0),
+                "ts_exec_end": (phases[3] if len(phases) > 3
+                                and _exec_stamps_on else 0.0),
             })
             return True
         except (ConnectionError, OSError):
@@ -379,7 +391,8 @@ def main():
             return False
 
     def complete_actor_method(msg, result=None, error=None,
-                              exec_s: float = 0.0) -> None:
+                              exec_s: float = 0.0,
+                              exec_win=(0.0, 0.0)) -> None:
         """Store returns (or the error), checkpoint, report task_done.
 
         The store->finish pair runs in ONE thread so the TCP FIFO invariant
@@ -400,7 +413,7 @@ def main():
                 traceback.print_exc()
         finally:
             _phase_times[threading.get_ident()] = \
-                [exec_s, time.monotonic() - t1]
+                [exec_s, time.monotonic() - t1, exec_win[0], exec_win[1]]
             finish(msg)
 
     def record_span(kind: str, name: str, t0: float,
@@ -423,6 +436,7 @@ def main():
         """One actor method: resolve, run, complete. Used inline (plain
         actors) and from pool threads (max_concurrency)."""
         t0 = time.monotonic()
+        w0 = time.time()
         try:
             method = getattr(actor_instance, msg["method"])
             pos, kwargs = resolve_args(msg)
@@ -431,12 +445,14 @@ def main():
                 result = asyncio.run(result)
         except BaseException as e:  # noqa: BLE001 - task errors are data
             complete_actor_method(msg, error=e,
-                                  exec_s=time.monotonic() - t0)
+                                  exec_s=time.monotonic() - t0,
+                                  exec_win=(w0, time.time()))
             return
         finally:
             record_span("actor_task", msg.get("method", "method"), t0,
                         "actor_id", msg.get("actor_id"))
-        complete_actor_method(msg, result, exec_s=time.monotonic() - t0)
+        complete_actor_method(msg, result, exec_s=time.monotonic() - t0,
+                              exec_win=(w0, time.time()))
 
     async def run_actor_method_async(msg) -> None:
         """Coroutine twin for the persistent loop: the method's coroutine is
@@ -445,6 +461,7 @@ def main():
         task_done RPCs) run via asyncio.to_thread so they never stall the
         loop and re-serialize the in-flight coroutines."""
         t0 = time.monotonic()
+        w0 = time.time()
         try:
             pos, kwargs = await asyncio.to_thread(resolve_args, msg)
             method = getattr(actor_instance, msg["method"])
@@ -452,12 +469,16 @@ def main():
             if asyncio.iscoroutine(result):
                 result = await result
         except BaseException as e:  # noqa: BLE001 - task errors are data
-            await asyncio.to_thread(complete_actor_method, msg, None, e)
+            await asyncio.to_thread(
+                complete_actor_method, msg, None, e,
+                time.monotonic() - t0, (w0, time.time()))
             return
         finally:
             record_span("actor_task", msg.get("method", "method"), t0,
                         "actor_id", msg.get("actor_id"))
-        await asyncio.to_thread(complete_actor_method, msg, result)
+        await asyncio.to_thread(
+            complete_actor_method, msg, result, None,
+            time.monotonic() - t0, (w0, time.time()))
 
     # The worker inner loop — one of the flight recorder's top burners, so
     # it is a named, hot-path-linted function: no pickle/json or loud
@@ -506,11 +527,12 @@ def main():
                     pos, kwargs = resolve_args(msg)
                     trace = msg.get("trace")  # sampled task: phase spans
                     t0 = time.monotonic()
+                    w0 = time.time()
                     try:
                         result = fn(*pos, **kwargs)
                     finally:
                         _phase_times[threading.get_ident()] = \
-                            [time.monotonic() - t0, 0.0]
+                            [time.monotonic() - t0, 0.0, w0, time.time()]
                         record_span("task", getattr(fn, "__name__", "task"),
                                     t0, "task_id", msg.get("task_id"))
                         if trace is not None:
@@ -528,9 +550,17 @@ def main():
                 elif mtype == "create_actor_instance":
                     cls = load_function(msg["fn_id"])
                     pos, kwargs = resolve_args(msg)
-                    actor_instance = cls(*pos, **kwargs)
-                    actor_id = msg["actor_id"]
-                    maybe_restore_checkpoint(msg)
+                    t0 = time.monotonic()
+                    w0 = time.time()
+                    try:
+                        actor_instance = cls(*pos, **kwargs)
+                        actor_id = msg["actor_id"]
+                        maybe_restore_checkpoint(msg)
+                    finally:
+                        # Constructor window: actor-creation completions
+                        # carry exec stamps like plain tasks do.
+                        _phase_times[threading.get_ident()] = \
+                            [time.monotonic() - t0, 0.0, w0, time.time()]
                     if msg.get("is_asyncio"):
                         actor_loop = asyncio.new_event_loop()
                         threading.Thread(

@@ -2,6 +2,7 @@
 here a stdlib HTTP server + a single self-contained HTML page).
 
 JSON API: /api/nodes /api/actors /api/objects /api/resources /api/tasks
+/api/jobs (per-job profiler rollup)
 HTML: / renders the same data with auto-refresh.
 
 Works against whatever runtime the driver is connected to (local or cluster):
@@ -92,11 +93,11 @@ function seriesValues(s) {
 }
 async function refresh() {
   const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
-         timeline, events, traces, pgs, timeseries] =
+         timeline, events, traces, pgs, timeseries, jobs] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
        "memory","serve","timeline","events","traces","pgs",
-       "timeseries"].map(
+       "timeseries","jobs"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th>" +
@@ -146,6 +147,29 @@ async function refresh() {
              `<td>${esc(t.name || "")}</td></tr>`;
       h += "</table>";
     }
+  }
+  // job profiler: per-job rollup with scheduler-efficiency ratios
+  // (critical-path exec lower bound / actual makespan; 1.0 = the
+  // scheduler could not have run this DAG any faster).
+  if ((jobs || []).length) {
+    h += `<h2>jobs (${jobs.length})</h2>` +
+         "<table><tr><th>job</th><th>tasks</th><th>active</th>" +
+         "<th>makespan</th><th>efficiency</th><th>critical hops</th>" +
+         "<th>states</th></tr>";
+    for (const j of jobs.slice(0, 25)) {
+      const jst = Object.entries(j.states || {}).map(
+        ([k, v]) => `${k.toLowerCase()}=${v}`).join(" ");
+      h += `<tr><td>${esc(j.job_id || "")}</td>` +
+           `<td class=num>${j.tasks ?? "-"}</td>` +
+           `<td>${j.active ? "yes" : "no"}</td>` +
+           `<td class=num>${j.makespan_s != null ?
+              j.makespan_s.toFixed(2) + "s" : "-"}</td>` +
+           `<td class=num>${j.efficiency != null ?
+              j.efficiency.toFixed(2) : "-"}</td>` +
+           `<td class=num>${j.critical_len ?? "-"}</td>` +
+           `<td>${jst}</td></tr>`;
+    }
+    h += "</table>";
   }
   h += "<h2>nodes</h2><table><tr><th>id</th><th>alive</th><th>resources</th></tr>";
   for (const n of nodes)
@@ -323,6 +347,13 @@ def _collect(endpoint: str):
                         "size": info.get("size_bytes", info.get("size", 0)),
                         "in_directory": True}
         return out
+    if endpoint == "jobs":
+        # Job profiler panel: per-job rollup rows with the cached
+        # efficiency figures (computed by the GCS tick on completion).
+        try:
+            return state.jobs()
+        except Exception:  # noqa: BLE001 - GCS restart window
+            return []
     if endpoint == "metrics":
         from ..metrics import collect_all
 

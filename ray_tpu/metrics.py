@@ -217,6 +217,33 @@ def slo_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def job_profiler_metrics() -> Dict[str, "Metric"]:
+    """``job_*`` series for the per-job critical-path profiler: the
+    scheduler-efficiency ratio of the last completed job (the SLO
+    floor's subject), its makespan, critical-path exec lower bound, and
+    the blocked time attributed on the critical path (by bucket).
+    Lazily registered; idempotent."""
+    return {
+        "efficiency": get_or_create(
+            Gauge, "job_sched_efficiency",
+            description="critical-path lower bound / actual makespan of "
+                        "the last completed job (1.0 = unimprovable)"),
+        "makespan": get_or_create(
+            Gauge, "job_makespan_s",
+            description="wall-clock makespan of the last completed job"),
+        "critical_exec": get_or_create(
+            Gauge, "job_critical_exec_s",
+            description="summed exec seconds along the last completed "
+                        "job's critical path (the makespan lower bound)"),
+        "blocked": get_or_create(
+            Gauge, "job_blocked_s", tag_keys=("bucket",),
+            description="blocked seconds attributed on the critical "
+                        "path, by gap bucket (waiting-for-deps / "
+                        "queue:<reason> / dispatch-to-exec / "
+                        "result-register)"),
+    }
+
+
 def audit_metrics() -> Dict[str, "Metric"]:
     """``audit_*`` series for the GCS consistency auditor: findings per
     kind from the latest reconciliation pass (a gauge — zeros export so

@@ -48,7 +48,10 @@ class SloRule:
         is set, else the newest gauge sample;
       * ``burn``    — error-budget burn rate: the fraction
         bad/(bad+total) over BOTH a short and a long window, divided by
-        ``budget``, must stay <= ``burn_threshold``.
+        ``budget``, must stay <= ``burn_threshold``;
+      * ``gauge-floor`` — the newest gauge sample in the window must
+        stay >= ``threshold`` (no sample in the window = not firing, so
+        a cluster that hasn't produced the gauge yet never pages).
     """
 
     def __init__(self, name: str, kind: str, series: str,
@@ -57,7 +60,7 @@ class SloRule:
                  total_series: str = "", budget: float = 0.01,
                  burn_threshold: float = 1.0,
                  long_window_s: Optional[float] = None):
-        if kind not in ("floor", "ceiling", "burn"):
+        if kind not in ("floor", "ceiling", "burn", "gauge-floor"):
             raise ValueError(f"unknown SLO rule kind {kind!r}")
         self.name = name
         self.kind = kind
@@ -107,6 +110,15 @@ def default_slo_rules() -> List[SloRule]:
                 "gcs_standby_lag_bytes",
                 threshold=_env_f("RAY_TPU_SLO_STANDBY_LAG_BYTES", 4_000_000.0),
                 window_s=60.0),
+        # Job profiler: scheduler-efficiency floor on the last completed
+        # job (critical-path exec lower bound / actual makespan, from
+        # the job_profile pass). A ratio near 0 means the job's
+        # wall-clock went to scheduling gaps — queueing, dep waits,
+        # dispatch latency — rather than compute; the default floor only
+        # pages on pathological jobs, raise it to tighten the bound.
+        SloRule("job_efficiency", "gauge-floor", "job_sched_efficiency",
+                threshold=_env_f("RAY_TPU_SLO_JOB_EFFICIENCY_FLOOR", 0.05),
+                window_s=600.0),
     ]
 
 
@@ -157,6 +169,13 @@ class SloEngine:
                 return out
             out["value"] = gauge[-1].get("last")
             out["firing"] = (out["value"] or 0.0) > rule.threshold
+            return out
+        if rule.kind == "gauge-floor":
+            gauge = [c for t, c in pts if t >= since]
+            if not gauge:
+                return out  # gauge never produced: the floor can't apply
+            out["value"] = gauge[-1].get("last")
+            out["firing"] = (out["value"] or 0.0) < rule.threshold
             return out
         # burn: bad fraction vs budget over short AND long windows.
         total_pts = self._points(payload, rule.total_series)

@@ -14,3 +14,8 @@ from .kernel import (  # noqa: F401
 )
 from .reference import schedule_dag_reference  # noqa: F401
 from .dag import collapse_chains, random_dag, uniform_cluster  # noqa: F401
+from .critical_path import (  # noqa: F401
+    longest_path_ref,
+    longest_path_vec,
+    profile_rows,
+)

@@ -496,6 +496,113 @@ def cmd_task(args) -> None:
         gcs.close()
 
 
+def cmd_jobs(args) -> None:
+    """Per-job rollup over the task table: counts, wall-clock bounds,
+    and — for jobs the GCS profiler already analyzed — the critical
+    path length and scheduler-efficiency ratio."""
+    gcs = _gcs_client(args.address)
+    try:
+        rows = gcs.call({"type": "list_jobs"})["jobs"]
+        if not rows:
+            print("no jobs in the task table")
+            return
+        now = time.time()
+        print(f"{'JOB_ID':<10} {'TASKS':>6} {'ACTIVE':<7} {'AGE':>7} "
+              f"{'SPAN':>8} {'EFF':>6} {'CP':>5}  STATES")
+        for j in rows:
+            span = "-"
+            if j.get("makespan_s"):
+                span = f"{j['makespan_s']:.2f}s"
+            elif j["ts_last_finish"] and j["ts_first_submit"]:
+                span = f"{j['ts_last_finish'] - j['ts_first_submit']:.2f}s"
+            eff = (f"{j['efficiency']:.2f}"
+                   if j.get("efficiency") is not None else "-")
+            cp = str(j.get("critical_len", "-"))
+            states = " ".join(f"{k.lower()}={v}"
+                              for k, v in sorted(j["states"].items()))
+            print(f"{j['job_id']:<10} {j['tasks']:>6} "
+                  f"{('yes' if j.get('active') else 'no'):<7} "
+                  f"{_fmt_age(now, j['ts_first_submit']):>7} {span:>8} "
+                  f"{eff:>6} {cp:>5}  {states}")
+    finally:
+        gcs.close()
+
+
+def cmd_job(args) -> None:
+    """One job's critical-path profile: the longest duration-weighted
+    path to sink, each hop's blocked-time decomposition, the blocked
+    rollup by pending reason, per-node skew, and the
+    scheduler-efficiency ratio (critical-path exec lower bound over
+    actual makespan — 1.0 means no scheduler could have run this DAG's
+    recorded exec times any faster)."""
+    gcs = _gcs_client(args.address)
+    try:
+        msg = {"type": "job_profile",
+               "include_rows": bool(args.timeline)}
+        if args.id:
+            msg["job_id"] = args.id
+        try:
+            resp = gcs.call(msg, timeout=180.0)
+        except RuntimeError as e:
+            raise SystemExit(f"job lookup failed: {e}")
+        prof = resp["profile"]
+        states = " ".join(f"{k.lower()}={v}"
+                          for k, v in sorted(prof["states"].items()))
+        print(f"job      {prof['job_id']}  ({prof['num_tasks']} tasks: "
+              f"{states})")
+        print(f"makespan {prof['makespan_s']:.3f}s   critical path "
+              f"{prof['critical_len']} hops / "
+              f"{prof['critical_exec_s']:.3f}s exec")
+        print(f"scheduler efficiency {prof['efficiency']:.3f}  "
+              f"(critical-path lower bound / actual makespan; "
+              f"1.0 = unimprovable)")
+        blocked = prof.get("blocked_s") or {}
+        if blocked:
+            print("blocked time on the critical path "
+                  f"({prof['blocked_total_s']:.3f}s total):")
+            for name, secs in sorted(blocked.items(),
+                                     key=lambda kv: -kv[1]):
+                print(f"  {name:<28} {secs:>9.3f}s")
+        nodes = prof.get("nodes") or {}
+        if len(nodes) > 1:
+            print(f"node skew {prof['node_skew']:.2f}x "
+                  f"(max node exec / mean):")
+            for node, agg in sorted(nodes.items(),
+                                    key=lambda kv: -kv[1]["exec_s"]):
+                print(f"  {node[:12]:<14} {agg['tasks']:>6} tasks "
+                      f"{agg['exec_s']:>9.3f}s exec")
+        hops = prof.get("critical_path") or []
+        if hops:
+            print(f"critical path ({len(hops)} hops, longest "
+                  f"duration-weighted path to sink):")
+            print(f"  {'TASK_ID':<18} {'NODE':<10} {'EXEC':>8} "
+                  f"{'GAP':>8}  GAP BREAKDOWN / NAME")
+            for h in hops[: args.limit]:
+                parts = " ".join(
+                    f"{k}={v:.3f}s"
+                    for k, v in sorted((h.get("buckets") or {}).items(),
+                                       key=lambda kv: -kv[1]))
+                tail = (parts + "  " if parts else "") + (h["name"] or "")
+                print(f"  {h['task_id'][:16]:<18} "
+                      f"{(h['node_id'] or '-')[:8]:<10} "
+                      f"{h['exec_s']:>7.3f}s {h['gap_s']:>7.3f}s  "
+                      f"{tail}")
+            if len(hops) > args.limit:
+                print(f"  ... {len(hops) - args.limit} more hops "
+                      f"(--limit to see them)")
+        if args.timeline:
+            from ..scheduler.critical_path import chrome_trace
+
+            trace = chrome_trace(resp.get("rows", []),
+                                 job_id=prof["job_id"])
+            with open(args.timeline, "w") as f:
+                json.dump(trace, f)
+            print(f"timeline written to {args.timeline} "
+                  f"(load in Perfetto / chrome://tracing)")
+    finally:
+        gcs.close()
+
+
 def cmd_doctor(args) -> None:
     """Cross-process consistency audit + postmortem bundle. Runs the GCS
     reconciliation pass (object directory vs controller arenas, spill
@@ -1318,6 +1425,23 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("id", help="task id (hex prefix accepted)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_task)
+
+    sp = sub.add_parser("jobs", help="per-job rollup: task counts, "
+                                     "makespan, scheduler efficiency")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("job", help="job critical-path profile: "
+                                    "blocked-time buckets + efficiency")
+    sp.add_argument("id", nargs="?", default="",
+                    help="job id (hex prefix; omit when one job)")
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=40,
+                    help="critical-path hops to print")
+    sp.add_argument("--timeline", metavar="OUT",
+                    help="also write the Chrome-trace/Perfetto JSON "
+                         "timeline to this path")
+    sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser("doctor", help="consistency audit + postmortem "
                                        "bundle (exit 1 on findings)")

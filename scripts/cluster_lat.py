@@ -89,11 +89,17 @@ def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
     return out
 
 
-def one_run(serial_n: int, batch_k: int, record_ts: bool = False) -> dict:
+def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
+            job_report: bool = False) -> dict:
     import ray_tpu
     from ray_tpu.cluster.testing import Cluster
 
-    c = Cluster(num_workers=2)
+    # --job-report profiles the warm batch post-hoc from the GCS task
+    # table; the default lineage cap (max_lineage_size=100) would evict
+    # most of a 5k batch before the profile pass reads it.
+    extra_env = {"RAY_TPU_MAX_LINEAGE_SIZE": str(max(batch_k * 3, 1000))} \
+        if job_report else None
+    c = Cluster(num_workers=2, extra_env=extra_env)
     ray_tpu.init(address=c.address)
     try:
         @ray_tpu.remote
@@ -125,7 +131,10 @@ def one_run(serial_n: int, batch_k: int, record_ts: bool = False) -> dict:
         core = global_worker().core
         ph0 = _phase_snapshot(core)
         t0 = time.perf_counter()
-        ray_tpu.get([noop.remote() for _ in range(batch_k)])
+        # Refs bound so --job-report can profile the warm batch before
+        # result GC sweeps its FINISHED rows out of the task table.
+        warm_refs = [noop.remote() for _ in range(batch_k)]
+        ray_tpu.get(warm_refs)
         dt_warm = time.perf_counter() - t0
         phases = _phase_delta_ms_per_1k(ph0, _phase_snapshot(core))
         out = {"p50_ms": round(pct(.5), 3), "p90_ms": round(pct(.9), 3),
@@ -148,6 +157,25 @@ def one_run(serial_n: int, batch_k: int, record_ts: bool = False) -> dict:
                                          ts.get("driver_totals", {})}
             except Exception as e:  # noqa: BLE001 - snapshot is optional
                 out["timeseries"] = {"error": repr(e)}
+        if job_report:
+            # Critical-path rollup of the whole driver job (--job-report):
+            # the warm-5k batch dominates it, so the efficiency ratio is
+            # the scheduler's figure of merit for pure fan-out — the
+            # critical path is ONE task, everything else is overhead.
+            try:
+                prof = core.job_profile()["profile"]
+                out["job_report"] = {
+                    "makespan_s": round(prof["makespan_s"], 4),
+                    "efficiency": round(prof["efficiency"], 6),
+                    "critical_len": prof["critical_len"],
+                    "critical_exec_s": round(prof["critical_exec_s"], 4),
+                    "blocked_s": {k: round(v, 4)
+                                  for k, v in prof["blocked_s"].items()},
+                    "num_tasks": prof["num_tasks"],
+                }
+            except Exception as e:  # noqa: BLE001 - report is optional
+                out["job_report"] = {"error": repr(e)}
+        del warm_refs
         return out
     finally:
         ray_tpu.shutdown()
@@ -376,6 +404,11 @@ def main():
     ap.add_argument("--record", action="store_true",
                     help="persist the LAST run's GCS time-series snapshot "
                          "next to its phase tables in CLUSTER_LAT.json")
+    ap.add_argument("--job-report", action="store_true",
+                    help="persist the LAST run's job critical-path rollup "
+                         "(makespan, scheduler-efficiency ratio, "
+                         "critical-path length, blocked buckets) in "
+                         "CLUSTER_LAT.json")
     args = ap.parse_args()
 
     if args.traces:
@@ -383,10 +416,14 @@ def main():
         return
 
     runs = []
+    job_rep = None
     for i in range(args.runs):
+        last = i == args.runs - 1
         r = one_run(args.serial, args.batch,
-                    record_ts=args.record and i == args.runs - 1)
+                    record_ts=args.record and last,
+                    job_report=args.job_report and last)
         ts_snap = r.pop("timeseries", None)
+        job_rep = r.pop("job_report", job_rep)
         runs.append(r)
         print(f"# run {i + 1}/{args.runs}: {r}", file=sys.stderr)
 
@@ -435,6 +472,8 @@ def main():
             for r in runs]
     if args.record and runs and ts_snap is not None:
         out["timeseries"] = ts_snap
+    if args.job_report and job_rep is not None:
+        out["job_report"] = job_rep
     if args.sim_nodes:
         rows = []
         for n in (int(x) for x in args.sim_nodes.split(",") if x):

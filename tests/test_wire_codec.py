@@ -566,12 +566,31 @@ class TestListTasksCodec:
                  "cancelled": bool(i % 2), "ts_submit": 1000.5 + i,
                  "ts_dispatch": 1001.5 + i, "ts_finish": 0.0,
                  "failure_cause": "deadline" if i % 2 else "",
-                 "failure_error": f"err-{i}" if i % 2 else ""}
+                 "failure_error": f"err-{i}" if i % 2 else "",
+                 "ts_exec_start": 1001.625 + i, "ts_exec_end": 1001.75 + i,
+                 "exec_s": 0.125}
                 for i in range(4)]
         msg = {"ok": True, "tasks": rows, "total": 9, "truncated": True,
                "rpc_id": 7}
         out = _rt(msg, req_type="list_tasks")
         assert out == msg
+
+    def test_list_tasks_resp_v6_peer_gets_forensics_layout(self):
+        """A v6 peer can't parse LIST_TASKS_RESP3: it must receive the
+        0x1C forensics layout with the exec-stamp columns dropped."""
+        row = {"task_id": (b"\x03" * 16).hex(), "kind": "task",
+               "state": "FINISHED", "name": "f", "node_id": "n",
+               "pending_reason": "", "retries_left": 0,
+               "cancelled": False, "ts_submit": 1.0, "ts_dispatch": 2.0,
+               "ts_finish": 3.0, "failure_cause": "", "failure_error": "",
+               "ts_exec_start": 2.25, "ts_exec_end": 2.875, "exec_s": 0.625}
+        body = b"".join(wire.encode_response(
+            "list_tasks", {"ok": True, "tasks": [row], "total": 1,
+                           "truncated": False}, peer_wire=6))
+        assert body[1] == wire.LIST_TASKS_RESP2
+        out = wire.decode(body)
+        assert "ts_exec_start" not in out["tasks"][0]
+        assert out["tasks"][0]["state"] == "FINISHED"
 
     def test_list_tasks_resp_v5_peer_gets_pre_forensics_layout(self):
         """A v5 peer can't parse LIST_TASKS_RESP2: it must receive the
@@ -622,6 +641,113 @@ class TestListTasksCodec:
         for cut in (11, len(body) - 1):
             with pytest.raises(wire.WireError):
                 wire.decode(body[:cut])
+
+
+class TestExecStampFrames:
+    """v7 exec-stamp twins (job profiler): TASK_DONE3 / TASK_DONE_BATCH3
+    carry the worker's wall-clock execution window on every completion;
+    LIST_TASKS_RESP3 carries the stamps back out through the state API.
+    Pre-v7 peers must get the older layouts (or pickle for completions,
+    which have no stamp-free downgrade once stamps are present)."""
+
+    def test_task_done3_round_trip(self):
+        msg = {"type": "task_done", "pid": 11, "return_ids": [b"R" * 24],
+               "added": [[b"R" * 24, 16]], "exec_s": 0.5, "reg_s": 0.25,
+               "ts_exec_start": 1722.125, "ts_exec_end": 1722.625}
+        body = b"".join(wire.encode(msg))
+        assert body[1] == wire.TASK_DONE3
+        out = wire.decode(body)
+        assert out["ts_exec_start"] == 1722.125
+        assert out["ts_exec_end"] == 1722.625
+        assert abs(out["exec_s"] - 0.5) < 1e-6
+        assert out["added"] == [[b"R" * 24, 16, None]]
+
+    def test_task_done_batch3_round_trip(self):
+        items = [{"task_id": b"T" * 16, "resources": {"CPU": 1.0},
+                  "exec_s": 0.1, "reg_s": 0.2, "ts_exec_start": 10.5,
+                  "ts_exec_end": 10.625, "added": [[b"A" * 24, 5, b"hello"]]},
+                 {"task_id": b"U" * 16, "resources": {}, "exec_s": 0.0,
+                  "reg_s": 0.0, "ts_exec_start": 0.0, "ts_exec_end": 0.0,
+                  "added": []}]
+        msg = {"type": "task_done_batch", "node_id": "n1", "items": items,
+               "rpc_id": 9}
+        body = b"".join(wire.encode(msg))
+        assert body[1] == wire.TASK_DONE_BATCH3
+        out = wire.decode(body)
+        assert out["items"][0]["ts_exec_start"] == 10.5
+        assert out["items"][0]["ts_exec_end"] == 10.625
+        assert out["items"][0]["added"] == [[b"A" * 24, 5, b"hello"]]
+        assert out["items"][1]["ts_exec_end"] == 0.0
+
+    def test_pre_v7_peer_gets_pickle_fallback_for_stamped_completions(self):
+        done = {"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+                "added": [[b"R" * 24, 16]], "exec_s": 0.5, "reg_s": 0.0,
+                "ts_exec_start": 5.0, "ts_exec_end": 5.5}
+        assert wire.encode(done, peer_wire=6) is None
+        batch = {"type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {}, "exec_s": 0.5,
+             "reg_s": 0.0, "ts_exec_start": 5.0, "ts_exec_end": 5.5,
+             "added": []}]}
+        assert wire.encode(batch, peer_wire=6) is None
+
+    def test_stampless_completions_keep_old_frame_codes(self):
+        # No exec window recorded (pre-v7 worker restarting mid-upgrade):
+        # the old codes are emitted so history stays byte-compatible.
+        done = {"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+                "added": [[b"R" * 24, 16]], "exec_s": 0.5, "reg_s": 0.0,
+                "ts_exec_start": 0.0, "ts_exec_end": 0.0}
+        assert b"".join(wire.encode(done))[1] == wire.TASK_DONE
+        batch = {"type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {}, "exec_s": 0.5,
+             "reg_s": 0.0, "added": []}]}
+        assert b"".join(wire.encode(batch))[1] == wire.TASK_DONE_BATCH
+
+    def test_truncated_exec_stamp_frames_raise(self):
+        msgs = [
+            ({"type": "task_done", "pid": 1, "return_ids": [b"R" * 24],
+              "added": [[b"R" * 24, 3, b"abc"]], "exec_s": 0.5,
+              "reg_s": 0.0, "ts_exec_start": 5.0, "ts_exec_end": 5.5},
+             None),
+            ({"type": "task_done_batch", "node_id": "n", "items": [
+                {"task_id": b"T" * 16, "resources": {"CPU": 1.0},
+                 "exec_s": 0.5, "reg_s": 0.0, "ts_exec_start": 5.0,
+                 "ts_exec_end": 5.5, "added": [[b"R" * 24, 9, b"blob"]]}]},
+             None),
+            ({"ok": True, "total": 1, "truncated": False, "rpc_id": 2,
+              "tasks": [{"task_id": "00" * 16, "kind": "task",
+                         "state": "FINISHED", "name": "f", "node_id": "n",
+                         "pending_reason": "", "retries_left": 0,
+                         "cancelled": False, "ts_submit": 1.0,
+                         "ts_dispatch": 2.0, "ts_finish": 3.0,
+                         "failure_cause": "", "failure_error": "",
+                         "ts_exec_start": 2.25, "ts_exec_end": 2.75,
+                         "exec_s": 0.5}]},
+             "list_tasks"),
+        ]
+        for msg, req_type in msgs:
+            if req_type:
+                body = b"".join(wire.encode_response(req_type, msg))
+            else:
+                body = b"".join(wire.encode(msg))
+            for cut in range(0, len(body), max(1, len(body) // 17)):
+                with pytest.raises(wire.WireError):
+                    wire.decode(body[:cut])
+            with pytest.raises(wire.WireError):
+                wire.decode(body + b"\x00")
+
+    def test_garbage_exec_stamp_bodies_raise(self):
+        rng = random.Random(13)
+        for code in (wire.TASK_DONE3, wire.TASK_DONE_BATCH3,
+                     wire.LIST_TASKS_RESP3):
+            for _ in range(50):
+                body = bytes([wire.MAGIC, code]) + bytes(
+                    rng.getrandbits(8) for _ in range(rng.randint(8, 64)))
+                try:
+                    wire.decode(body)
+                except wire.WireError:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    pytest.fail(f"non-WireError escaped decode: {e!r}")
 
 
 class TestHaCodec:
@@ -756,13 +882,30 @@ _FRAME_CASES = {
         "type": "list_tasks", "state": "PENDING", "limit": 10}),
     wire.LIST_TASKS_RESP: (("resp", "list_tasks", 5), lambda: {
         "ok": True, "total": 0, "truncated": False, "tasks": []}),
-    wire.LIST_TASKS_RESP2: (("resp", "list_tasks"), lambda: {
+    wire.LIST_TASKS_RESP2: (("resp", "list_tasks", 6), lambda: {
         "ok": True, "total": 1, "truncated": False, "tasks": [{
             "task_id": "00" * 16, "kind": "task", "state": "FAILED",
             "name": "f", "node_id": "n", "pending_reason": "",
             "retries_left": 0, "cancelled": False, "ts_submit": 0.0,
             "ts_dispatch": 0.0, "ts_finish": 0.0,
             "failure_cause": "deadline", "failure_error": "e"}]}),
+    wire.LIST_TASKS_RESP3: (("resp", "list_tasks"), lambda: {
+        "ok": True, "total": 1, "truncated": False, "tasks": [{
+            "task_id": "00" * 16, "kind": "task", "state": "FINISHED",
+            "name": "f", "node_id": "n", "pending_reason": "",
+            "retries_left": 0, "cancelled": False, "ts_submit": 1.0,
+            "ts_dispatch": 2.0, "ts_finish": 3.0,
+            "failure_cause": "", "failure_error": "",
+            "ts_exec_start": 2.25, "ts_exec_end": 2.75, "exec_s": 0.5}]}),
+    wire.TASK_DONE3: ("req", lambda: {
+        "type": "task_done", "pid": 7, "return_ids": [b"R" * 24],
+        "added": [[b"R" * 24, 5]], "exec_s": 0.5, "reg_s": 0.0,
+        "ts_exec_start": 9.0, "ts_exec_end": 9.5}),
+    wire.TASK_DONE_BATCH3: ("req", lambda: {
+        "type": "task_done_batch", "node_id": "n", "items": [
+            {"task_id": b"T" * 16, "resources": {"CPU": 1.0},
+             "exec_s": 0.5, "reg_s": 0.0, "ts_exec_start": 9.0,
+             "ts_exec_end": 9.5, "added": [[b"R" * 24, 5]]}]}),
     wire.REPL_RECORD: ("req", lambda: {
         "type": "repl_record", "epoch": 3, "seq": 9,
         "body": b"opaque-frame-bytes", "rpc_id": 1}),
