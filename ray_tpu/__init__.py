@@ -34,6 +34,7 @@ from .exceptions import (  # noqa: F401
     ObjectLostError,
     PlacementGroupError,
     RayTpuError,
+    ReplicaUnavailableError,
     TaskCancelledError,
     TaskError,
     TaskPoisonedError,
@@ -97,4 +98,5 @@ __all__ = [
     "TaskTimeoutError",
     "TaskPoisonedError",
     "WorkerCrashedError",
+    "ReplicaUnavailableError",
 ]

@@ -31,6 +31,12 @@ Env knobs (all off by default; read once at :func:`install_from_env`):
     N seconds (armed controller-side in ``Controller.start``) — the
     blast-radius drill: blame attribution, collateral re-drive, and the
     poison-quarantine counters all run under it.
+``RAY_TPU_CHAOS_KILL_REPLICA_EVERY_S``
+    In a serve driver: kill one random replica of a backend every N
+    seconds (armed by :func:`arm_replica_killer`, driven by
+    ``scripts/serve_soak.py``) — the self-healing fleet drill: failover
+    routing, stream fast-fail, and replica auto-replacement all run
+    under it.
 ``RAY_TPU_CHAOS_SEED``
     Deterministic RNG seed for the drop/delay draws.
 
@@ -201,6 +207,46 @@ def resume_process(pid: int) -> bool:
         return True
     except (OSError, ProcessLookupError):
         return False
+
+
+def arm_replica_killer(master: object, backend_tag: str,
+                       every_s: float = 0.0,
+                       stop: Optional[threading.Event] = None,
+                       on_kill=None) -> threading.Event:
+    """In a serve driver: kill one RANDOM live replica of ``backend_tag``
+    every ``every_s`` seconds (env ``RAY_TPU_CHAOS_KILL_REPLICA_EVERY_S``
+    when 0) until the returned Event is set. The self-healing drill:
+    each kill must produce a router failover + a master replacement, not
+    a client-visible failure. ``on_kill(handle)`` is called after each
+    kill (soaks count kills survived)."""
+    import ray_tpu
+
+    stop = stop or threading.Event()
+    every_s = every_s or _env_f("RAY_TPU_CHAOS_KILL_REPLICA_EVERY_S")
+    if every_s <= 0:
+        stop.set()
+        return stop
+    rng = random.Random(int(_env_f("RAY_TPU_CHAOS_SEED")) or None)
+
+    def _loop():
+        while not stop.wait(every_s):
+            try:
+                replicas = ray_tpu.get(
+                    master.get_replicas.remote(backend_tag))
+                if not replicas:
+                    continue
+                victim = rng.choice(replicas)
+                ray_tpu.kill(victim)
+                if on_kill is not None:
+                    on_kill(victim)
+            except Exception:  # noqa: BLE001 - chaos must not crash the soak
+                if not ray_tpu.is_initialized():
+                    return
+
+    t = threading.Thread(target=_loop, name="chaos-replica-killer",
+                         daemon=True)
+    t.start()
+    return stop
 
 
 def arm_head_timers() -> None:

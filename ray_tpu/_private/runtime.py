@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ReplicaUnavailableError,
     TaskCancelledError,
     TaskError,
 )
@@ -411,7 +412,12 @@ class LocalActor:
             self._exit_requested = True
             self._post_method_hooks()
         except BaseException as e:  # noqa: BLE001
-            self.runtime._store_error(spec, TaskError(spec.function.repr_name, e))
+            if isinstance(e, (TaskError, ActorDiedError,
+                              ReplicaUnavailableError)):
+                err = e  # propagate the original failure through chains
+            else:
+                err = TaskError(spec.function.repr_name, e)
+            self.runtime._store_error(spec, err)
         finally:
             self.runtime._stamp_terminal(
                 spec, "FINISHED", (w0, time.time()), time.monotonic() - t0)
@@ -853,7 +859,8 @@ class LocalRuntime:
                 return
             final_state = "FAILED"
             self.stats["tasks_failed"] += 1
-            if isinstance(e, (TaskError, ActorDiedError)):
+            if isinstance(e, (TaskError, ActorDiedError,
+                              ReplicaUnavailableError)):
                 err = e  # propagate the original failure through chains
             else:
                 err = TaskError(spec.function.repr_name, e)

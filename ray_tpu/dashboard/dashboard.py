@@ -291,6 +291,29 @@ async function refresh() {
            `<td class=num>${m.latency_ms_p99 ?? "-"}</td></tr>`;
     }
     h += "</table>";
+    // fleet health: replica states per backend + failover counters
+    const fleet = serve.fleet || {};
+    if (Object.keys(fleet).length) {
+      h += "<h3>fleet</h3><table><tr><th>backend</th><th>target</th>" +
+           "<th>up</th><th>down</th><th>draining</th><th>inflight</th>" +
+           "<th>queued</th><th>autoscale</th></tr>";
+      for (const [tag, f] of Object.entries(fleet)) {
+        const b = (serve.backends || {})[tag] || {};
+        const auto = f.autoscaling ?
+          `${f.min_replicas}..${f.max_replicas}` : "off";
+        h += `<tr><td>${esc(tag)}</td><td class=num>${f.target}</td>` +
+             `<td class=num>${b.up ?? "-"}</td>` +
+             `<td class=num>${b.down ?? 0}</td>` +
+             `<td class=num>${b.draining ?? 0}</td>` +
+             `<td class=num>${b.inflight ?? 0}</td>` +
+             `<td class=num>${b.queued ?? 0}</td><td>${auto}</td></tr>`;
+      }
+      h += "</table>";
+      const cnt = Object.assign({}, serve.counters || {},
+                                serve.fleet_counters || {});
+      h += "<p>" + Object.entries(cnt).map(([k, v]) =>
+        `${esc(k)}=${v}`).join(" &nbsp; ") + "</p>";
+    }
   }
   document.getElementById("content").innerHTML = h;
 }

@@ -163,7 +163,9 @@ class AsyncBlockingChecker(Checker):
     rule_id = "async-blocking"
     description = ("blocking calls (sleep/file/socket/subprocess/unbounded "
                    "pickle) reachable from cluster async handlers")
-    paths = ("ray_tpu/cluster/",)
+    # serve/ is included because the Router is an asyncio actor: one
+    # blocking call in its event loop stalls EVERY endpoint's routing.
+    paths = ("ray_tpu/cluster/", "ray_tpu/serve/")
 
     def run(self, project: Project) -> Iterator[Finding]:
         for prefix in self.paths:

@@ -162,6 +162,26 @@ class ClusterUnavailableError(RayTpuError):
     task) — distinct from user-code errors so callers can retry safely."""
 
 
+class ReplicaUnavailableError(RayTpuError):
+    """A serve request cannot be (re)placed on any live replica.
+
+    Raised by the serve router when a stream's pinned replica died (streams
+    fail fast instead of hanging to the idle timeout), when a whole-response
+    call exhausted its retry budget across sibling replicas, or when a
+    backend has no routable replica at all. Also raised by a poisoned
+    backend (e.g. ``serve.LMBackend`` after an engine-step failure) so the
+    router treats it as a replica-infrastructure failure — retryable on a
+    sibling — rather than an application error."""
+
+    def __init__(self, backend_tag=None, message="no replica available"):
+        self.backend_tag = backend_tag
+        self.message = message
+        super().__init__(f"{message} (backend={backend_tag})")
+
+    def __reduce__(self):
+        return (type(self), (self.backend_tag, self.message))
+
+
 __all__ = [
     "PlacementGroupError",
     "RayTpuError",
@@ -179,4 +199,5 @@ __all__ = [
     "TaskPoisonedError",
     "RuntimeEnvError",
     "ClusterUnavailableError",
+    "ReplicaUnavailableError",
 ]

@@ -119,6 +119,19 @@ def default_slo_rules() -> List[SloRule]:
         SloRule("job_efficiency", "gauge-floor", "job_sched_efficiency",
                 threshold=_env_f("RAY_TPU_SLO_JOB_EFFICIENCY_FLOOR", 0.05),
                 window_s=600.0),
+        # Serving fleet: the ServeMaster's reconcile loop mirrors the
+        # router's per-route windows into untagged worst-case gauges
+        # (serve_route_p99_ms_max / serve_route_error_rate_max); these
+        # ceilings page when ANY route blows its latency or error budget
+        # — e.g. replicas flapping faster than replacements spin up. Both
+        # gauges read 0 with no serve instance running, so the rules are
+        # inert outside serving jobs.
+        SloRule("serve_route_p99", "ceiling", "serve_route_p99_ms_max",
+                threshold=_env_f("RAY_TPU_SLO_SERVE_P99_MS", 2000.0),
+                window_s=120.0),
+        SloRule("serve_error_rate", "ceiling", "serve_route_error_rate_max",
+                threshold=_env_f("RAY_TPU_SLO_SERVE_ERROR_RATE", 0.01),
+                window_s=120.0),
     ]
 
 

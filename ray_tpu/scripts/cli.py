@@ -1245,6 +1245,58 @@ def cmd_dashboard(args) -> None:
         ray_tpu.shutdown()
 
 
+def cmd_serve(args) -> None:
+    """Serving-fleet status: endpoints (routed/errors/latency), backends
+    (replicas by up/down/draining state, inflight, queue depth, autoscale
+    band) and the failover counters the self-healing loop maintains."""
+    import ray_tpu
+    from ray_tpu.serve.master import MASTER_NAME
+
+    address = args.address or _load_session().get("address")
+    if not address:
+        raise SystemExit("no running cluster (pass --address or `cli up`); "
+                         "serve status needs the cluster that runs the "
+                         "serve control plane")
+    ray_tpu.init(address=address)
+    try:
+        try:
+            master = ray_tpu.get_actor(MASTER_NAME)
+        except Exception:
+            raise SystemExit("no serve control plane in this cluster "
+                             "(serve.init() not called)")
+        s = ray_tpu.get(master.stat.remote())
+        eps = s.get("endpoints", {})
+        print(f"{len(eps)} endpoints")
+        if eps:
+            print(f"{'ENDPOINT':<20} {'ROUTED':>8} {'ERRORS':>8} TRAFFIC")
+            for ep, info in eps.items():
+                traffic = " ".join(f"{t}={w:g}" for t, w in
+                                   info.get("traffic", {}).items())
+                print(f"{ep:<20} {info['routed']:>8} {info['errors']:>8} "
+                      f"{traffic}")
+        fleet = s.get("fleet", {})
+        backends = s.get("backends", {})
+        print(f"{len(backends)} backends")
+        if backends:
+            print(f"{'BACKEND':<20} {'TARGET':>6} {'UP':>4} {'DOWN':>5} "
+                  f"{'DRAIN':>6} {'INFLIGHT':>9} {'QUEUED':>7} AUTOSCALE")
+            for tag, b in backends.items():
+                f = fleet.get(tag, {})
+                auto = (f"{f['min_replicas']}..{f['max_replicas']}"
+                        if f.get("autoscaling") else "off")
+                print(f"{tag:<20} {f.get('target', '-'):>6} "
+                      f"{b.get('up', 0):>4} {b.get('down', 0):>5} "
+                      f"{b.get('draining', 0):>6} {b.get('inflight', 0):>9} "
+                      f"{b.get('queued', 0):>7} {auto}")
+        counters = {**s.get("counters", {}), **s.get("fleet_counters", {})}
+        if counters:
+            print("counters: " + " ".join(
+                f"{k}={v}" for k, v in counters.items()))
+        print(f"live streams: {s.get('streams', 0)}")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_microbenchmark(args) -> None:
     """In-process perf microbenchmarks (reference: ray microbenchmark /
     ray_perf.py). Prints ops/s per pattern."""
@@ -1482,6 +1534,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--address")
     sp.add_argument("--port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("serve", help="serving-fleet status: replicas by "
+                                      "state, inflight, retry/failover "
+                                      "counters")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)

@@ -23,6 +23,7 @@ from .api import (  # noqa: F401
     stat,
     update_backend_config,
 )
+from ..exceptions import ReplicaUnavailableError  # noqa: F401
 from .config import BackendConfig  # noqa: F401
 from .handle import ServeHandle  # noqa: F401
 from .metric import (  # noqa: F401
@@ -46,6 +47,7 @@ __all__ = [
     "stat",
     "http_address",
     "BackendConfig",
+    "ReplicaUnavailableError",
     "ServeHandle",
     "ExporterInterface",
     "InMemoryExporter",

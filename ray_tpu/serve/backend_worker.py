@@ -14,6 +14,11 @@ from typing import Any, Callable, List
 
 from .config import ServeRequest
 
+# Reserved method name the master's reconcile loop probes with
+# handle_request(HEALTH_CHECK_METHOD, (), {}): it must never collide with a
+# user method, so it is dunder-shaped and intercepted before dispatch.
+HEALTH_CHECK_METHOD = "__health__"
+
 
 def _is_batched(fn: Callable) -> bool:
     return bool(getattr(fn, "__serve_accept_batch__", False))
@@ -51,7 +56,22 @@ class ReplicaActor:
             f"backend {self.backend_tag} is not callable and no method given"
         )
 
+    def check_health(self) -> dict:
+        """Typed health probe. Delegates to the user callable's
+        ``check_health()`` when it defines one (e.g. a poisoned LMBackend
+        reports unhealthy here instead of erroring on every request);
+        otherwise a reachable replica is a healthy replica."""
+        probe = getattr(self.callable, "check_health", None)
+        if callable(probe):
+            out = probe()
+            if isinstance(out, dict):
+                return out
+            return {"healthy": bool(out)}
+        return {"healthy": True}
+
     def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        if method == HEALTH_CHECK_METHOD:
+            return self.check_health()
         self.num_queries += 1
         target = self._target(method)
         if _is_batched(target):

@@ -111,6 +111,12 @@ class LMBackend:
         self._stream_done: set = set()  # req_ids whose last token is buffered
         self._stream_seen: dict = {}    # token -> last poll/start time
         self._failed: dict = {}         # req_id -> exception from the pump
+        # Set by _poison(): the engine step failed. The replica keeps
+        # answering RPCs but reports unhealthy (check_health) so the
+        # master's reconcile loop replaces it, and refuses new work with
+        # ReplicaUnavailableError so the router fails over to a sibling
+        # instead of erroring here forever.
+        self._poisoned: Optional[BaseException] = None
 
     def _parse(self, r: ServeRequest):
         if len(r.args) > 2:
@@ -176,6 +182,7 @@ class LMBackend:
         whole-response waiters raise it, stream pollers raise it, and the
         engine's slots/queue are cleared so the next submission starts
         from an idle engine rather than re-running the failing step."""
+        self._poisoned = err
         rids = [r.req_id for r in self.engine.queue]
         rids += [r.req_id for r in self.engine.active if r is not None]
         for rid in rids:
@@ -183,10 +190,33 @@ class LMBackend:
             self.engine.cancel(rid)
         self._cond.notify_all()
 
+    def _check_poisoned(self) -> None:
+        """Under self._cond: refuse new work once the engine is poisoned.
+        ReplicaUnavailableError is the router's failover signal, so callers
+        are retried on a sibling replica while the master replaces us."""
+        if self._poisoned is not None:
+            from ..exceptions import ReplicaUnavailableError
+
+            raise ReplicaUnavailableError(
+                None, "LM engine poisoned by step failure: "
+                      f"{type(self._poisoned).__name__}: {self._poisoned}")
+
+    def check_health(self) -> dict:
+        """Surfaced through ReplicaActor.check_health to the master's
+        reconcile probes."""
+        with self._cond:
+            if self._poisoned is None:
+                return {"healthy": True}
+            return {"healthy": False,
+                    "reason": f"engine poisoned: "
+                              f"{type(self._poisoned).__name__}: "
+                              f"{self._poisoned}"}
+
     @accept_batch
     def __call__(self, requests: List[ServeRequest]) -> List[List[int]]:
         parsed = [self._parse(r) for r in requests]
         with self._cond:
+            self._check_poisoned()
             # Validate every request BEFORE submitting any: a bad one must
             # not leave its batch-mates orphaned inside the engine (they
             # would keep decoding with no caller and leak into engine.done
@@ -226,6 +256,7 @@ class LMBackend:
         n = int(max_new_tokens if max_new_tokens is not None
                 else self.default_max_new_tokens)
         with self._cond:
+            self._check_poisoned()
             self._expire_idle_streams()
             self.engine.validate(prompt, n, float(temperature), seed, stop)
             rid = self.engine.submit(prompt, n,
@@ -288,6 +319,7 @@ class LMBackend:
                 "active": sum(r is not None for r in eng.active),
                 "queued": len(eng.queue),
                 "streams": len(self._streams),
+                "poisoned": self._poisoned is not None,
                 "speculative": st,
             }
 

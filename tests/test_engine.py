@@ -220,10 +220,15 @@ def test_http_streaming_chunked(local_ray):
 
 def test_lm_backend_pump_error_propagates():
     """A failing engine step must surface on the waiting RPCs (whole-
-    response raises; stream_poll raises) instead of silently killing the
-    pump thread and hanging every caller forever."""
+    response raises; an in-flight stream_poll raises) instead of silently
+    killing the pump thread and hanging every caller forever. Once
+    poisoned, the replica refuses NEW work with ReplicaUnavailableError
+    (the router's failover signal) and reports unhealthy via check_health
+    so the master's reconcile loop replaces it — it does not keep erroring
+    on every request forever."""
     import pytest
 
+    from ray_tpu.exceptions import ReplicaUnavailableError
     from ray_tpu.serve.config import ServeRequest
     from ray_tpu.serve.lm import LMBackend
 
@@ -235,17 +240,33 @@ def test_lm_backend_pump_error_propagates():
         raise RuntimeError("device exploded")
 
     b.engine.step = lambda: boom()
+    # In-flight whole-response call gets the REAL step error.
     with pytest.raises(RuntimeError, match="device exploded"):
         b([ServeRequest(([1, 2, 3],), {"max_new_tokens": 4})])
     # Engine drained: nothing active or queued after the poison.
     assert not b.engine.queue and not any(
         r is not None for r in b.engine.active)
 
-    token = b.stream_start([1, 2], max_new_tokens=4)
-    with pytest.raises(RuntimeError, match="device exploded"):
-        b.stream_poll(token, wait_s=5.0)
-    # The failed stream is fully dropped — no leaked bookkeeping.
+    # Poisoned now: new work is refused with the failover signal, and
+    # health probes see the poison so the fleet replaces this replica.
+    with pytest.raises(ReplicaUnavailableError, match="device exploded"):
+        b.stream_start([1, 2], max_new_tokens=4)
+    with pytest.raises(ReplicaUnavailableError, match="device exploded"):
+        b([ServeRequest(([1, 2, 3],), {"max_new_tokens": 4})])
+    health = b.check_health()
+    assert not health["healthy"] and "device exploded" in health["reason"]
     assert not b._streams and not b._stream_seen and not b._failed
+
+    # An ALREADY-RUNNING stream when the step fails gets the real error
+    # on its next poll (not a hang, not the failover signal).
+    b2 = LMBackend(params, cfg, max_slots=2)
+    with b2._cond:  # pump can't step until we release: swap is pre-step
+        token = b2.stream_start([1, 2], max_new_tokens=4)
+        b2.engine.step = lambda: boom()
+    with pytest.raises(RuntimeError, match="device exploded"):
+        for _ in range(100):
+            b2.stream_poll(token, wait_s=5.0)
+    assert not b2._streams and not b2._stream_seen and not b2._failed
 
 
 class TestSpeculativeDecoding:

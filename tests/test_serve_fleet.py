@@ -1,0 +1,320 @@
+"""Self-healing serving fleet tests (model: python/ray/serve/tests/
+test_failure.py): failover routing with retry budgets, replica health
+probes and auto-replacement, stream fast-fail, drain-based scale-down,
+and the chaos soak (slow-marked).
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import ReplicaUnavailableError
+from ray_tpu.serve.master import MASTER_NAME
+from ray_tpu.serve.router import Router
+
+
+@pytest.fixture
+def serve_instance(local_ray):
+    serve.init()
+    yield serve
+    serve.shutdown()
+
+
+class TickStream:
+    """Minimal streaming backend speaking the stream_start/poll/cancel
+    wire contract (what LMBackend exposes) without the LM engine."""
+
+    def __init__(self):
+        self._streams = {}
+        self._n = 0
+
+    def stream_start(self, total=1000):
+        self._n += 1
+        token = f"t{self._n}"
+        self._streams[token] = [0, int(total)]
+        return token
+
+    def stream_poll(self, token, wait_s=2.0):
+        st = self._streams.get(token)
+        if st is None:
+            return {"tokens": [], "done": True}
+        st[0] += 1
+        done = st[0] >= st[1]
+        out = {"tokens": [st[0]], "done": done}
+        if done:
+            del self._streams[token]
+        time.sleep(0.01)
+        return out
+
+    def stream_cancel(self, token):
+        return self._streams.pop(token, None) is not None
+
+
+def _router_up(master, tag):
+    return ray_tpu.get(master.stat.remote())["backends"][tag]["up"]
+
+
+def _wait_for(pred, timeout=10.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_pick_backend_zero_weights():
+    # Regression: random.choices raises a bare ValueError when every
+    # traffic weight is 0; the router must raise the typed routing error.
+    r = Router.__new__(Router)
+    with pytest.raises(ReplicaUnavailableError, match="traffic weight"):
+        r._pick_backend({"a": 0.0, "b": 0.0})
+    assert r._pick_backend({"a": 0.0, "b": 1.0}) == "b"
+
+
+def test_failover_marks_down_and_retries(serve_instance):
+    # Kill 1 of 2 replicas: calls must fail over to the sibling with zero
+    # client-visible failures, and the router must count the down-mark.
+    def echo(x):
+        return x
+
+    serve.create_backend("fo:v1", echo, config=serve.BackendConfig(
+        num_replicas=2,
+        health_check_period_s=60.0))  # keep the reconciler out of the way
+    serve.create_endpoint("fo", backend="fo:v1")
+    h = serve.get_handle("fo")
+    assert ray_tpu.get(h.remote(0)) == 0
+
+    master = ray_tpu.get_actor(MASTER_NAME)
+    victim = ray_tpu.get(master.get_replicas.remote("fo:v1"))[0]
+    ray_tpu.kill(victim)
+    outs = ray_tpu.get([h.remote(i) for i in range(40)])
+    assert outs == list(range(40))
+    stats = ray_tpu.get(master.stat.remote())
+    assert stats["counters"]["replicas_down"] >= 1
+    assert stats["backends"]["fo:v1"]["up"] == 1
+
+
+def test_retry_budget_exhaustion(serve_instance, monkeypatch):
+    # Every replica dead and no reconciler: the call must surface the
+    # typed error once the budget is spent, not hang or loop forever.
+    def echo(x):
+        return x
+
+    serve.create_backend("rb:v1", echo, config=serve.BackendConfig(
+        num_replicas=2, health_check_period_s=60.0))
+    serve.create_endpoint("rb", backend="rb:v1")
+    h = serve.get_handle("rb")
+    assert ray_tpu.get(h.remote(1)) == 1
+
+    master = ray_tpu.get_actor(MASTER_NAME)
+    for rep in ray_tpu.get(master.get_replicas.remote("rb:v1")):
+        ray_tpu.kill(rep)
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaUnavailableError):
+        ray_tpu.get(h.remote(2))
+    assert time.monotonic() - t0 < 10.0
+    # Later calls fail fast too: every replica is already marked down.
+    with pytest.raises(ReplicaUnavailableError):
+        ray_tpu.get(h.remote(3))
+
+
+def test_stream_fast_fail_on_replica_death(serve_instance):
+    # A stream pinned to a killed replica must fail with the typed error
+    # promptly — not hang until the 300 s idle timeout.
+    serve.create_backend("sf:v1", TickStream, config=serve.BackendConfig(
+        num_replicas=1, replica_concurrency=4,
+        health_check_period_s=60.0))
+    serve.create_endpoint("sf", backend="sf:v1")
+    h = serve.get_handle("sf")
+    master = ray_tpu.get_actor(MASTER_NAME)
+
+    got = []
+    t_kill = None
+    with pytest.raises(ReplicaUnavailableError):
+        for tok in h.stream(total=1000):
+            got.append(tok)
+            if len(got) == 3:
+                victim = ray_tpu.get(
+                    master.get_replicas.remote("sf:v1"))[0]
+                ray_tpu.kill(victim)
+                t_kill = time.monotonic()
+    assert got == [1, 2, 3]
+    assert time.monotonic() - t_kill < 10.0
+    stats = ray_tpu.get(master.stat.remote())
+    assert stats["counters"]["stream_failfast"] >= 1
+
+
+def test_stream_purged_on_backend_delete(serve_instance):
+    # remove_backend must purge pinned streams: the generator's next poll
+    # gets the typed error (regression: it used to poll a stale handle
+    # until the idle timeout).
+    serve.create_backend("sp:v1", TickStream, config=serve.BackendConfig(
+        num_replicas=1, replica_concurrency=4,
+        health_check_period_s=60.0))
+    serve.create_endpoint("sp", backend="sp:v1")
+    h = serve.get_handle("sp")
+
+    gen = h.stream(total=1000)
+    assert next(gen) == 1
+    serve.delete_endpoint("sp")
+    serve.delete_backend("sp:v1")
+    with pytest.raises(ReplicaUnavailableError, match="deleted"):
+        for _ in gen:
+            pass
+
+
+def test_unhealthy_backend_replaced(serve_instance):
+    # A backend that reports unhealthy (the poisoned-LMBackend shape, via
+    # check_health) must be struck out and replaced even though its actor
+    # process is alive and answering probes.
+    class Flaky:
+        healthy = True
+
+        def __call__(self, x):
+            return x
+
+        def poison(self):
+            Flaky.healthy = False  # class-level: survives handle pickling
+            return "poisoned"
+
+        def check_health(self):
+            return {"healthy": Flaky.healthy, "reason": "poisoned"}
+
+    serve.create_backend("uh:v1", Flaky, config=serve.BackendConfig(
+        num_replicas=1, health_check_period_s=0.2,
+        health_check_timeout_s=2.0, health_check_failures=2))
+    serve.create_endpoint("uh", backend="uh:v1")
+    h = serve.get_handle("uh")
+    assert ray_tpu.get(h.remote(1)) == 1
+
+    master = ray_tpu.get_actor(MASTER_NAME)
+    old = ray_tpu.get(master.get_replicas.remote("uh:v1"))[0]
+    assert ray_tpu.get(h.options(method="poison").remote()) == "poisoned"
+    # The replacement constructs a fresh Flaky in a NEW actor process
+    # whose class object is a fresh copy (healthy=True again).
+    assert _wait_for(
+        lambda: ray_tpu.get(master.get_replicas.remote("uh:v1"))
+        and ray_tpu.get(master.get_replicas.remote("uh:v1"))[0] != old,
+        timeout=15.0)
+    assert _wait_for(lambda: _router_up(master, "uh:v1") == 1, timeout=15.0)
+    assert ray_tpu.get(master.stat.remote())[
+        "fleet_counters"]["replicas_replaced"] >= 1
+    assert ray_tpu.get(h.remote(2)) == 2
+
+
+def test_scale_down_drains_inflight(serve_instance):
+    # Scale-down goes through graceful drain: in-flight requests on the
+    # retiring replica finish (no drops) before the replica exits.
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.5)
+            return x
+
+    serve.create_backend("dr:v1", Slow, config=serve.BackendConfig(
+        num_replicas=3, health_check_period_s=60.0, drain_timeout_s=30.0))
+    serve.create_endpoint("dr", backend="dr:v1")
+    h = serve.get_handle("dr")
+
+    refs = [h.remote(i) for i in range(9)]
+    time.sleep(0.1)  # let the router dispatch across all 3 replicas
+    serve.update_backend_config("dr:v1", {"num_replicas": 1})
+    assert sorted(ray_tpu.get(refs)) == list(range(9))
+    master = ray_tpu.get_actor(MASTER_NAME)
+    assert len(ray_tpu.get(master.get_replicas.remote("dr:v1"))) == 1
+
+
+def test_kill_replica_mid_traffic_e2e(serve_instance):
+    # The tentpole E2E: SIGKILL a replica while traffic flows — zero
+    # client-visible failures, and a replacement is serving (router up
+    # count restored) within the probe interval + spawn budget.
+    def echo(x):
+        return x
+
+    probe_s = 0.3
+    serve.create_backend("e2e:v1", echo, config=serve.BackendConfig(
+        num_replicas=3, health_check_period_s=probe_s,
+        health_check_timeout_s=2.0, health_check_failures=1))
+    serve.create_endpoint("e2e", backend="e2e:v1")
+    h = serve.get_handle("e2e")
+    master = ray_tpu.get_actor(MASTER_NAME)
+
+    failures = []
+    sent = [0]
+    t_killed = None
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        try:
+            out = ray_tpu.get(h.remote(sent[0]), timeout=30.0)
+            assert out == sent[0]
+            sent[0] += 1
+        except Exception as e:  # noqa: BLE001 - failures are the subject
+            failures.append(e)
+        if t_killed is None and sent[0] >= 20:
+            victim = ray_tpu.get(master.get_replicas.remote("e2e:v1"))[0]
+            ray_tpu.kill(victim)
+            t_killed = time.monotonic()
+        if t_killed is not None:
+            # Healed = a replacement was spawned AND the router routes to
+            # a full fleet again (up alone reads 3 right after the kill,
+            # before any call or probe noticed the death).
+            s = ray_tpu.get(master.stat.remote())
+            if (s["fleet_counters"]["replicas_replaced"] >= 1
+                    and s["backends"]["e2e:v1"]["up"] == 3):
+                break
+    assert not failures, failures[:3]
+    assert sent[0] > 20
+    assert t_killed is not None
+    heal_s = time.monotonic() - t_killed
+    stats = ray_tpu.get(master.stat.remote())
+    assert stats["fleet_counters"]["replicas_replaced"] >= 1
+    assert stats["backends"]["e2e:v1"]["up"] == 3, \
+        f"fleet not healed after {heal_s:.1f}s"
+    # Replacement must serve within the probe interval + spawn budget.
+    assert heal_s < probe_s + 8.0
+
+
+def test_fleet_metrics_and_cli_surface(serve_instance):
+    # The reconcile loop mirrors route latency + replica states into the
+    # process metrics registry (Prometheus via the dashboard /metrics).
+    def echo(x):
+        return x
+
+    serve.create_backend("fm:v1", echo, config=serve.BackendConfig(
+        num_replicas=1, health_check_period_s=0.2))
+    serve.create_endpoint("fm", backend="fm:v1")
+    h = serve.get_handle("fm")
+    ray_tpu.get([h.remote(i) for i in range(10)])
+
+    from ray_tpu import metrics as metrics_mod
+
+    def exported():
+        snap = metrics_mod.collect_all()
+        values = snap.get("serve_replicas", {}).get("values", {})
+        return any("fm:v1" in tags and "'up'" in tags and v == 1
+                   for tags, v in values.items())
+
+    assert _wait_for(exported, timeout=10.0)
+    text = metrics_mod.render_prometheus()
+    assert "serve_route_latency_p99_ms" in text
+    assert "serve_replicas" in text
+
+
+@pytest.mark.slow
+def test_chaos_soak_script():
+    # The full drill as shipped: sustained call+stream mix, replicas
+    # SIGKILLed every few seconds, zero failed requests.
+    proc = subprocess.run(
+        [sys.executable, "scripts/serve_soak.py",
+         "--duration", "15", "--kill-every", "4"],
+        capture_output=True, text=True, timeout=300,
+        cwd=__file__.rsplit("/tests/", 1)[0])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SOAK OK" in proc.stdout
