@@ -1288,8 +1288,14 @@ class NodeController:
         mtype = msg.get("type")
         if mtype == "assign_task":
             coro = self._run_task(_payload(msg))
-        elif mtype == "assign_batch":
-            tasks = msg.get("tasks", [])
+        elif mtype in ("assign_batch", "dispatch_wave"):
+            if mtype == "dispatch_wave":
+                # Columnar scatter frame: explode the template runs into
+                # per-task dicts HERE, off the GCS — it relayed one frame
+                # for this node's whole wave instead of N spec structs.
+                tasks = self._explode_wave(msg)
+            else:
+                tasks = msg.get("tasks", [])
 
             def fan_out(ts=tasks):
                 for t in ts:
@@ -1320,6 +1326,35 @@ class NodeController:
         else:
             return
         self._loop.call_soon_threadsafe(lambda: self._spawn_bg(coro))
+
+    @staticmethod
+    def _explode_wave(msg: Dict) -> list:  # raylint: hotpath
+        """Expand a DISPATCH_WAVE scatter frame into the per-task dicts the
+        assign_batch path runs. Template fields (fn_id/name/retries/deps/
+        pins/resources) are parsed once per run by the wire decoder and
+        SHARED across the run's task dicts (read-only downstream); each
+        task's executable spec bytes are rebuilt from the template +
+        its own id/return-ids/arg tail."""
+        from . import wire
+
+        tasks = list(msg.get("singles") or ())
+        for run in msg.get("runs") or ():
+            fn_id = run.get("fn_id")
+            name = run.get("name")
+            max_retries = run.get("max_retries", 0)
+            deps = run.get("deps") or []
+            pin_refs = run.get("pin_refs") or []
+            resources = run.get("resources") or {}
+            return_oids = run["return_oids"]
+            for i, tid in enumerate(run["task_ids"]):
+                tasks.append({
+                    "task_id": tid, "name": name, "fn_id": fn_id,
+                    "deps": deps, "pin_refs": pin_refs,
+                    "return_ids": return_oids[i], "resources": resources,
+                    "max_retries": max_retries,
+                    "_spec": wire.build_spec_from_run(run, i),
+                })
+        return tasks
 
     def _fits_local(self, res: Dict[str, float]) -> bool:
         return all(self.local_avail.get(k, 0.0) + 1e-9 >= v

@@ -637,6 +637,7 @@ class ClusterCoreWorker:
         if not recs:
             return []
         out: List[Tuple[bytes, bytes, str]] = []
+        ready_new: List[bytes] = []
         store = self.local_store
         n_ring = n_inline = inline_bytes = 0
         for oid, flags, size, inline in recs:
@@ -654,9 +655,14 @@ class ClusterCoreWorker:
                 if blob is not None:
                     out.append((oid, blob, "ring"))
                     continue
-            self._ring_ready.add(oid)
-            self._ring_ready_order.append(oid)
-            while len(self._ring_ready_order) > 65536:
+            ready_new.append(oid)
+        if ready_new:
+            # Batched bookkeeping: one set.update + one deque.extend + a
+            # single trim pass instead of per-record churn (the harvest is
+            # on the get() hot path).
+            self._ring_ready.update(ready_new)
+            self._ring_ready_order.extend(ready_new)
+            for _ in range(len(self._ring_ready_order) - 65536):
                 self._ring_ready.discard(self._ring_ready_order.popleft())
         if ring.degraded:
             # Torn record detected mid-harvest (worker died mid-publish):
@@ -698,20 +704,34 @@ class ClusterCoreWorker:
             timer.cancel()
         if not buf:
             return
-        if not wire.pickle_only():
-            # Serialize each spec ONCE into its wire blob: the submit frame
-            # carries these bytes, the GCS keeps them opaque, and the
-            # executing worker is the only decoder (zero re-serialization
-            # along the relay).
+        msg: Optional[Dict] = None
+        if len(buf) > 1 and not wire.pickle_only() \
+                and wire.columnar_submit_enabled() \
+                and self._gcs_wire_version() >= 8:
+            # Columnar hot path: same-template tasks share ONE spec header
+            # (fn_id/name/retries/resources encoded once per run); only the
+            # task ids, return ids and arg tails travel per task. Falls
+            # back to the per-task frames when no run forms.
             t0 = time.perf_counter()
-            for t in buf:
-                if "_spec" not in t:
-                    t["_spec"] = wire.encode_task_spec(t)
+            msg = self._build_columnar_submit(buf)
             self._phase_add("driver_serialize", time.perf_counter() - t0, 0)
+        if msg is None:
+            if not wire.pickle_only():
+                # Serialize each spec ONCE into its wire blob: the submit
+                # frame carries these bytes, the GCS keeps them opaque, and
+                # the executing worker is the only decoder (zero
+                # re-serialization along the relay).
+                t0 = time.perf_counter()
+                for t in buf:
+                    if "_spec" not in t:
+                        t["_spec"] = wire.encode_task_spec(t)
+                self._phase_add("driver_serialize",
+                                time.perf_counter() - t0, 0)
+            msg = {"type": "submit_batch", "tasks": buf}
         try:
             t0 = time.perf_counter()
             t0m = time.monotonic()
-            self.gcs.call({"type": "submit_batch", "tasks": buf})
+            self.gcs.call(msg)
             self._phase_add("submit_rpc", time.perf_counter() - t0, len(buf))
             t1m = time.monotonic()
             for t in buf:
@@ -733,6 +753,82 @@ class ClusterCoreWorker:
                         0.25, self._flush_submits)
                     self._submit_timer.daemon = True
                     self._submit_timer.start()
+
+    def _gcs_wire_version(self) -> int:
+        """The GCS's advertised wire version, probed once per connection
+        and cached on the underlying RpcClient (a reconnect builds a new
+        client, so the probe naturally re-runs against a new leader).
+        Pre-v8 and unknown peers report 1: the caller keeps the per-task
+        legacy frames, which every peer parses."""
+        try:
+            cli = self.gcs._ensure()
+        except Exception:  # noqa: BLE001 - can't dial; legacy path is safe
+            return 1
+        w = getattr(cli, "_srv_wire", None)
+        if w is None:
+            try:
+                resp = self.gcs.call({"type": "wire_probe"}, timeout=5.0)
+                w = int(resp.get("wire", 1)) if resp.get("ok") else 1
+            except Exception:  # noqa: BLE001 - old GCS / flaky link => v1
+                w = 1
+            try:
+                cli = self.gcs._ensure()
+                cli._srv_wire = w
+                if w > cli.peer_wire:
+                    # The ResilientClient never handshakes wire versions
+                    # (the GCS advertises to nodes/workers at registration
+                    # only), so lift the client's peer floor here: without
+                    # it encode() would pickle the columnar frame.
+                    cli.peer_wire = w
+            except Exception:  # noqa: BLE001 - reconnected mid-probe
+                pass
+        return int(w)
+
+    @staticmethod
+    def _template_key(t: Dict) -> Optional[Tuple]:
+        """Grouping key for the columnar submit: tasks sharing a key share
+        one spec template. None = ineligible (trace/deadline extensions
+        need the v2/v3 per-task header; dep/pin lists are almost never
+        shared, so they ride the legacy singles rather than fragment the
+        runs)."""
+        if t.get("trace") is not None or t.get("timeout_s") is not None \
+                or t.get("deps") or t.get("pin_refs"):
+            return None
+        res = t.get("resources") or {}
+        return (t.get("fn_id"), t.get("name"), int(t.get("max_retries", 0)),
+                tuple(sorted(res.items())))
+
+    def _build_columnar_submit(self, buf: List[Dict]) -> Optional[Dict]:
+        """Partition a submit buffer into template runs (>=2 tasks sharing
+        a template) + legacy singles; None when no run forms (the per-task
+        frame is then strictly better — no run headers to pay for)."""
+        groups: Dict[Tuple, List[Dict]] = {}
+        singles: List[Dict] = []
+        for t in buf:
+            key = self._template_key(t)
+            if key is None:
+                singles.append(t)
+            else:
+                groups.setdefault(key, []).append(t)
+        runs = []
+        for ts in groups.values():
+            if len(ts) < 2:
+                singles.extend(ts)
+                continue
+            seg_a, seg_b = wire.encode_spec_segments(ts[0])
+            runs.append({
+                "ver": wire.SPEC_VERSION, "seg_a": seg_a, "seg_b": seg_b,
+                "task_ids": [t["task_id"] for t in ts],
+                "return_oids": [t.get("return_ids", ()) for t in ts],
+                "tails": [wire.encode_spec_tail(t) for t in ts],
+            })
+        if not runs:
+            return None
+        for t in singles:
+            if "_spec" not in t:
+                t["_spec"] = wire.encode_task_spec(t)
+        return {"type": "submit_batch_cols", "runs": runs,
+                "singles": singles}
 
     # ------------------------------------------------------------------ tasks
     def next_task_id(self) -> TaskID:

@@ -656,7 +656,8 @@ class GcsServer:
     # rebuilt state (heartbeat, ref refresh — periodic by design), and
     # observability feeds (log_event, stats) are deliberately absent.
     _REPLICATED = frozenset({
-        "register_node", "report_node_dead", "submit_batch", "submit_task",
+        "register_node", "report_node_dead", "submit_batch",
+        "submit_batch_cols", "submit_task",
         "create_actor", "register_actor", "update_actor", "task_done",
         "task_done_batch", "task_failed", "cancel_task",
         "record_direct_task", "requeue_task", "add_object_location",
@@ -1743,17 +1744,83 @@ class GcsServer:
         if batch:
             await self._send_assign_batch(node_id, batch)
 
+    @staticmethod
+    def _materialize_spec(p: Dict[str, Any]) -> None:  # raylint: hotpath
+        """Rebuild a templated payload's full spec bytes (template prefix +
+        this task's id/return-ids/arg tail) for relays that need the legacy
+        per-task frame. No-op for payloads that already carry ``_spec``."""
+        tmpl = p.get("_tmpl")
+        if tmpl is None or "_spec" in p:
+            return
+        ver, seg_a, seg_b = tmpl
+        p["_spec"] = wire.build_spec(ver, seg_a, seg_b, p["task_id"],
+                                     p.get("return_ids", ()), p["_tail"])
+
+    @staticmethod
+    def _wave_msg(batch: list) -> Optional[Dict[str, Any]]:  # raylint: hotpath
+        """Regroup one node's dispatch batch into a DISPATCH_WAVE scatter
+        message: payloads sharing a submit-time template collapse back into
+        columnar runs (the template bytes ship once per run, not once per
+        task); spec-carrying payloads ride as singles. None => a payload
+        has neither form, caller uses the legacy relay."""
+        runs_by_tmpl: Dict[int, Dict[str, Any]] = {}
+        singles = []
+        for p in batch:
+            tmpl = p.get("_tmpl")
+            if tmpl is None:
+                spec = p.get("_spec")
+                if spec is None:
+                    return None
+                singles.append(spec)
+                continue
+            run = runs_by_tmpl.get(id(tmpl))
+            if run is None:
+                ver, seg_a, seg_b = tmpl
+                run = runs_by_tmpl[id(tmpl)] = {
+                    "ver": ver, "seg_a": seg_a, "seg_b": seg_b,
+                    "task_ids": [], "return_oids": [], "tails": []}
+            run["task_ids"].append(p["task_id"])
+            run["return_oids"].append(p.get("return_ids", ()))
+            run["tails"].append(p["_tail"])
+        return {"type": "dispatch_wave",
+                "runs": list(runs_by_tmpl.values()), "singles": singles}
+
     async def _send_assign_batch(self, node_id: str, batch: list) -> None:
         t0 = time.monotonic()
-        if all("_spec" in p for p in batch):
+        msg = None
+        if wire.dispatch_wave_enabled() and not wire.pickle_only() \
+                and any("_tmpl" in p for p in batch):
+            conn = self._node_conns.get(node_id)
+            peer = int(conn.meta.get("wire") or 0) if conn is not None else 0
+            if peer >= 8:
+                # Scatter wave: this node's whole tick of templated
+                # dispatches travels as ONE columnar frame the controller
+                # explodes locally. Gated on the peer's advertised wire
+                # version — a pickled wave to an old controller would be
+                # silently dropped by its push dispatcher.
+                msg = self._wave_msg(batch)
+                if msg is not None:
+                    self._stat_add("relay:wave", 0.0, len(batch))
+        if msg is not None:
+            pass
+        elif all("_spec" in p or "_tmpl" in p for p in batch):
             # Zero-re-serialization relay: these payloads arrived as binary
             # spec blobs and are forwarded verbatim inside the assign_batch
             # frame — the GCS never re-encodes a task spec. Pinned by the
             # relay:opaque / relay:pickled counters (tests assert pickled
-            # stays 0 on the fast path).
+            # stays 0 on the fast path). Templated payloads headed to a
+            # pre-v8 peer (or with waves switched off) rebuild their spec
+            # bytes here, once, from the shared template.
+            for p in batch:
+                self._materialize_spec(p)
             msg = {"type": "assign_batch", "tasks": batch}
             self._stat_add("relay:opaque", 0.0, len(batch))
         else:
+            # Mixed batch with at least one pickled payload (no spec blob):
+            # templated entries still need their spec bytes rebuilt or the
+            # executing worker would have neither args nor a spec.
+            for p in batch:
+                self._materialize_spec(p)
             msg = (dict(batch[0], type="assign_task") if len(batch) == 1
                    else {"type": "assign_batch", "tasks": batch})
             self._stat_add("relay:pickled", 0.0, len(batch))
@@ -3473,6 +3540,57 @@ class GcsServer:
                 self._enqueue_task(t, "task", retries=t.get("max_retries", 0))
             return {"ok": True, "count": len(msg["tasks"])}
 
+        @s.handler("submit_batch_cols")
+        async def submit_batch_cols(msg, conn):  # raylint: hotpath
+            """Columnar submissions: template runs expand LAZILY — the run
+            header is parsed once (by the wire decoder), the shared
+            template tuple rides every payload as ``_tmpl`` and per-task
+            spec bytes are only rebuilt if a node needs a legacy relay
+            (pre-v8 peer or RAY_TPU_DISPATCH_WAVE=0). Idempotent per
+            task_id like submit_batch, and replicated under the same
+            contract (the decoded runs re-encode verbatim)."""
+            table = self.task_table
+            count = 0
+            for run in msg.get("runs") or ():
+                tmpl = (run.get("ver", wire.SPEC_VERSION),
+                        run["seg_a"], run["seg_b"])
+                fn_id = run.get("fn_id")
+                name = run.get("name")
+                max_retries = int(run.get("max_retries", 0))
+                deps = run.get("deps") or []
+                pin_refs = run.get("pin_refs") or []
+                resources = run.get("resources") or {}
+                task_ids = run["task_ids"]
+                return_oids = run["return_oids"]
+                tails = run["tails"]
+                count += len(task_ids)
+                for i, tid in enumerate(task_ids):
+                    if tid in table:
+                        continue
+                    self._enqueue_task({
+                        "task_id": tid, "name": name, "fn_id": fn_id,
+                        "deps": deps, "pin_refs": pin_refs,
+                        "return_ids": return_oids[i],
+                        "resources": resources,
+                        "max_retries": max_retries,
+                        "_tmpl": tmpl, "_tail": tails[i],
+                    }, "task", retries=max_retries)
+            for t in msg.get("singles") or ():
+                count += 1
+                if t["task_id"] in table:
+                    continue
+                self._enqueue_task(t, "task", retries=t.get("max_retries", 0))
+            return {"ok": True, "count": count}
+
+        @s.handler("wire_probe")
+        async def wire_probe(msg, conn):
+            """Capability probe for clients that never handshake a wire
+            version (the driver's ResilientClient): the columnar submit
+            path engages only when the probed version is >= 8. NOT
+            replicated — it mutates nothing."""
+            return {"ok": True,
+                    "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION}
+
         def _locations_snapshot(object_ids, probe_recovery: bool) -> dict:
             out = {}
             for oid in object_ids:
@@ -3708,19 +3826,92 @@ class GcsServer:
             return None  # one-way
 
         @s.handler("task_done_batch")
-        async def task_done_batch(msg, conn):
+        async def task_done_batch(msg, conn):  # raylint: hotpath
             """Coalesced completions from one controller (one frame + one
             socket write for a tick's worth — at fan-out rates the
             per-task oneway dominated GCS socket I/O). Items may carry the
             task's result registrations ("added"), saving one directory
             message per task; registration runs strictly before the finish
-            so a FINISHED record never has unindexed outputs."""
+            so a FINISHED record never has unindexed outputs.
+
+            Batched apply: one partition pass splits the items into
+            duplicate / early / normal, then the share release, the phase
+            cells, the early-done set + its order trim, and the inline
+            eviction each run ONCE over the whole batch instead of per
+            item. Semantics are pinned to the sequential loop this
+            replaced (see _handle_task_done, kept for the singular
+            task_done): dup items still register their "added" entries,
+            stale-node reports release but never finish, and a tid
+            repeated within one batch counts once."""
             node_id = msg["node_id"]
+            table = self.task_table
+            early = self._early_task_done
+            seen: Set[bytes] = set()
+            finishes = []          # (item, rec): stamp + finish, in order
+            early_new: List[bytes] = []
+            res_sum: Dict[str, float] = {}
+            exec_sum = reg_sum = 0.0
+            n_stat = 0
             for item in msg["items"]:
-                for ent in item.get("added") or ():
-                    _add_location(ent[0], node_id, ent[1],
-                                  ent[2] if len(ent) > 2 else None)
-                _handle_task_done({"node_id": node_id, **item})
+                added = item.get("added")
+                if added:
+                    # Registrations apply even for duplicate completions
+                    # (the directory add is idempotent and a dup may still
+                    # carry blobs the first report's connection dropped);
+                    # inline-budget eviction is deferred to one sweep.
+                    for ent in added:
+                        _add_location(ent[0], node_id, ent[1],
+                                      ent[2] if len(ent) > 2 else None,
+                                      evict=False)
+                tid = item.get("task_id")
+                rec = table.get(tid) if tid else None
+                if tid:
+                    if tid in seen:
+                        continue       # repeat within this batch
+                    if rec is not None:
+                        if rec["state"] in ("FINISHED", "FAILED"):
+                            continue   # duplicate of a settled completion
+                    elif tid in early:
+                        continue       # dup of a completion that beat its
+                                       # record here
+                    seen.add(tid)
+                if "exec_s" in item:
+                    exec_sum += float(item.get("exec_s") or 0.0)
+                    reg_sum += float(item.get("reg_s") or 0.0)
+                    n_stat += 1
+                res = item.get("resources")
+                if res:
+                    for k, v in res.items():
+                        res_sum[k] = res_sum.get(k, 0.0) + v
+                if rec is not None:
+                    if rec["node_id"] == node_id:
+                        finishes.append((item, rec))
+                elif tid:
+                    early_new.append(tid)
+            _evict_inline()
+            if n_stat:
+                self._stat_add("phase:worker_exec", exec_sum, n_stat)
+                self._stat_add("phase:result_register", reg_sum, n_stat)
+            if res_sum:
+                # One summed release per batch: per-key min()-capping makes
+                # sequential per-item releases and the summed release land
+                # on the same availability.
+                self._release(node_id, res_sum)
+            for item, rec in finishes:
+                ts1 = float(item.get("ts_exec_end") or 0.0)
+                if ts1 > 0.0:
+                    rec["ts_exec_start"] = \
+                        float(item.get("ts_exec_start") or 0.0)
+                    rec["ts_exec_end"] = ts1
+                if "exec_s" in item:
+                    rec["exec_s"] = float(item.get("exec_s") or 0.0)
+                self._finish_record(item["task_id"])
+            if early_new:
+                order = self._early_task_done_order
+                early.update(early_new)
+                order.extend(early_new)
+                for _ in range(len(order) - 10_000):
+                    early.discard(order.popleft())
             return None  # one-way
 
         @s.handler("task_failed")
@@ -3868,13 +4059,29 @@ class GcsServer:
                         pass
             return {"ok": True, "cancelled": True}
 
+        def _evict_inline() -> None:  # raylint: hotpath
+            """Bring the inline-result cache back under budget (oldest
+            first). Split out of _add_location so a completion batch pays
+            for ONE eviction sweep, not one per registered object."""
+            while self._inline_total > self._inline_budget \
+                    and self._inline_order:
+                old_oid = self._inline_order.popleft()
+                old_entry = self.objects.get(old_oid)
+                dropped = (old_entry.pop("inline", None)
+                           if old_entry else None)
+                if dropped is not None:
+                    self._inline_total -= len(dropped)
+                    self._stat_add("inline:gcs_evicted", 0.0, 1)
+
         def _add_location(oid: bytes, node_id: str, size: int,
-                          blob: bytes = None) -> None:
+                          blob: bytes = None, evict: bool = True) -> None:
             """One directory registration (shared by the add_object_location
             oneway and the registrations riding inside task_done_batch
             items). ``blob`` is an inline small result carried with the
             completion: the directory keeps the bytes and serves them
-            straight from locations responses — consumers never fetch."""
+            straight from locations responses — consumers never fetch.
+            ``evict=False`` defers the inline-budget sweep to the caller
+            (the batched completion path runs it once per batch)."""
             if oid in self._freed:
                 # Late registration of a freed object: keep it out of the
                 # directory and tell the holder to evict its copy.
@@ -3889,15 +4096,8 @@ class GcsServer:
                 entry["inline"] = blob
                 self._inline_total += len(blob)
                 self._inline_order.append(oid)
-                while self._inline_total > self._inline_budget \
-                        and self._inline_order:
-                    old_oid = self._inline_order.popleft()
-                    old_entry = self.objects.get(old_oid)
-                    dropped = (old_entry.pop("inline", None)
-                               if old_entry else None)
-                    if dropped is not None:
-                        self._inline_total -= len(dropped)
-                        self._stat_add("inline:gcs_evicted", 0.0, 1)
+                if evict:
+                    _evict_inline()
             entry["locations"].add(node_id)
             # Back in an arena: the node's SPILLED marker (if any) is stale.
             self._spilled_set(entry).discard(node_id)

@@ -237,15 +237,22 @@ class Cluster:
                            f"within {timeout}s (last: {last})")
 
     def add_node(self, resources: Optional[Dict[str, float]] = None,
-                 num_workers: int = 2) -> ClusterNode:
+                 num_workers: int = 2,
+                 env: Optional[Dict[str, str]] = None) -> ClusterNode:
+        """Start one more node process. ``env`` overlays extra variables on
+        just this node (e.g. RAY_TPU_WIRE_PICKLE_ONLY=1 to emulate an
+        old-wire peer in mixed-version smokes)."""
         log_path = tempfile.mktemp(prefix="ray_tpu_node_", suffix=".log")
+        penv = self._env()
+        if env:
+            penv.update(env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.launch", "node",
              "--gcs", self.address,
              "--resources", json.dumps(resources or {"CPU": 4}),
              "--num-workers", str(num_workers)],
             stdout=subprocess.PIPE, stderr=open(log_path, "w"), text=True,
-            env=self._env(),
+            env=penv,
         )
         evt = self._read_event(proc, log_path=log_path)
         node = ClusterNode(proc, evt["port"], evt.get("node_id", ""), log_path)

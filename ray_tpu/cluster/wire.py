@@ -60,11 +60,16 @@ MAGIC = 0xBF
 # (TASK_DONE3 / TASK_DONE_BATCH3): every completion carries worker-side
 # wall-clock ts_exec_start/ts_exec_end so the job profiler can attribute
 # queue vs exec vs registration time exactly, not just on the 1/64 trace
-# sample.
+# sample; v8 adds the columnar hot-path frames (SUBMIT_BATCH_COLS /
+# DISPATCH_WAVE): a homogeneous submit wave travels as ONE spec template
+# (shared header segments) plus packed per-task columns (ids, return ids,
+# arg tails) instead of N per-task structs, and the GCS relays each node's
+# whole wave the same way — receivers rebuild byte-identical spec blobs by
+# concatenating the template segments around the varying columns.
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 7
+WIRE_VERSION = 8
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -132,6 +137,15 @@ TASK_DONE_BATCH3 = 0x1E
 # window (ts_exec_start/ts_exec_end f64 pair) and exec_s, so the state
 # API and the job profiler see worker-side stamps without pickle.
 LIST_TASKS_RESP3 = 0x1F
+# Columnar hot-path frames (v8). SUBMIT_BATCH_COLS carries a driver's
+# submit flush as template runs (one shared spec header per run of
+# same-function/same-options tasks + packed task-id / return-id / arg-tail
+# columns) plus any non-conforming tasks as legacy per-task spec blobs —
+# one frame either way. DISPATCH_WAVE is its GCS->controller twin: each
+# node's whole dispatch wave rides as runs + singles in ONE scatter frame
+# that the controller explodes locally into byte-identical spec blobs.
+SUBMIT_BATCH_COLS = 0x20
+DISPATCH_WAVE = 0x21
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -170,6 +184,8 @@ FRAME_MIN_WIRE = {
     TASK_DONE3: 7,
     TASK_DONE_BATCH3: 7,
     LIST_TASKS_RESP3: 7,
+    SUBMIT_BATCH_COLS: 8,
+    DISPATCH_WAVE: 8,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -207,6 +223,20 @@ _F64 = struct.Struct("<d")
 def pickle_only() -> bool:
     """Send-side kill switch (decode support is unconditional)."""
     return os.environ.get("RAY_TPU_WIRE_PICKLE_ONLY", "") not in ("", "0")
+
+
+def columnar_submit_enabled() -> bool:
+    """Driver-side kill switch for the columnar submit path
+    (``RAY_TPU_COLUMNAR_SUBMIT=0`` forces the per-task legacy frames —
+    results must be byte-identical either way)."""
+    return os.environ.get("RAY_TPU_COLUMNAR_SUBMIT", "1") != "0"
+
+
+def dispatch_wave_enabled() -> bool:
+    """GCS-side kill switch for columnar dispatch relay
+    (``RAY_TPU_DISPATCH_WAVE=0`` materializes per-task spec blobs and
+    relays legacy assign_batch frames instead)."""
+    return os.environ.get("RAY_TPU_DISPATCH_WAVE", "1") != "0"
 
 
 class WireError(ValueError):
@@ -348,6 +378,60 @@ def _oids(ids) -> bytes:
 # task spec codec
 # --------------------------------------------------------------------------
 
+def encode_spec_segments(p: Dict[str, Any]) -> Tuple[bytes, bytes]:
+    """The two spec-header segments shared by every task of a columnar run:
+    ``seg_a`` (fn_id | name | max_retries — the bytes between the task id
+    and the return ids) and ``seg_b`` (deps | pin_refs | resources — the
+    bytes between the return ids and the args tail). Only v1 specs (no
+    trace, no deadline extension) split this way; the columnar path keeps
+    traced/deadline tasks on the per-task frames."""
+    seg_a = b"".join((
+        _b8(p.get("fn_id", b"")),
+        _s(p.get("name", "") or ""),
+        _I32.pack(int(p.get("max_retries", 0))),
+    ))
+    seg_b = b"".join((
+        _oids(p.get("deps", ())),
+        _oids(p.get("pin_refs", ())),
+        _resources(p.get("resources", {})),
+    ))
+    return seg_a, seg_b
+
+
+def encode_spec_tail(p: Dict[str, Any]) -> bytes:
+    """The per-task varying suffix of a spec: the args + kwargs sections."""
+    args = p.get("args", ())
+    parts = [_U16.pack(len(args))]
+    for kind, payload in args:
+        parts.append(_U8.pack(1 if kind == "ref" else 0))
+        parts.append(_U32.pack(len(payload)))
+        parts.append(payload)
+    kwargs = p.get("kwargs", {}) or {}
+    parts.append(_U16.pack(len(kwargs)))
+    for key, (kind, payload) in kwargs.items():
+        parts.append(_s(key))
+        parts.append(_U8.pack(1 if kind == "ref" else 0))
+        parts.append(_U32.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def build_spec(ver: int, seg_a: bytes, seg_b: bytes, task_id: bytes,
+               return_ids, tail: bytes) -> bytes:
+    """Reassemble one task's full spec bytes from its run template —
+    byte-identical to ``encode_task_spec`` of the original payload (the
+    run is just the spec split at its task-varying fields)."""
+    return b"".join((_U8.pack(ver), _b8(task_id), seg_a,
+                     _oids(return_ids), seg_b, tail))
+
+
+def build_spec_from_run(run: Dict[str, Any], i: int) -> bytes:
+    """Task ``i`` of a decoded columnar run, as full spec bytes."""
+    return build_spec(int(run.get("ver", SPEC_VERSION)),
+                      run["seg_a"], run["seg_b"], run["task_ids"][i],
+                      run["return_oids"][i], run["tails"][i])
+
+
 def encode_task_spec(p: Dict[str, Any]) -> bytes:
     """Pack a task payload once, on the owner. Header fields (what the GCS
     and controllers need) come first so relays parse them without touching
@@ -361,16 +445,13 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
         ver = SPEC_VERSION_TRACED
     else:
         ver = SPEC_VERSION
+    seg_a, seg_b = encode_spec_segments(p)
     parts = [
         _U8.pack(ver),
         _b8(p["task_id"]),
-        _b8(p.get("fn_id", b"")),
-        _s(p.get("name", "") or ""),
-        _I32.pack(int(p.get("max_retries", 0))),
+        seg_a,
         _oids(p.get("return_ids", ())),
-        _oids(p.get("deps", ())),
-        _oids(p.get("pin_refs", ())),
-        _resources(p.get("resources", {})),
+        seg_b,
     ]
     if ver == SPEC_VERSION_DEADLINE:
         flags = (SPEC_F_TRACE if trace else 0) \
@@ -381,19 +462,7 @@ def encode_task_spec(p: Dict[str, Any]) -> bytes:
             parts.append(_b8(trace))
     elif trace:
         parts.append(_b8(trace))
-    args = p.get("args", ())
-    parts.append(_U16.pack(len(args)))
-    for kind, payload in args:
-        parts.append(_U8.pack(1 if kind == "ref" else 0))
-        parts.append(_U32.pack(len(payload)))
-        parts.append(payload)
-    kwargs = p.get("kwargs", {}) or {}
-    parts.append(_U16.pack(len(kwargs)))
-    for key, (kind, payload) in kwargs.items():
-        parts.append(_s(key))
-        parts.append(_U8.pack(1 if kind == "ref" else 0))
-        parts.append(_U32.pack(len(payload)))
-        parts.append(payload)
+    parts.append(encode_spec_tail(p))
     return b"".join(parts)
 
 
@@ -1232,6 +1301,107 @@ def _dec_cancel_task(r: _Reader, rpc_id) -> Dict[str, Any]:
     return out
 
 
+def _enc_spec_runs(out: List[bytes], runs, singles) -> None:
+    """Shared body of the columnar frames: template runs (one header per
+    run, columnar task ids / return ids / arg tails) followed by legacy
+    per-task spec blobs for tasks that didn't fit a template."""
+    out.append(_U16.pack(len(runs)))
+    for run in runs:
+        task_ids = run["task_ids"]
+        return_oids = run["return_oids"]
+        tails = run["tails"]
+        out.append(_U8.pack(int(run.get("ver", SPEC_VERSION))))
+        seg_a = run["seg_a"]
+        out.append(_U32.pack(len(seg_a)))
+        out.append(seg_a)
+        seg_b = run["seg_b"]
+        out.append(_U32.pack(len(seg_b)))
+        out.append(seg_b)
+        out.append(_U32.pack(len(task_ids)))
+        for tid in task_ids:
+            out.append(_b8(tid))
+        for oids in return_oids:
+            out.append(_oids(oids))
+        for tail in tails:
+            out.append(_U32.pack(len(tail)))
+            out.append(tail)
+    out.append(_U32.pack(len(singles)))
+    for t in singles:
+        blob = t.get("_spec") if isinstance(t, dict) else t
+        if blob is None:
+            blob = encode_task_spec(t)
+        out.append(_U32.pack(len(blob)))
+        out.append(blob)
+
+
+def _dec_spec_runs(r: _Reader) -> Tuple[List[Dict[str, Any]],
+                                        List[Dict[str, Any]]]:
+    n_runs = r.count(r.u16())
+    runs: List[Dict[str, Any]] = []
+    for _ in range(n_runs):
+        ver = r.u8()
+        if ver != SPEC_VERSION:
+            raise WireError("columnar run requires v1 specs, got %d" % ver)
+        seg_a = r.b32()
+        seg_b = r.b32()
+        n = r.count(r.u32())
+        task_ids = [r.b8() for _ in range(n)]
+        return_oids = [_read_oids(r) for _ in range(n)]
+        tails = [r.b32() for _ in range(n)]
+        ra = _Reader(seg_a)
+        fn_id = ra.b8()
+        name = ra.s()
+        max_retries = ra.i32()
+        ra.done()
+        rb = _Reader(seg_b)
+        deps = _read_oids(rb)
+        pin_refs = _read_oids(rb)
+        resources = _read_resources(rb)
+        rb.done()
+        runs.append({
+            "ver": ver, "seg_a": seg_a, "seg_b": seg_b,
+            "fn_id": fn_id, "name": name, "max_retries": max_retries,
+            "deps": deps, "pin_refs": pin_refs, "resources": resources,
+            "task_ids": task_ids, "return_oids": return_oids,
+            "tails": tails,
+        })
+    n_singles = r.count(r.u32())
+    singles = [decode_task_spec_header(r.b32()) for _ in range(n_singles)]
+    return runs, singles
+
+
+def _enc_submit_batch_cols(msg, peer_wire: int = WIRE_VERSION
+                           ) -> Optional[List[bytes]]:
+    if peer_wire < 8:
+        return None  # pre-v8 peer can't parse 0x20: pickle carries it
+    out = [_head(SUBMIT_BATCH_COLS, msg.get("rpc_id"))]
+    _enc_spec_runs(out, msg["runs"], msg.get("singles") or ())
+    return out
+
+
+def _dec_submit_batch_cols(r: _Reader, rpc_id) -> Dict[str, Any]:
+    runs, singles = _dec_spec_runs(r)
+    r.done()
+    return {"type": "submit_batch_cols", "runs": runs,
+            "singles": singles, "rpc_id": rpc_id}
+
+
+def _enc_dispatch_wave(msg, peer_wire: int = WIRE_VERSION
+                       ) -> Optional[List[bytes]]:
+    if peer_wire < 8:
+        return None  # pre-v8 peer can't parse 0x21: pickle carries it
+    out = [_head(DISPATCH_WAVE, msg.get("rpc_id"))]
+    _enc_spec_runs(out, msg["runs"], msg.get("singles") or ())
+    return out
+
+
+def _dec_dispatch_wave(r: _Reader, rpc_id) -> Dict[str, Any]:
+    runs, singles = _dec_spec_runs(r)
+    r.done()
+    return {"type": "dispatch_wave", "runs": runs,
+            "singles": singles, "rpc_id": rpc_id}
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -1251,6 +1421,8 @@ _ENCODERS = {
     "repl_tail": _enc_repl_tail,
     "ha_status": _enc_ha_status,
     "cancel_task": _enc_cancel_task,
+    "submit_batch_cols": _enc_submit_batch_cols,
+    "dispatch_wave": _enc_dispatch_wave,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -1264,6 +1436,7 @@ _RESP_ENCODERS = {
     "list_tasks": _enc_list_tasks_resp,
     "repl_tail": _enc_repl_tail_resp,
     "ha_status": _enc_ha_status_resp,
+    "submit_batch_cols": _enc_submit_batch_resp,
 }
 
 _DECODERS = {
@@ -1298,6 +1471,8 @@ _DECODERS = {
     HA_STATUS: _dec_ha_status,
     HA_STATUS_RESP: _dec_ha_status_resp,
     CANCEL_TASK: _dec_cancel_task,
+    SUBMIT_BATCH_COLS: _dec_submit_batch_cols,
+    DISPATCH_WAVE: _dec_dispatch_wave,
 }
 
 

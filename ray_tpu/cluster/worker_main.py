@@ -283,7 +283,7 @@ def main():
     # and the "off" arm of the stamping-overhead A/B smoke.
     _exec_stamps_on = os.environ.get("RAY_TPU_EXEC_STAMPS", "1") != "0"
 
-    def _store_blob(oid: bytes, blob: bytes) -> None:
+    def _store_blob(oid: bytes, blob: bytes, adds: list) -> None:
         """Result store on the new data plane (see ARCHITECTURE.md
         "Result data plane"):
 
@@ -302,44 +302,51 @@ def main():
         if 0 < len(blob) <= cring.inline_result_max() \
                 and cring.ring_enabled():
             core.publish_completion(oid, len(blob), inline=blob)
-            _pending_adds.setdefault(
-                threading.get_ident(), []).append([oid, len(blob), blob])
+            adds.append([oid, len(blob), blob])
             return
         if core.local_store is not None and core.arena_admits(len(blob)):
             try:
                 core.local_store.put(oid, blob)
                 core.publish_completion(oid, len(blob))
-                _pending_adds.setdefault(
-                    threading.get_ident(), []).append([oid, len(blob)])
+                adds.append([oid, len(blob)])
                 return
             except Exception:  # noqa: BLE001 - arena full: RPC path
                 pass
         core.put_blob(oid, blob)
 
-    def store_result(oid: bytes, value: Any):
+    def _adds_list() -> list:
+        """This executor thread's pending "added" registrations, resolved
+        ONCE per task (the batched-bookkeeping mirror of the GCS
+        completion apply): every return object of a task appends to the
+        same list without re-paying the ident lookup + setdefault."""
+        return _pending_adds.setdefault(threading.get_ident(), [])
+
+    def store_result(oid: bytes, value: Any, adds: list):
         sobj = ser.serialize(value)
         # Refs returned inside the result stay pinned while it lives.
         core._report_contained(oid, sobj.contained_refs)
-        _store_blob(oid, VAL_PREFIX + sobj.to_bytes())
+        _store_blob(oid, VAL_PREFIX + sobj.to_bytes(), adds)
 
     def store_error(msg, exc: BaseException):
         if not isinstance(exc, TaskError):
             exc = TaskError(msg.get("name", "task"), exc)
         blob = ERR_PREFIX + pickle.dumps(exc)
+        adds = _adds_list()
         for oid in msg["return_ids"]:
-            _store_blob(oid, blob)
+            _store_blob(oid, blob, adds)
 
     def run_returns(msg, result):
         oids = msg["return_ids"]
+        adds = _adds_list()
         if len(oids) == 1:
-            store_result(oids[0], result)
+            store_result(oids[0], result, adds)
         else:
             if not isinstance(result, tuple) or len(result) != len(oids):
                 raise ValueError(
                     f"expected {len(oids)} returns, got {type(result).__name__}"
                 )
             for oid, val in zip(oids, result):
-                store_result(oid, val)
+                store_result(oid, val, adds)
 
     # ---- actor method concurrency -----------------------------------------
     # Cluster/local parity (reference: BoundedExecutor for max_concurrency,
@@ -572,7 +579,7 @@ def main():
                         actor_pool = ThreadPoolExecutor(
                             max_workers=int(msg["max_concurrency"]),
                             thread_name_prefix="actor-exec")
-                    store_result(msg["return_ids"][0], True)
+                    store_result(msg["return_ids"][0], True, _adds_list())
                 elif mtype == "execute_actor_task":
                     raise RuntimeError("actor not initialized")
                 else:

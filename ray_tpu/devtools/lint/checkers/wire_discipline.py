@@ -1,7 +1,8 @@
 """wire-discipline: static cross-check of the binary wire codec.
 
-``cluster/wire.py`` is at WIRE_VERSION 4 and still growing; every frame
-added carries four obligations that nothing enforced until now:
+``cluster/wire.py`` keeps growing (the version constant lives there, not
+here — this checker reads it from the AST); every frame added carries
+four obligations that nothing enforced until now:
 
   1. a frame id that collides with no other id (codes are append-only);
   2. a paired encoder + decoder, and the decoder registered in
@@ -15,6 +16,9 @@ added carries four obligations that nothing enforced until now:
   4. a round-trip case in ``tests/test_wire_codec.py`` (the static twin
      of PR 7's dynamic coverage lint) and a live handler/dispatch site in
      the cluster sources — a frame nobody handles is dead wire surface.
+     The coverage check parses the test module's ``_FRAME_CASES`` keys
+     (``wire.<FRAME>`` attributes), so a frame merely *mentioned* in a
+     comment or docstring no longer satisfies it.
 """
 
 from __future__ import annotations
@@ -295,15 +299,45 @@ class WireDisciplineChecker(Checker):
         # ---- 4b. codec-test coverage -----------------------------------
         test_mod = project.get(CODEC_TEST_PATH)
         if test_mod is not None:
-            for name in sorted(frame_codes):
-                if name not in test_mod.source:
+            case_keys = self._frame_case_keys(test_mod.tree)
+            if case_keys is None:
+                # No enumerable _FRAME_CASES dict: fall back to the weaker
+                # textual check so the rule degrades rather than vanishes.
+                for name in sorted(frame_codes):
+                    if name not in test_mod.source:
+                        yield Finding(
+                            rule=self.rule_id, path=mod.relpath,
+                            line=frame_lines[name], col=0,
+                            message=(f"frame {name} is never referenced in "
+                                     f"{CODEC_TEST_PATH}"),
+                            hint="add a round-trip + truncation case for it",
+                            symbol=name)
+            else:
+                for name in sorted(set(frame_codes) - case_keys):
                     yield Finding(
                         rule=self.rule_id, path=mod.relpath,
                         line=frame_lines[name], col=0,
-                        message=(f"frame {name} is never referenced in "
-                                 f"{CODEC_TEST_PATH}"),
-                        hint="add a round-trip + truncation case for it",
+                        message=(f"frame {name} has no _FRAME_CASES entry "
+                                 f"in {CODEC_TEST_PATH}"),
+                        hint="add a wire.<FRAME> round-trip case to "
+                             "_FRAME_CASES (textual mentions don't count)",
                         symbol=name)
+
+    @staticmethod
+    def _frame_case_keys(tree: ast.Module) -> Optional[Set[str]]:
+        """Frame names enumerated as ``wire.<FRAME>`` keys of the test
+        module's module-level ``_FRAME_CASES`` dict; None if the dict is
+        absent (older layouts)."""
+        table = _module_dict(tree, "_FRAME_CASES")
+        if table is None:
+            return None
+        keys: Set[str] = set()
+        for key in table.keys:
+            if isinstance(key, ast.Attribute) \
+                    and isinstance(key.value, ast.Name) \
+                    and key.value.id == "wire":
+                keys.add(key.attr)
+        return keys
 
     @staticmethod
     def _emitted_frames(fn: ast.AST) -> Set[str]:

@@ -61,7 +61,8 @@ def _phase_snapshot(core) -> dict:
         cell = handlers.get(f"phase:{name}")
         if cell is not None:
             out[name] = [cell["count"], cell["total_s"]]
-    for key in ("relay:opaque", "relay:pickled"):
+    for key in ("relay:opaque", "relay:pickled", "relay:wave",
+                "submit_batch_cols", "submit_batch"):
         cell = handlers.get(key)
         if cell is not None:
             out[key] = [cell["count"], cell["total_s"]]
@@ -83,14 +84,52 @@ def _phase_delta_ms_per_1k(before: dict, after: dict) -> dict:
         c1, s1 = after.get(name, [0, 0.0])
         dc, ds = c1 - c0, s1 - s0
         out[name] = round(ds / dc * 1e6, 3) if dc > 0 else None
-    for key in ("relay:opaque", "relay:pickled", *_RESULT_PATHS):
+    for key in ("relay:opaque", "relay:pickled", "relay:wave",
+                "submit_batch_cols", "submit_batch", *_RESULT_PATHS):
         out[key.replace(":", "_")] = (after.get(key, [0, 0.0])[0]
                                       - before.get(key, [0, 0.0])[0])
     return out
 
 
+# Both sides of the columnar hot path (the driver's template-batched
+# submit and the GCS's scatter dispatch waves) flip together per arm: an
+# A/B arm compares the whole path, not one half.
+_COLUMNAR_KNOBS = ("RAY_TPU_COLUMNAR_SUBMIT", "RAY_TPU_DISPATCH_WAVE")
+
+
+def _columnar_env(mode: str) -> dict:
+    """Env overlay for one columnar arm; {} for auto (ambient env)."""
+    if mode == "auto":
+        return {}
+    val = "1" if mode == "on" else "0"
+    return {k: val for k in _COLUMNAR_KNOBS}
+
+
+class _apply_env:
+    """Overlay env vars in THIS process (driver-side knob reads) and
+    restore on exit; subprocess components get the same overlay via
+    Cluster(extra_env=...)."""
+
+    def __init__(self, over: dict):
+        self.over = over
+        self.saved = {}
+
+    def __enter__(self):
+        for k, v in self.over.items():
+            self.saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self.saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
 def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
-            job_report: bool = False) -> dict:
+            job_report: bool = False, columnar: str = "auto") -> dict:
     import ray_tpu
     from ray_tpu.cluster.testing import Cluster
 
@@ -98,7 +137,19 @@ def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
     # table; the default lineage cap (max_lineage_size=100) would evict
     # most of a 5k batch before the profile pass reads it.
     extra_env = {"RAY_TPU_MAX_LINEAGE_SIZE": str(max(batch_k * 3, 1000))} \
-        if job_report else None
+        if job_report else {}
+    env_over = _columnar_env(columnar)
+    extra_env.update(env_over)
+    with _apply_env(env_over):
+        return _one_run_inner(serial_n, batch_k, record_ts, job_report,
+                              extra_env or None, columnar)
+
+
+def _one_run_inner(serial_n: int, batch_k: int, record_ts: bool,
+                   job_report: bool, extra_env, columnar: str) -> dict:
+    import ray_tpu
+    from ray_tpu.cluster.testing import Cluster
+
     c = Cluster(num_workers=2, extra_env=extra_env)
     ray_tpu.init(address=c.address)
     try:
@@ -142,6 +193,7 @@ def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
                "min_ms": round(lats[0] * 1e3, 3),
                "batch_tasks_per_sec": round(batch_k / dt, 1),
                "batch_warm_tasks_per_sec": round(batch_k / dt_warm, 1),
+               "columnar": columnar,
                "phases_ms_per_1k": phases}
         if record_ts:
             # Time-series snapshot of the run (--record): the GCS rollup
@@ -281,6 +333,12 @@ class _SimController:
         mtype = msg.get("type")
         if mtype == "assign_batch":
             tasks = msg.get("tasks", [])
+        elif mtype == "dispatch_wave":
+            # Same template expansion a real controller runs: the sim rows
+            # measure the scatter frame's control-plane cost end to end.
+            from ray_tpu.cluster.controller import NodeController
+
+            tasks = NodeController._explode_wave(msg)
         elif mtype == "assign_task":
             tasks = [msg]
         else:
@@ -311,9 +369,18 @@ class _SimController:
         self.cli.close()
 
 
-def sim_scaling_row(num_nodes: int, num_tasks: int) -> dict:
+def sim_scaling_row(num_nodes: int, num_tasks: int,
+                    columnar: str = "auto") -> dict:
     """One E2E control-plane run against ``num_nodes`` simulated
-    controllers: submit -> place -> relay -> complete -> directory."""
+    controllers: submit -> place -> relay -> complete -> directory.
+    ``columnar`` pins the hot-path arm for the whole row (the in-process
+    GCS reads the wave knob from this process's env)."""
+    with _apply_env(_columnar_env(columnar)):
+        return _sim_scaling_row_inner(num_nodes, num_tasks, columnar)
+
+
+def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
+                           columnar: str) -> dict:
     import threading
 
     from ray_tpu.cluster import wire
@@ -347,12 +414,31 @@ def sim_scaling_row(num_nodes: int, num_tasks: int) -> dict:
                 "return_ids": [oid], "resources": {"CPU": 1.0},
                 "max_retries": 0,
             })
+        # Columnar arm: probe the server wire so the v8 frame actually
+        # goes out binary (RpcClient starts conservative at peer_wire=1),
+        # then submit template runs the same way the real driver does.
+        use_cols = wire.columnar_submit_enabled() and not wire.pickle_only()
+        if use_cols:
+            resp = driver.call({"type": "wire_probe"})
+            if resp.get("ok"):
+                driver.peer_wire = max(driver.peer_wire,
+                                       int(resp.get("wire") or 1))
+            use_cols = driver.peer_wire >= 8
+        _cw = None
+        if use_cols:
+            from ray_tpu.cluster.core_worker import ClusterCoreWorker
+
+            _cw = object.__new__(ClusterCoreWorker)
         t0 = time.perf_counter()
         for i in range(0, num_tasks, 256):
             chunk = specs[i:i + 256]
-            for t in chunk:
-                t["_spec"] = wire.encode_task_spec(t)
-            driver.call({"type": "submit_batch", "tasks": chunk})
+            msg = _cw._build_columnar_submit(chunk) if _cw is not None \
+                else None
+            if msg is None:
+                for t in chunk:
+                    t["_spec"] = wire.encode_task_spec(t)
+                msg = {"type": "submit_batch", "tasks": chunk}
+            driver.call(msg)
         pending = set(oids)
         deadline = time.monotonic() + 120.0
         while pending and time.monotonic() < deadline:
@@ -368,9 +454,13 @@ def sim_scaling_row(num_nodes: int, num_tasks: int) -> dict:
             "nodes": num_nodes, "tasks": num_tasks,
             "completed": num_tasks - len(pending),
             "tasks_per_sec": round((num_tasks - len(pending)) / dt, 1),
+            "columnar": columnar,
             "relay_opaque": handlers.get("relay:opaque", {}).get("count", 0),
             "relay_pickled": handlers.get(
                 "relay:pickled", {}).get("count", 0),
+            "relay_wave": handlers.get("relay:wave", {}).get("count", 0),
+            "submit_cols": handlers.get(
+                "submit_batch_cols", {}).get("count", 0),
         }
         driver.close()
         return row
@@ -379,6 +469,83 @@ def sim_scaling_row(num_nodes: int, num_tasks: int) -> dict:
         for n in nodes:
             n.close()
         sim.stop()
+
+
+# The phases the columnar path targets; the A/B report tracks their
+# combined per-task cost next to the throughput ratio.
+_COLUMNAR_PHASES = ("submit_rpc", "dispatch_relay", "result_register")
+
+
+def ab_main(args) -> None:
+    """Interleaved columnar A/B: each pair runs both arms back to back in
+    fresh clusters, with the arm ORDER alternated pair-by-pair so a
+    monotone co-tenant drift penalizes both arms equally. The headline is
+    the MEDIAN of per-pair warm-throughput ratios — each ratio compares
+    two runs minutes apart, not two windows hours apart."""
+    pairs = []
+    for i in range(args.ab_pairs):
+        order = ("on", "off") if i % 2 == 0 else ("off", "on")
+        res = {}
+        for arm in order:
+            r = one_run(args.serial, args.batch, columnar=arm)
+            res[arm] = r
+            print(f"# pair {i + 1}/{args.ab_pairs} arm={arm}: "
+                  f"warm={r['batch_warm_tasks_per_sec']}/s "
+                  f"phases={r['phases_ms_per_1k']}", file=sys.stderr)
+        pairs.append(res)
+
+    def phase_cost(run):
+        ph = run["phases_ms_per_1k"]
+        return sum(ph.get(p) or 0.0 for p in _COLUMNAR_PHASES)
+
+    ratios = sorted(p["on"]["batch_warm_tasks_per_sec"]
+                    / p["off"]["batch_warm_tasks_per_sec"] for p in pairs)
+    cost_ratios = sorted(
+        phase_cost(p["on"]) / phase_cost(p["off"]) for p in pairs
+        if phase_cost(p["off"]) > 0)
+    out = {
+        "protocol": {"ab_pairs": args.ab_pairs, "serial_n": args.serial,
+                     "batch_k": args.batch, "interleaved": True,
+                     "fresh_cluster_per_run": True,
+                     "knobs": list(_COLUMNAR_KNOBS)},
+        "unix": int(time.time()),
+        "warm_ratio_median": round(statistics.median(ratios), 4),
+        "warm_ratios": [round(r, 4) for r in ratios],
+        "columnar_phase_cost_ratio_median":
+            round(statistics.median(cost_ratios), 4) if cost_ratios
+            else None,
+        "pairs": [
+            {arm: {"warm_tasks_per_sec": p[arm]["batch_warm_tasks_per_sec"],
+                   "cold_tasks_per_sec": p[arm]["batch_tasks_per_sec"],
+                   "phases_ms_per_1k": p[arm]["phases_ms_per_1k"]}
+             for arm in ("on", "off")}
+            for p in pairs],
+    }
+    if args.sim_nodes:
+        rows = []
+        for n in (int(x) for x in args.sim_nodes.split(",") if x):
+            pair = {}
+            for arm in ("on", "off"):
+                pair[arm] = sim_scaling_row(n, args.sim_tasks, columnar=arm)
+                print(f"# sim {n} nodes [{arm}]: {pair[arm]}",
+                      file=sys.stderr)
+            off_tps = pair["off"]["tasks_per_sec"] or 1.0
+            pair["ratio"] = round(pair["on"]["tasks_per_sec"] / off_tps, 4)
+            rows.append(pair)
+        out["sim_scaling_ab"] = rows
+    if args.note:
+        out["note"] = args.note
+    print(json.dumps(out))
+    if not args.no_record:
+        path = os.path.join(REPO, "BENCH_CONTROL_PLANE.json")
+        try:
+            with open(path) as f:
+                bench = json.load(f)
+        except (OSError, ValueError):
+            bench = []
+        bench.append({"kind": "columnar_ab", **out})
+        with open(path, "w") as f:
+            json.dump(bench, f, indent=2)
 
 
 def main():
@@ -390,6 +557,18 @@ def main():
                     help="comma list of simulated-controller counts "
                          "(e.g. 16,64,256) for the scaling rows")
     ap.add_argument("--sim-tasks", type=int, default=5000)
+    ap.add_argument("--columnar", choices=("on", "off", "auto"),
+                    default="auto",
+                    help="pin the columnar hot path (template-batched "
+                         "submit + dispatch waves) for every run: on/off "
+                         "force both env knobs, auto leaves ambient env")
+    ap.add_argument("--ab-pairs", type=int, default=0,
+                    help="interleaved columnar A/B: N (on,off) run pairs "
+                         "with arm order alternated pair-by-pair; reports "
+                         "per-pair warm-throughput ratios and their median "
+                         "(robust to slow co-tenant drift) and appends the "
+                         "result to BENCH_CONTROL_PLANE.json. --sim-nodes "
+                         "rows are also run once per arm.")
     ap.add_argument("--traces", action="store_true",
                     help="run ONE traced cluster window and print the "
                          "per-task straggler report instead of the "
@@ -415,13 +594,18 @@ def main():
         trace_run(args.batch, args.trace_top, args.trace_sample)
         return
 
+    if args.ab_pairs > 0:
+        ab_main(args)
+        return
+
     runs = []
     job_rep = None
     for i in range(args.runs):
         last = i == args.runs - 1
         r = one_run(args.serial, args.batch,
                     record_ts=args.record and last,
-                    job_report=args.job_report and last)
+                    job_report=args.job_report and last,
+                    columnar=args.columnar)
         ts_snap = r.pop("timeseries", None)
         job_rep = r.pop("job_report", job_rep)
         runs.append(r)
@@ -477,7 +661,7 @@ def main():
     if args.sim_nodes:
         rows = []
         for n in (int(x) for x in args.sim_nodes.split(",") if x):
-            row = sim_scaling_row(n, args.sim_tasks)
+            row = sim_scaling_row(n, args.sim_tasks, columnar=args.columnar)
             rows.append(row)
             print(f"# sim {n} nodes: {row}", file=sys.stderr)
         out["sim_scaling"] = rows

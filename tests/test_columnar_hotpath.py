@@ -1,0 +1,361 @@
+"""Columnar hot path E2E + unit coverage (ISSUE 17).
+
+Three layers:
+
+  * driver-side: heterogeneous submit waves (two fn_ids, per-task
+    ``.options`` overrides, ref-args) must split into columnar runs +
+    legacy singles and produce results identical to the
+    ``RAY_TPU_COLUMNAR_SUBMIT=0`` legacy arm;
+  * cluster-level: the columnar frames actually engage (handler stats),
+    the kill switch takes the legacy path, and a mixed-peer cluster with
+    one controller pinned to the old wire version stays correct;
+  * GCS-unit: the batched task_done_batch apply keeps the exact dedup /
+  	early-completion / release semantics of the per-item loop it replaced
+    (completion retries release shares and count phase stats exactly
+    once).
+"""
+
+import hashlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import wire
+from ray_tpu.cluster.testing import Cluster, _subprocess_env
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4}, num_workers=2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def driver(cluster):
+    ray_tpu.init(address=cluster.address, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _gcs_handlers(core):
+    return core.gcs.call({"type": "debug_stats"})["handlers"]
+
+
+def _count(handlers, key):
+    return handlers.get(key, {"count": 0})["count"]
+
+
+# The workload both arms of the byte-identity test run: two functions, a
+# per-task options override every 7th task, and a ref-arg chain every 5th
+# task — columnar runs, a fragmented run, and legacy singles all in one
+# wave. Deterministic, so the two arms must hash identically.
+_WORKLOAD = """
+import hashlib
+import ray_tpu
+
+@ray_tpu.remote
+def enc(i):
+    return (b"%d" % i) * 3
+
+@ray_tpu.remote
+def dub(x):
+    return x + x
+
+seeds = [enc.remote(i) for i in range(0, 120, 5)]
+refs = []
+for i in range(120):
+    if i % 5 == 0:
+        refs.append(dub.remote(seeds[i // 5]))      # ref-arg: legacy single
+    elif i % 7 == 0:
+        # Per-task override: different template key => separate run/single.
+        refs.append(enc.options(max_retries=3).remote(i))
+    elif i % 2 == 0:
+        refs.append(enc.remote(i))
+    else:
+        refs.append(dub.remote(b"%d" % i))
+out = ray_tpu.get(refs, timeout=120)
+h = hashlib.sha256(b"|".join(out)).hexdigest()
+print("WORKLOAD_SHA", h, flush=True)
+"""
+
+
+def _run_workload_subprocess(address, extra_env):
+    script = (f"import ray_tpu\n"
+              f"ray_tpu.init(address={address!r})\n"
+              + _WORKLOAD +
+              "ray_tpu.shutdown()\n")
+    env = _subprocess_env()
+    env.update(extra_env)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    for line in proc.stdout.splitlines():
+        if line.startswith("WORKLOAD_SHA"):
+            return line.split()[1]
+    raise AssertionError(f"no WORKLOAD_SHA in output: {proc.stdout}")
+
+
+def test_heterogeneous_wave_matches_legacy_arm(cluster):
+    """Byte-identity E2E: the same heterogeneous wave (two fn_ids,
+    .options overrides, ref-args) run with the columnar path ON (default)
+    and OFF (RAY_TPU_COLUMNAR_SUBMIT=0) hashes to the same result bytes."""
+    sha_on = _run_workload_subprocess(cluster.address, {})
+    sha_off = _run_workload_subprocess(
+        cluster.address, {"RAY_TPU_COLUMNAR_SUBMIT": "0"})
+    assert sha_on == sha_off
+
+
+def test_columnar_path_engages_and_relays_waves(driver):
+    """The fast path must actually be taken, not silently fall back:
+    homogeneous batches travel as submit_batch_cols frames and the GCS
+    relays dispatch waves (relay:wave advances, relay:pickled doesn't)."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker().core
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    # Warm the worker pool / fn export outside the measured window.
+    assert ray_tpu.get([one.remote() for _ in range(20)], timeout=60) \
+        == [1] * 20
+    before = _gcs_handlers(core)
+    n = 400
+    assert ray_tpu.get([one.remote() for _ in range(n)], timeout=120) \
+        == [1] * n
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        after = _gcs_handlers(core)
+        if _count(after, "phase:worker_exec") \
+                - _count(before, "phase:worker_exec") >= n:
+            break
+        time.sleep(0.2)
+    cols = _count(after, "submit_batch_cols") - _count(before,
+                                                       "submit_batch_cols")
+    waves = _count(after, "relay:wave") - _count(before, "relay:wave")
+    pickled = _count(after, "relay:pickled") - _count(before,
+                                                      "relay:pickled")
+    assert cols > 0, f"columnar submit never engaged: {after}"
+    assert waves > 0, f"no dispatch waves relayed: {after}"
+    assert pickled == 0, f"fast path fell back to pickle relay: {after}"
+
+
+def test_kill_switch_takes_legacy_frames(cluster):
+    """RAY_TPU_COLUMNAR_SUBMIT=0: the driver must use per-task
+    submit_batch frames only, with correct results."""
+    script = (
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={cluster.address!r})\n"
+        "from ray_tpu._private.worker import global_worker\n"
+        "core = global_worker().core\n"
+        "@ray_tpu.remote\n"
+        "def sq(x):\n"
+        "    return x * x\n"
+        "before = core.gcs.call({'type': 'debug_stats'})['handlers']\n"
+        "out = ray_tpu.get([sq.remote(i) for i in range(200)], timeout=90)\n"
+        "assert out == [i * i for i in range(200)], out\n"
+        "after = core.gcs.call({'type': 'debug_stats'})['handlers']\n"
+        "def cnt(h, k):\n"
+        "    return h.get(k, {'count': 0})['count']\n"
+        "cols = cnt(after, 'submit_batch_cols') "
+        "- cnt(before, 'submit_batch_cols')\n"
+        "legacy = cnt(after, 'submit_batch') - cnt(before, 'submit_batch')\n"
+        "assert cols == 0, ('kill switch ignored', cols)\n"
+        "assert legacy > 0, 'no legacy submit frames seen'\n"
+        "ray_tpu.shutdown()\n"
+        "print('KILL_SWITCH_OK', flush=True)\n"
+    )
+    env = _subprocess_env()
+    env["RAY_TPU_COLUMNAR_SUBMIT"] = "0"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "KILL_SWITCH_OK" in proc.stdout
+
+
+def test_mixed_peer_cluster_smoke():
+    """One controller pinned to the old wire (pickle-only => advertises
+    wire 0): the GCS must relay legacy frames to it — materializing specs
+    from the template — while the modern node keeps taking waves. Both
+    execute correctly."""
+    c = Cluster(head_resources={"CPU": 2}, num_workers=2)
+    try:
+        c.add_node(resources={"CPU": 2}, num_workers=2,
+                   env={"RAY_TPU_WIRE_PICKLE_ONLY": "1"})
+        c.wait_for_nodes(2)
+        ray_tpu.init(address=c.address, ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            def ident(i):
+                return i
+
+            # 4 CPU shares across both nodes: a 300-task wave spreads over
+            # the old and new controllers alike.
+            out = ray_tpu.get([ident.remote(i) for i in range(300)],
+                              timeout=180)
+            assert out == list(range(300))
+            from ray_tpu._private.worker import global_worker
+
+            handlers = _gcs_handlers(global_worker().core)
+            # The modern node still received waves; the pickled relay
+            # carried the old node's share.
+            assert _count(handlers, "submit_batch_cols") > 0
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_template_expansion_byte_identity_unit():
+    """Driver-side unit: _build_columnar_submit's runs rebuild, per task,
+    the exact bytes encode_task_spec would have produced."""
+    payloads = []
+    for i in range(8):
+        payloads.append({
+            "task_id": bytes([i]) * 16, "name": "f", "fn_id": b"F" * 16,
+            "args": [("value", b"a" * i)], "kwargs": {},
+            "deps": [], "pin_refs": [], "return_ids": [bytes([i]) * 24],
+            "resources": {"CPU": 1.0}, "max_retries": 1,
+        })
+    # A trace-carrying task and a dep-carrying task must land in singles.
+    payloads.append(dict(payloads[0], task_id=b"X" * 16, trace=b"tr",
+                         return_ids=[b"X" * 24]))
+    payloads.append(dict(payloads[0], task_id=b"Y" * 16,
+                         deps=[b"D" * 24], return_ids=[b"Y" * 24]))
+    from ray_tpu.cluster.core_worker import ClusterCoreWorker
+
+    cw = object.__new__(ClusterCoreWorker)  # method only touches _template_key
+    msg = cw._build_columnar_submit(payloads)
+    assert msg is not None and msg["type"] == "submit_batch_cols"
+    assert len(msg["runs"]) == 1
+    run = msg["runs"][0]
+    for i in range(8):
+        assert wire.build_spec(run["ver"], run["seg_a"], run["seg_b"],
+                               run["task_ids"][i], run["return_oids"][i],
+                               run["tails"][i]) \
+            == wire.encode_task_spec(payloads[i])
+    singles = {t["task_id"] for t in msg["singles"]}
+    assert singles == {b"X" * 16, b"Y" * 16}
+    for t in msg["singles"]:
+        assert t["_spec"] == wire.encode_task_spec(t)
+
+
+class TestBatchedCompletionApply:
+    """GCS-unit pins for the vectorized task_done_batch apply: exactly-
+    once release/stats under completion retry, within-batch dup collapse,
+    batched early-done set maintenance, and the one-sweep inline budget."""
+
+    def _gcs(self):
+        from ray_tpu._private.config import Config
+        from ray_tpu.cluster.gcs import GcsServer, NodeEntry
+
+        g = GcsServer(Config())
+        g.nodes["nodeA"] = NodeEntry("nodeA", ("127.0.0.1", 1),
+                                     {"CPU": 4.0}, index=0)
+        return g
+
+    def _seed_dispatched(self, g, tid, oid):
+        payload = {"task_id": tid, "return_ids": [oid],
+                   "resources": {"CPU": 1.0}, "deps": []}
+        rec = {"task_id": tid, "payload": payload, "kind": "task",
+               "resources": {"CPU": 1.0}, "retries_left": 0,
+               "state": "DISPATCHED", "node_id": "nodeA",
+               "cancelled": False, "return_ids": [oid],
+               "ts_submit": 0.0, "ts_dispatch": 0.0, "ts_finish": 0.0,
+               "pending_reason": ""}
+        g.task_table[tid] = rec
+        g.nodes["nodeA"].available["CPU"] -= 1.0
+        return rec
+
+    def _apply(self, g, items):
+        import asyncio
+
+        handler = g.server._handlers["task_done_batch"]
+        asyncio.run(handler({"type": "task_done_batch", "node_id": "nodeA",
+                             "items": items}, None))
+
+    def _stat(self, g, key):
+        cell = g.server.handler_stats.get(key)
+        return (cell[0], cell[1]) if cell else (0, 0.0)
+
+    def test_completion_retry_releases_and_counts_once(self):
+        g = self._gcs()
+        rec = self._seed_dispatched(g, b"t1" * 8, b"o1" * 12)
+        item = {"task_id": b"t1" * 8, "resources": {"CPU": 1.0},
+                "exec_s": 0.5, "reg_s": 0.25,
+                "added": [[b"o1" * 12, 3]]}
+        self._apply(g, [item])
+        assert rec["state"] == "FINISHED"
+        assert g.nodes["nodeA"].available["CPU"] == 4.0
+        assert self._stat(g, "phase:worker_exec") == (1, 0.5)
+        assert self._stat(g, "phase:result_register") == (1, 0.25)
+        # The controller re-sends the whole batch after a reconnect: the
+        # dup must not release again, not re-count stats — but its
+        # "added" registration still applies (idempotent directory add).
+        self._apply(g, [item])
+        assert g.nodes["nodeA"].available["CPU"] == 4.0
+        assert self._stat(g, "phase:worker_exec") == (1, 0.5)
+        assert "nodeA" in g.objects[b"o1" * 12]["locations"]
+
+    def test_within_batch_duplicate_counts_once(self):
+        g = self._gcs()
+        self._seed_dispatched(g, b"t2" * 8, b"o2" * 12)
+        item = {"task_id": b"t2" * 8, "resources": {"CPU": 1.0},
+                "exec_s": 0.5, "reg_s": 0.0, "added": []}
+        self._apply(g, [item, dict(item)])
+        assert g.nodes["nodeA"].available["CPU"] == 4.0
+        assert self._stat(g, "phase:worker_exec")[0] == 1
+
+    def test_summed_release_matches_sequential(self):
+        g = self._gcs()
+        recs = [self._seed_dispatched(g, bytes([i]) * 16, bytes([i]) * 24)
+                for i in range(3)]
+        assert g.nodes["nodeA"].available["CPU"] == 1.0
+        self._apply(g, [{"task_id": bytes([i]) * 16,
+                         "resources": {"CPU": 1.0}, "exec_s": 0.1,
+                         "reg_s": 0.0, "added": []} for i in range(3)])
+        assert g.nodes["nodeA"].available["CPU"] == 4.0
+        assert all(r["state"] == "FINISHED" for r in recs)
+        assert self._stat(g, "phase:worker_exec")[0] == 3
+
+    def test_early_completion_set_ops_and_retry_dedup(self):
+        g = self._gcs()
+        item = {"task_id": b"e1" * 8, "resources": {"CPU": 1.0},
+                "exec_s": 0.5, "reg_s": 0.0, "added": []}
+        self._apply(g, [item])
+        assert b"e1" * 8 in g._early_task_done
+        n0 = self._stat(g, "phase:worker_exec")[0]
+        # Retry of an early completion: dedup against the early set — no
+        # second stat, no second release.
+        avail = g.nodes["nodeA"].available["CPU"]
+        self._apply(g, [item])
+        assert self._stat(g, "phase:worker_exec")[0] == n0
+        assert g.nodes["nodeA"].available["CPU"] == avail
+
+    def test_early_order_trim_is_batched(self):
+        g = self._gcs()
+        items = [{"task_id": i.to_bytes(16, "big"), "resources": {},
+                  "exec_s": 0.0, "reg_s": 0.0, "added": []}
+                 for i in range(10_500)]
+        self._apply(g, items)
+        assert len(g._early_task_done_order) == 10_000
+        assert len(g._early_task_done) == 10_000
+        assert set(g._early_task_done_order) == g._early_task_done
+
+    def test_inline_budget_swept_once_per_batch(self):
+        g = self._gcs()
+        g._inline_budget = 64
+        self._apply(g, [{"task_id": None, "resources": {}, "added":
+                         [[bytes([i]) * 24, 32, bytes([i]) * 32]]}
+                        for i in range(8)])
+        assert g._inline_total <= 64
+        kept = [oid for oid, e in g.objects.items() if "inline" in e]
+        # Oldest evicted first: the survivors are the newest registrations.
+        assert kept and all(oid[0] >= 6 for oid in kept)

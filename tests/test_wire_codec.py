@@ -814,12 +814,139 @@ class TestCancelFrame:
                 wire.decode(body[:cut])
 
 
+def _make_run(tasks):
+    """Columnar run dict from same-template task payloads (the shape the
+    driver's _build_columnar_submit and the GCS's _wave_msg both emit)."""
+    seg_a, seg_b = wire.encode_spec_segments(tasks[0])
+    return {"ver": wire.SPEC_VERSION, "seg_a": seg_a, "seg_b": seg_b,
+            "task_ids": [t["task_id"] for t in tasks],
+            "return_oids": [t["return_ids"] for t in tasks],
+            "tails": [wire.encode_spec_tail(t) for t in tasks]}
+
+
+def _run_task_payloads(rng, n, fn_id=b"C" * 16, name="col"):
+    """n payloads sharing one template (varying ids/returns/args only)."""
+    out = []
+    for i in range(n):
+        out.append({
+            "task_id": bytes(rng.getrandbits(8) for _ in range(16)),
+            "fn_id": fn_id, "name": name, "max_retries": 2,
+            "return_ids": [_rand_oid(rng)
+                           for _ in range(rng.randint(1, 2))],
+            "deps": [], "pin_refs": [], "resources": {"CPU": 1.0},
+            "args": [("value", bytes(rng.getrandbits(8)
+                                     for _ in range(rng.randint(0, 64))))],
+            "kwargs": ({"k": ("value", b"v" * i)} if i % 2 else {}),
+        })
+    return out
+
+
+class TestColumnarFrames:
+    """SUBMIT_BATCH_COLS (0x20) / DISPATCH_WAVE (0x21), wire v8: one spec
+    template per run + columnar per-task ids/returns/arg tails, with
+    legacy per-task spec blobs riding as singles."""
+
+    def test_submit_cols_round_trip_byte_identity(self):
+        rng = random.Random(23)
+        tasks_a = _run_task_payloads(rng, 5)
+        tasks_b = _run_task_payloads(rng, 3, fn_id=b"D" * 16, name="col2")
+        single = _rand_spec(rng, 0)
+        msg = {"type": "submit_batch_cols",
+               "runs": [_make_run(tasks_a), _make_run(tasks_b)],
+               "singles": [{"_spec": wire.encode_task_spec(single)}],
+               "rpc_id": 9}
+        out = _rt(msg)
+        assert out["type"] == "submit_batch_cols" and out["rpc_id"] == 9
+        assert len(out["runs"]) == 2 and len(out["singles"]) == 1
+        for run, tasks in zip(out["runs"], (tasks_a, tasks_b)):
+            # The decoder parses the template once per run...
+            assert run["fn_id"] == tasks[0]["fn_id"]
+            assert run["name"] == tasks[0]["name"]
+            assert run["max_retries"] == 2
+            assert run["resources"] == {"CPU": 1.0}
+            assert run["deps"] == [] and run["pin_refs"] == []
+            # ...and every task's spec rebuilds byte-identically to the
+            # legacy per-task encoding.
+            for i, t in enumerate(tasks):
+                assert wire.build_spec_from_run(run, i) \
+                    == wire.encode_task_spec(t)
+        assert out["singles"][0]["task_id"] == single["task_id"]
+
+    def test_dispatch_wave_round_trip(self):
+        rng = random.Random(29)
+        tasks = _run_task_payloads(rng, 4)
+        single_blob = wire.encode_task_spec(_rand_spec(rng, 1))
+        msg = {"type": "dispatch_wave", "runs": [_make_run(tasks)],
+               "singles": [single_blob]}
+        out = _rt(msg)
+        assert out["type"] == "dispatch_wave"
+        assert out["singles"][0]["_spec"] == single_blob
+        run = out["runs"][0]
+        for i, t in enumerate(tasks):
+            assert wire.build_spec_from_run(run, i) \
+                == wire.encode_task_spec(t)
+        # A decoded wave re-encodes verbatim (the HA log replicates the
+        # decoded message dict).
+        again = wire.decode(b"".join(wire.encode(out)))
+        assert again["runs"][0]["task_ids"] == run["task_ids"]
+
+    def test_pre_v8_peer_gets_pickle_fallback(self):
+        rng = random.Random(31)
+        run = _make_run(_run_task_payloads(rng, 2))
+        for mtype in ("submit_batch_cols", "dispatch_wave"):
+            msg = {"type": mtype, "runs": [run], "singles": []}
+            assert wire.encode(msg, peer_wire=7) is None
+            assert wire.encode(msg, peer_wire=8) is not None
+
+    def test_non_v1_run_rejected(self):
+        rng = random.Random(37)
+        run = dict(_make_run(_run_task_payloads(rng, 2)),
+                   ver=wire.SPEC_VERSION_TRACED)
+        body = b"".join(wire.encode(
+            {"type": "submit_batch_cols", "runs": [run], "singles": []}))
+        with pytest.raises(wire.WireError):
+            wire.decode(body)
+
+    def test_truncated_columnar_frames_raise(self):
+        rng = random.Random(41)
+        msg = {"type": "submit_batch_cols",
+               "runs": [_make_run(_run_task_payloads(rng, 3))],
+               "singles": [{"_spec": _coverage_spec_blob()}]}
+        body = b"".join(wire.encode(msg))
+        assert body[1] == wire.SUBMIT_BATCH_COLS
+        for cut in range(0, len(body), max(1, len(body) // 23)):
+            with pytest.raises(wire.WireError):
+                wire.decode(body[:cut])
+
+    def test_garbage_columnar_bodies_raise(self):
+        rng = random.Random(43)
+        for code in (wire.SUBMIT_BATCH_COLS, wire.DISPATCH_WAVE):
+            for _ in range(60):
+                body = (struct.pack("<BBQ", wire.MAGIC, code, 0)
+                        + bytes(rng.getrandbits(8)
+                                for _ in range(rng.randint(0, 64))))
+                try:
+                    wire.decode(body)
+                except wire.WireError:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    pytest.fail(f"non-WireError escaped decode: {e!r}")
+
+
 def _coverage_spec_blob():
     return wire.encode_task_spec({
         "task_id": b"T" * 16, "fn_id": b"F" * 16, "name": "f",
         "max_retries": 0, "return_ids": [b"R" * 24], "deps": [],
         "pin_refs": [], "resources": {"CPU": 1.0}, "args": [],
         "kwargs": {}})
+
+
+def _coverage_run():
+    return _make_run([{
+        "task_id": tid, "fn_id": b"F" * 16, "name": "f", "max_retries": 1,
+        "return_ids": [rid], "deps": [], "pin_refs": [],
+        "resources": {"CPU": 1.0}, "args": [("value", tid)], "kwargs": {},
+    } for tid, rid in ((b"T" * 16, b"R" * 24), (b"U" * 16, b"S" * 24))])
 
 
 # One encode case per registered frame code. kind "req" goes through
@@ -919,6 +1046,12 @@ _FRAME_CASES = {
     wire.CANCEL_TASK: ("req", lambda: {
         "type": "cancel_task", "task_id": b"T" * 16,
         "object_id": b"R" * 24, "force": True, "rpc_id": 5}),
+    wire.SUBMIT_BATCH_COLS: ("req", lambda: {
+        "type": "submit_batch_cols", "runs": [_coverage_run()],
+        "singles": [{"_spec": _coverage_spec_blob()}], "rpc_id": 6}),
+    wire.DISPATCH_WAVE: ("req", lambda: {
+        "type": "dispatch_wave", "runs": [_coverage_run()],
+        "singles": [_coverage_spec_blob()]}),
     wire.HA_STATUS: ("req", lambda: {"type": "ha_status", "rpc_id": 3}),
     wire.HA_STATUS_RESP: (("resp", "ha_status"), lambda: {
         "ok": True, "epoch": 4, "is_leader": True, "role": "leader",
