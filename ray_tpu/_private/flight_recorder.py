@@ -27,6 +27,9 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from .loopmon import enabled as _loopmon_enabled
+from .loopmon import thread_cpu_ns as _thread_cpu_ns
+
 COMPONENTS = ("gcs", "controller", "worker", "driver")
 
 DEFAULT_HZ = 20.0
@@ -71,6 +74,18 @@ class FlightRecorder:
         self.component = component
         self.hz = float(hz) if hz else sample_hz()
         self._counts: Dict[str, int] = {}
+        # Parallel on-CPU weight per folded stack: each sample adds the
+        # fraction of the inter-sample window its thread spent on-CPU
+        # (schedstat delta / wall delta), so a thread blocked in recv
+        # accumulates wall samples but ~0 on-CPU weight — the PR 12
+        # self-time lie, closed at the source.
+        self._oncpu: Dict[str, float] = {}
+        self._cpu_prev: Dict[int, int] = {}     # python ident -> cpu ns
+        self._cpu_prev_t: float = 0.0           # perf_counter of last pass
+        self.cpu_tagging = False                # procfs delivered at least once
+        # RAY_TPU_LOOPMON=0 also drops the tagging reads, so the
+        # observatory kill switch yields a byte-stock sampler hot path.
+        self._tag_cpu = _loopmon_enabled()
         self._counts_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -127,7 +142,21 @@ class FlightRecorder:
         self.samples += 1
         code_cache = self._code_cache
         stack_cache = self._stack_cache
+        # Python ident -> native tid map for the on-CPU clock reads
+        # (sys._current_frames keys are Python idents; /proc/self/task
+        # wants kernel tids). enumerate() is a lock + list copy — cheap
+        # against the procfs reads that follow.
+        native = {}
+        if self._tag_cpu:
+            for t in threading.enumerate():
+                nid = getattr(t, "native_id", None)
+                if t.ident is not None and nid is not None:
+                    native[t.ident] = nid
+        now = time.perf_counter()
+        wall_ns = (now - self._cpu_prev_t) * 1e9 \
+            if self._cpu_prev_t else 0.0
         folded = []
+        cpu_seen: Dict[int, int] = {}
         for ident, frame in frames.items():
             if ident == own_ident:
                 continue
@@ -151,14 +180,33 @@ class FlightRecorder:
                 key = ";".join(parts)
                 if len(stack_cache) < 4 * MAX_STACKS:
                     stack_cache[codes_t] = key
-            folded.append(key)
+            # on-CPU fraction of the inter-sample window for this thread:
+            # schedstat cpu-ns delta / wall-ns. 1.0 when procfs is
+            # unavailable (wall==on-CPU, the old degraded semantics).
+            frac = 1.0
+            tid = native.get(ident)
+            if tid is not None:
+                ns = _thread_cpu_ns(tid)
+                if ns is not None:
+                    self.cpu_tagging = True
+                    cpu_seen[ident] = ns
+                    prev = self._cpu_prev.get(ident)
+                    if prev is None or wall_ns <= 0:
+                        frac = 0.0  # first sight: no window to judge
+                    else:
+                        frac = min(max((ns - prev) / wall_ns, 0.0), 1.0)
+            folded.append((key, frac))
         del frames
+        self._cpu_prev = cpu_seen
+        self._cpu_prev_t = now
         with self._counts_lock:
-            for key in folded:
+            for key, frac in folded:
                 if key not in self._counts and \
                         len(self._counts) >= MAX_STACKS:
                     key = OVERFLOW_KEY
                 self._counts[key] = self._counts.get(key, 0) + 1
+                if frac:
+                    self._oncpu[key] = self._oncpu.get(key, 0.0) + frac
                 self.stacks_folded += 1
 
     # ----------------------------------------------------------------- sinks
@@ -167,11 +215,25 @@ class FlightRecorder:
         whoever drains first owns the window's samples)."""
         with self._counts_lock:
             counts, self._counts = self._counts, {}
+            self._oncpu = {}
         return counts
+
+    def drain_tagged(self) -> tuple:
+        """(wall_counts, oncpu_weights) — the tagged flush the producers
+        ship so `cli profile` can print wall and on-CPU columns instead
+        of one conflated self-time figure."""
+        with self._counts_lock:
+            counts, self._counts = self._counts, {}
+            oncpu, self._oncpu = self._oncpu, {}
+        return counts, {k: round(v, 2) for k, v in oncpu.items() if v}
 
     def snapshot(self) -> Dict[str, int]:
         with self._counts_lock:
             return dict(self._counts)
+
+    def snapshot_oncpu(self) -> Dict[str, float]:
+        with self._counts_lock:
+            return dict(self._oncpu)
 
 
 # --------------------------------------------------------------------------
@@ -257,3 +319,37 @@ def self_time_table(counts: Dict[str, int], top: int = 25) -> list:
             cum_n[f] = cum_n.get(f, 0) + n
     ranked = sorted(self_n.items(), key=lambda kv: -kv[1])[:top]
     return [(f, n, cum_n.get(f, n), 100.0 * n / total) for f, n in ranked]
+
+
+def attribution_table(counts: Dict[str, int],
+                      oncpu: Optional[Dict[str, float]] = None,
+                      top: int = 25) -> list:
+    """Top-N leaf frames with wall AND on-CPU columns (the PR 12 fix:
+    blocked-in-recv shows big wall, ~0 on-CPU — never again a single
+    "self-time" number that conflates the two).
+
+    Returns [(frame, wall_n, oncpu_n, cum_n, wall_pct)], wall-descending.
+    ``oncpu`` is the per-stack on-CPU sample weight from
+    ``drain_tagged()``; a missing stack key means ~0 on-CPU (weightless
+    entries are dropped at drain). ``oncpu=None`` means no tagging ran —
+    every oncpu_n comes back None so renderers show the honest '-'
+    rather than a wall==on-CPU lie."""
+    total = sum(counts.values())
+    if not total:
+        return []
+    wall_n: Dict[str, int] = {}
+    cpu_n: Dict[str, float] = {}
+    cum_n: Dict[str, int] = {}
+    for stack, n in counts.items():
+        frames = stack.split(";")
+        leaf = frames[-1]
+        wall_n[leaf] = wall_n.get(leaf, 0) + n
+        if oncpu is not None:
+            cpu_n[leaf] = cpu_n.get(leaf, 0.0) \
+                + float(oncpu.get(stack, 0.0))
+        for f in set(frames):
+            cum_n[f] = cum_n.get(f, 0) + n
+    ranked = sorted(wall_n.items(), key=lambda kv: -kv[1])[:top]
+    return [(f, n,
+             round(cpu_n.get(f, 0.0), 1) if oncpu is not None else None,
+             cum_n.get(f, n), 100.0 * n / total) for f, n in ranked]

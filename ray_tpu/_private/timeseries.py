@@ -188,6 +188,16 @@ def latest_value(points: Sequence[Sequence],
     return cell.get(key)
 
 
+def gauge_window(points: Sequence[Sequence], since: float,
+                 key: str = "last") -> List[float]:
+    """Every gauge-cell ``key`` value in buckets at/after ``since``,
+    oldest-first. The sustained-breach primitive: a gauge-ceiling SLO
+    fires only when min() of this window exceeds the threshold, and the
+    `cli top`/`cli loops` loop-lag rows read the same slice."""
+    return [c[key] for t, c in points
+            if t >= since and c.get(key) is not None]
+
+
 def merge_hist(cells: Iterable[Dict]) -> Dict:
     """Additively merge hist cells (e.g. every bucket of a window) into one
     {buckets, sum, count} distribution."""

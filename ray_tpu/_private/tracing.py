@@ -222,3 +222,124 @@ def straggler_report(spans: List[Dict[str, Any]], top_k: int = 10) -> str:
                      f"{rec['total_ms']:>9.3f} " + " ".join(cells)
                      + (f"  [{via}]" if via else ""))
     return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# wall-clock conservation ledger (the PR-18 observatory invariant)
+# --------------------------------------------------------------------------
+
+# Gap buckets the ledger can name, in ledger order. ``worker_queue`` is
+# measured per-task from the trace itself (dispatch handoff -> exec
+# start, i.e. time spent queued behind other tasks at the worker); the
+# other four are inferred from the observatory's window aggregates.
+GAP_BUCKETS = ("worker_queue", "head_loop_lag", "callback_run",
+               "socket_dwell", "ctx_switch")
+
+# Context-switch cost proxy: direct switch cost plus the cache/GIL
+# reacquisition tail — a *proxy*, stated as such everywhere it prints
+# (microbenchmarks put a Linux switch at 1–5 µs; we take the low end so
+# the bucket can only under-claim).
+CTX_SWITCH_US = 2.0
+
+
+def conservation_ledger(traces: Dict[str, Dict[str, Any]],
+                        window: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Phases + named gap buckets must sum to end-to-end wall within ε.
+
+    ``traces``: :func:`group_traces` output for the window's sampled
+    tasks — per task, e2e = last span end - first span start and the gap
+    is the inter-span wall the 7 phases do NOT cover. ``window``: the
+    observatory aggregates over the same wall window::
+
+        {"tasks": n,            # tasks completed in the window
+         "lag_s": float,        # head loop-lag sum (loopmon heartbeat)
+         "cb_s": float,         # head callback run time (loopmon)
+         "handler_s": float,    # head handler seconds (already inside
+                                # the gcs-side phases; subtracted from
+                                # cb_s so callback_run is the *extra*)
+         "dwell_s": float,      # head select/poll dwell (informational)
+         "socket_dwell_s": float,  # driver blocked-in-recv seconds
+         "ctx": int}            # process ctx switches in the window
+
+    Each gap bucket is scaled to µs/task and *capped at the measured
+    gap* — the ledger may under-explain (coverage < 1) but can never
+    invent wall time. Returns phase/gap µs-per-task rows plus
+    ``coverage`` = (phases + explained gaps) / e2e."""
+    phase_us = {p: 0.0 for p in PHASES}
+    e2e_us = 0.0
+    queue_us = 0.0
+    n = 0
+    for rec in traces.values():
+        ph = rec.get("phases") or {}
+        if not ph:
+            continue
+        n += 1
+        e2e_us += (max(w[1] for w in ph.values())
+                   - min(w[0] for w in ph.values())) * 1e6
+        for p, w in ph.items():
+            if p in phase_us:
+                phase_us[p] += (w[1] - w[0]) * 1e6
+        # Worker-queue wait is exact per task: the dispatch frame is on
+        # the worker's wire, execution hasn't started — the task is
+        # sitting behind others in the worker's run queue.
+        if "dispatch_relay" in ph and "worker_exec" in ph:
+            queue_us += max(
+                0.0, (ph["worker_exec"][0] - ph["dispatch_relay"][1]) * 1e6)
+    if not n:
+        return {"tasks": 0, "e2e_us": 0.0, "phase_us": {},
+                "gap_us": 0.0, "buckets_us": {}, "explained_us": 0.0,
+                "coverage": 0.0}
+    e2e_us /= n
+    phase_us = {p: v / n for p, v in phase_us.items()}
+    phase_sum = sum(phase_us.values())
+    gap_us = max(0.0, e2e_us - phase_sum)
+
+    buckets = {b: 0.0 for b in GAP_BUCKETS}
+    buckets["worker_queue"] = queue_us / n
+    if window and window.get("tasks"):
+        per = 1e6 / max(float(window["tasks"]), 1.0)
+        buckets["head_loop_lag"] = float(window.get("lag_s") or 0.0) * per
+        buckets["callback_run"] = max(
+            0.0, float(window.get("cb_s") or 0.0)
+            - float(window.get("handler_s") or 0.0)) * per
+        buckets["socket_dwell"] = \
+            float(window.get("socket_dwell_s") or 0.0) * per
+        buckets["ctx_switch"] = \
+            float(window.get("ctx") or 0) * CTX_SWITCH_US \
+            / max(float(window["tasks"]), 1.0)
+    # Conservation discipline: never explain more gap than exists.
+    claimed = sum(buckets.values())
+    if claimed > gap_us and claimed > 0:
+        scale = gap_us / claimed
+        buckets = {b: v * scale for b, v in buckets.items()}
+    explained = sum(buckets.values())
+    return {
+        "tasks": n, "e2e_us": e2e_us, "phase_us": phase_us,
+        "phase_sum_us": phase_sum, "gap_us": gap_us,
+        "buckets_us": buckets, "explained_us": explained,
+        "coverage": min(1.0, (phase_sum + explained) / max(e2e_us, 1e-9)),
+    }
+
+
+def ledger_table(ledger: Dict[str, Any]) -> str:
+    """Render a conservation ledger as the fixed-width table `cli loops`,
+    scripts/cluster_lat.py --ledger and PERF.md share."""
+    if not ledger.get("tasks"):
+        return "conservation ledger: no sampled traces in window"
+    lines = [f"conservation ledger over {ledger['tasks']} sampled tasks "
+             f"(µs/task; e2e = {ledger['e2e_us']:.1f})",
+             f"{'BUCKET':<22} {'µs/task':>10} {'% e2e':>7}"]
+    e2e = max(ledger["e2e_us"], 1e-9)
+    for p in PHASES:
+        v = ledger["phase_us"].get(p, 0.0)
+        lines.append(f"{p:<22} {v:>10.1f} {100 * v / e2e:>6.1f}%")
+    for b in GAP_BUCKETS:
+        v = ledger["buckets_us"].get(b, 0.0)
+        lines.append(f"gap:{b:<18} {v:>10.1f} {100 * v / e2e:>6.1f}%")
+    resid = e2e - ledger["phase_sum_us"] - ledger["explained_us"]
+    lines.append(f"{'(unattributed)':<22} {resid:>10.1f} "
+                 f"{100 * resid / e2e:>6.1f}%")
+    lines.append(f"{'coverage':<22} {'':>10} "
+                 f"{100 * ledger['coverage']:>6.1f}%")
+    return "\n".join(lines)

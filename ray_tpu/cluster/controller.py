@@ -237,6 +237,14 @@ class NodeController:
             # Worker-node processes sample as "controller"; the head's
             # colocated controller thread shares the GCS's sampler.
             flight_recorder.start("controller")
+        # Event-loop observatory on the controller loop (on the head this
+        # is a SEPARATE loop from the GCS's, so per-loop attribution
+        # stays clean even colocated). The process-wide thread-CPU
+        # sampler is shared, flight-recorder style.
+        from .._private import loopmon
+
+        self._loopmon = loopmon.install("controller")
+        self._cpu_sampler = loopmon.cpu_sampler("controller")
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
         chaos_every = float(os.environ.get(
@@ -303,11 +311,14 @@ class NodeController:
 
     async def stop(self):
         self._shutting_down = True
-        from .._private import flight_recorder
+        from .._private import flight_recorder, loopmon
 
         rec = flight_recorder.get()
         if rec is not None and rec.component == "controller":
             flight_recorder.stop()  # never a sampler another role started
+        if getattr(self, "_loopmon", None) is not None:
+            loopmon.uninstall("controller")
+            self._loopmon = None
         for t in self._tasks:
             t.cancel()
         for w in self.workers.values():
@@ -484,13 +495,26 @@ class NodeController:
                     if rec is not None:
                         # Flight-recorder drain piggybacks on the report
                         # (the sampler needs no connection of its own).
-                        stacks = rec.drain()
+                        stacks, stacks_cpu = rec.drain_tagged()
                         if stacks:
                             stats["stacks"] = stacks
+                            stats["stacks_oncpu"] = stacks_cpu
                             stats["stack_component"] = rec.component
                             stats["stack_samples"] = sum(stacks.values())
                             flight_recorder.flush_metrics(
                                 rec, stats["stack_samples"])
+                    # Event-loop observatory windows ride the same report.
+                    if self._loopmon is not None:
+                        stats["loopmon"] = self._loopmon.drain()
+                    if self._cpu_sampler is not None:
+                        tc = self._cpu_sampler.drain()
+                        if tc:
+                            # On the head the process sampler is labeled
+                            # "gcs" (first starter); attribution follows
+                            # the sampler, not the sender.
+                            tc["component"] = \
+                                self._cpu_sampler.component or "controller"
+                            stats["thread_cpu"] = tc
                     self._gcs.send_oneway({"type": "node_stats",
                                            "node_id": self.node_id,
                                            "stats": stats})

@@ -480,9 +480,11 @@ class ClusterCoreWorker:
         return counters, hists
 
     def _stats_flush_loop(self) -> None:
-        from .._private import flight_recorder, tracing
+        from .._private import flight_recorder, loopmon, tracing
 
         trace_kv_last: Any = ("\0unset",)
+        cpu_sampler = loopmon.cpu_sampler("driver")
+        dwell_last = 0.0
         while not self._stats_stop.wait(2.0):
             try:
                 msg: Dict[str, Any] = {"type": "driver_stats",
@@ -494,12 +496,26 @@ class ClusterCoreWorker:
                     msg["hists"] = hists
                 rec = flight_recorder.get()
                 if rec is not None:
-                    stacks = rec.drain()
+                    stacks, stacks_cpu = rec.drain_tagged()
                     if stacks:
                         msg["stacks"] = stacks
+                        msg["stacks_oncpu"] = stacks_cpu
                         msg["component"] = rec.component
                         msg["samples"] = sum(stacks.values())
                         flight_recorder.flush_metrics(rec, msg["samples"])
+                # Observatory ride-alongs: per-thread CPU/ctx-switch
+                # window + the GCS-link reader's blocked-in-recv delta
+                # (the conservation ledger's socket_dwell numerator).
+                if cpu_sampler is not None:
+                    tc = cpu_sampler.drain()
+                    if tc:
+                        tc["component"] = cpu_sampler.component or "driver"
+                        msg["thread_cpu"] = tc
+                dwell = float(
+                    self.gcs.io_stats.get("recv_dwell_s", 0.0))
+                if dwell > dwell_last:
+                    msg["socket_dwell_s"] = dwell - dwell_last
+                    dwell_last = dwell
                 if len(msg) > 2:
                     self.gcs.send_oneway(msg)
                 # Runtime-adjustable trace sampling: the driver makes the

@@ -145,6 +145,18 @@ class GcsServer:
 
         self.profile_stacks: Dict[str, Dict[str, int]] = {}
         self.profile_stack_samples: Dict[str, int] = {}
+        # Parallel on-CPU weight table: component -> folded stack ->
+        # on-CPU sample weight (flight recorder schedstat tagging), so
+        # `cli profile` prints wall and on-CPU columns separately.
+        self.profile_stacks_cpu: Dict[str, Dict[str, float]] = {}
+        # ---- event-loop observatory (loopmon): newest per-component
+        # drain window + a cumulative top-N slow-callback ledger
+        # (component -> callback name -> [count, total_s, max_s]),
+        # served by get_loop_stats for `cli loops` / the dashboard.
+        self.loop_windows: Dict[str, Dict[str, Any]] = {}
+        self.loop_slow: Dict[str, Dict[str, list]] = {}
+        self._loopmon = None
+        self._cpu_sampler = None
         self.timeseries = TimeSeriesStore(
             bucket_s=float(getattr(config, "timeseries_bucket_s", 10)),
             retention_buckets=int(getattr(
@@ -429,6 +441,13 @@ class GcsServer:
             # The head process's ONE sampler (a colocated controller
             # thread shares it); samples merge under component "gcs".
             flight_recorder.start("gcs")
+        # Event-loop observatory on the head loop: lag heartbeat,
+        # dwell/callback split, slow-callback ledger. loopmon.install is
+        # a no-op under the RAY_TPU_LOOPMON=0 kill switch.
+        from .._private import loopmon
+
+        self._loopmon = loopmon.install("gcs")
+        self._cpu_sampler = loopmon.cpu_sampler("gcs")
         return port
 
     def _redrive_restored(self) -> None:
@@ -464,6 +483,11 @@ class GcsServer:
     async def stop(self):
         for t in self._tasks:
             t.cancel()
+        if self._loopmon is not None:
+            from .._private import loopmon
+
+            loopmon.uninstall("gcs")
+            self._loopmon = None
         from .._private import flight_recorder
 
         rec = flight_recorder.get()
@@ -995,20 +1019,115 @@ class GcsServer:
     _STACKS_PER_COMPONENT = 20_000
 
     def merge_profile_stacks(self, component: str, stacks: Dict[str, int],
-                             samples: int = 0) -> None:
+                             samples: int = 0,
+                             oncpu: Optional[Dict[str, float]] = None
+                             ) -> None:
         """Fold one recorder drain into the profile-stacks table. Bounded:
         past the per-component cap, NEW stacks collapse into an overflow
         key (known stacks keep accumulating — the hot ones, by
-        construction, already exist)."""
+        construction, already exist). ``oncpu`` is the parallel on-CPU
+        weight map from a tagged drain; it shares the wall table's key
+        admission so the two stay row-aligned."""
         if not stacks:
             return
         table = self.profile_stacks.setdefault(component, {})
+        cpu_table = self.profile_stacks_cpu.setdefault(component, {})
         for key, n in stacks.items():
+            c = (oncpu or {}).get(key, 0.0)
             if key not in table and len(table) >= self._STACKS_PER_COMPONENT:
                 key = "<overflow>"
             table[key] = table.get(key, 0) + int(n)
+            if c:
+                cpu_table[key] = cpu_table.get(key, 0.0) + float(c)
         self.profile_stack_samples[component] = \
             self.profile_stack_samples.get(component, 0) + int(samples)
+
+    _SLOW_LEDGER_CAP = 64
+
+    def _roll_loop_window(self, component: str,
+                          lm: Optional[Dict[str, Any]],
+                          tc: Optional[Dict[str, Any]]) -> None:
+        """Fold one event-loop-observatory window (loopmon drain ``lm`` +
+        thread-CPU drain ``tc``) into the time-series store, Prometheus
+        mirrors, and the get_loop_stats tables. Any component's drains
+        land here — the GCS's own on the stats tick, controllers' via
+        node_stats, workers'/drivers' via their flush frames."""
+        ts = self.timeseries
+        window: Dict[str, Any] = dict(lm or {})
+        if lm:
+            lag = lm.get("lag") or {}
+            if lag.get("count"):
+                ts.add_hist(f"loop_lag_ms:{component}",
+                            lag.get("buckets") or {},
+                            total=float(lag.get("sum_ms") or 0.0),
+                            count=int(lag.get("count") or 0))
+            ts.add_gauge(f"loop_lag_max_ms:{component}",
+                         float(lag.get("max_ms") or 0.0))
+            if component == "gcs":
+                # The SLO gauge: sustained head loop lag pages (the
+                # gauge-ceiling rule wants every window breaching).
+                ts.add_gauge("head_loop_lag_ms",
+                             float(lag.get("max_ms") or 0.0))
+            ts.add_delta(f"loop_dwell_s:{component}",
+                         float(lm.get("dwell_s") or 0.0))
+            ts.add_delta(f"loop_cb_s:{component}",
+                         float(lm.get("cb_s") or 0.0))
+            ts.add_delta(f"loop_cb_count:{component}",
+                         float(lm.get("cb_count") or 0))
+            ts.add_gauge(f"loop_queue_depth:{component}",
+                         float(lm.get("queue_max") or 0))
+            ledger = self.loop_slow.setdefault(component, {})
+            for name, count, total_s, max_s in (lm.get("slow") or []):
+                row = ledger.get(name)
+                if row is None:
+                    if len(ledger) >= self._SLOW_LEDGER_CAP:
+                        name = "<overflow>"
+                        row = ledger.setdefault(name, [0, 0.0, 0.0])
+                    else:
+                        row = ledger[name] = [0, 0.0, 0.0]
+                row[0] += int(count)
+                row[1] += float(total_s)
+                row[2] = max(row[2], float(max_s))
+        if tc:
+            wall = max(float(tc.get("wall_s") or 0.0), 1e-9)
+            ts.add_delta(f"proc_cpu_s:{component}",
+                         float(tc.get("cpu_s") or 0.0))
+            ts.add_delta(f"ctx_vol:{component}", float(tc.get("vol") or 0))
+            ts.add_delta(f"ctx_invol:{component}",
+                         float(tc.get("invol") or 0))
+            ts.add_gauge(f"proc_cpu_cores:{component}",
+                         float(tc.get("cpu_s") or 0.0) / wall)
+            window["thread_cpu"] = tc
+        if not window:
+            return
+        window["ts"] = time.time()
+        self.loop_windows[component] = window
+        try:
+            from ..metrics import loopmon_metrics
+
+            m = loopmon_metrics()
+            tags = {"component": component}
+            if lm:
+                m["lag_max_ms"].record(
+                    float((lm.get("lag") or {}).get("max_ms") or 0.0),
+                    tags=tags)
+                m["dwell_s"].record(float(lm.get("dwell_s") or 0.0),
+                                    tags=tags)
+                m["cb_s"].record(float(lm.get("cb_s") or 0.0), tags=tags)
+                m["queue_depth"].record(float(lm.get("queue_max") or 0),
+                                        tags=tags)
+            if tc:
+                m["cpu_cores"].record(
+                    float(tc.get("cpu_s") or 0.0)
+                    / max(float(tc.get("wall_s") or 0.0), 1e-9), tags=tags)
+                m["ctx_switches"].record(
+                    float(tc.get("vol") or 0),
+                    tags={"component": component, "kind": "voluntary"})
+                m["ctx_switches"].record(
+                    float(tc.get("invol") or 0),
+                    tags={"component": component, "kind": "involuntary"})
+        except Exception:  # noqa: BLE001 - metrics never fail rollups
+            pass
 
     def _roll_cum(self, series: str, current: float) -> None:
         """Fold a cumulative source (handler-stat cell, event counter) into
@@ -1134,13 +1253,20 @@ class GcsServer:
             try:
                 rec = flight_recorder.get()
                 if rec is not None:
-                    stacks = rec.drain()
+                    stacks, oncpu = rec.drain_tagged()
                     if stacks:
                         self.merge_profile_stacks(
                             rec.component, stacks,
-                            samples=sum(stacks.values()))
+                            samples=sum(stacks.values()), oncpu=oncpu)
                         flight_recorder.flush_metrics(
                             rec, sum(stacks.values()))
+                # Observatory drains ride the same tick: the head loop's
+                # loopmon window + this process's thread-CPU deltas.
+                if self._loopmon is not None:
+                    self._roll_loop_window(
+                        "gcs", self._loopmon.drain(),
+                        self._cpu_sampler.drain()
+                        if self._cpu_sampler is not None else None)
                 self._roll_timeseries_tick()
             except Exception:  # noqa: BLE001 - observability never kills GCS
                 import traceback
@@ -4208,11 +4334,21 @@ class GcsServer:
             the sampler needs no connection of its own."""
             stats = msg["stats"]
             stacks = stats.pop("stacks", None)
+            stacks_oncpu = stats.pop("stacks_oncpu", None)
             if stacks:
                 self.merge_profile_stacks(
                     stats.pop("stack_component", "controller"), stacks,
                     samples=stats.pop("stack_samples", 0) or
-                    sum(stacks.values()))
+                    sum(stacks.values()), oncpu=stacks_oncpu)
+            # Event-loop observatory windows piggyback on the report
+            # (same no-connection-of-its-own discipline as the stacks).
+            lm = stats.pop("loopmon", None)
+            tc = stats.pop("thread_cpu", None)
+            if lm or tc:
+                comp = (lm or {}).get("component") \
+                    or stats.pop("loop_component", None) or "controller"
+                stats.pop("loop_component", None)
+                self._roll_loop_window(str(comp), lm, tc)
             # Consistency-audit inventory riding the report: kept out of
             # node_stats (get_node_stats consumers don't want oid lists).
             audit = stats.pop("audit", None)
@@ -4224,11 +4360,17 @@ class GcsServer:
         @s.handler("add_profile_stacks")
         async def add_profile_stacks(msg, conn):
             """Flight-recorder drain from a worker/driver process (binary
-            PROFILE_STACKS frame or pickle)."""
+            PROFILE_STACKS frame, or pickle when the observatory's
+            on-CPU/thread-CPU payload rides along)."""
+            comp = str(msg.get("component") or "worker")
             self.merge_profile_stacks(
-                str(msg.get("component") or "worker"),
-                msg.get("stacks") or {},
-                samples=int(msg.get("samples") or 0))
+                comp, msg.get("stacks") or {},
+                samples=int(msg.get("samples") or 0),
+                oncpu=msg.get("stacks_oncpu"))
+            tc = msg.get("thread_cpu")
+            if tc:
+                self._roll_loop_window(
+                    str(tc.get("component") or comp), None, tc)
             return None  # one-way
 
         @s.handler("get_profile_stacks")
@@ -4239,8 +4381,24 @@ class GcsServer:
             comps = ([want] if want else sorted(self.profile_stacks)) or []
             return {"ok": True, "components": {
                 c: {"stacks": dict(self.profile_stacks.get(c, {})),
+                    "stacks_oncpu": dict(
+                        self.profile_stacks_cpu.get(c, {})),
                     "samples": self.profile_stack_samples.get(c, 0)}
                 for c in comps if c in self.profile_stacks
+            }}
+
+        @s.handler("get_loop_stats")
+        async def get_loop_stats(msg, conn):
+            """Event-loop observatory view: newest loopmon/thread-CPU
+            window per component plus the cumulative slow-callback
+            ledger (`cli loops`, dashboard loops panel)."""
+            return {"ok": True, "components": {
+                c: dict(w) for c, w in self.loop_windows.items()
+            }, "slow": {
+                c: sorted(([n, int(r[0]), round(r[1], 4), round(r[2], 4)]
+                           for n, r in led.items()),
+                          key=lambda r: -r[3])[:16]
+                for c, led in self.loop_slow.items()
             }}
 
         @s.handler("driver_stats")
@@ -4268,7 +4426,18 @@ class GcsServer:
             if stacks:
                 self.merge_profile_stacks(
                     str(msg.get("component") or "driver"), stacks,
-                    samples=int(msg.get("samples") or 0))
+                    samples=int(msg.get("samples") or 0),
+                    oncpu=msg.get("stacks_oncpu"))
+            tc = msg.get("thread_cpu")
+            if tc:
+                self._roll_loop_window(
+                    str(tc.get("component") or "driver"), None, tc)
+            dwell = msg.get("socket_dwell_s")
+            if dwell:
+                # Driver reader-thread blocked-in-recv seconds: the
+                # conservation ledger's socket_dwell bucket numerator.
+                self.timeseries.add_delta("socket_dwell_s:driver",
+                                          float(dwell))
             return None  # one-way
 
         @s.handler("get_timeseries")

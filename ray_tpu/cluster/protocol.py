@@ -345,6 +345,11 @@ class RpcClient:
         self.io_stats = io_stats if io_stats is not None else {
             "frames_sent": 0, "writes": 0}
         self.io_stats.setdefault("late_drops", 0)
+        # Reader-thread seconds blocked waiting for bytes (recv/pump
+        # wait). The observatory's socket-dwell bucket: time a client
+        # spends off-CPU waiting on its peer, which the old wall-clock
+        # sampler used to report as self-time.
+        self.io_stats.setdefault("recv_dwell_s", 0.0)
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(None)
         # Small control messages back-to-back must not wait out Nagle +
@@ -387,17 +392,22 @@ class RpcClient:
 
     def _read_loop(self):
         try:
+            io_stats = self.io_stats
             if self._pump is not None:
                 # Native arm: recv + frame split run in C with the GIL
                 # released; each wakeup hands back a whole batch of bodies.
                 while not self._closed:
+                    t0 = time.perf_counter()
                     batch = self._pump.pump()
+                    io_stats["recv_dwell_s"] += time.perf_counter() - t0
                     if batch is None:
                         break  # EOF / socket error / oversize frame
                     self._dispatch_frames(batch)
             else:
                 while not self._closed:
+                    t0 = time.perf_counter()
                     header = self._recv_exact(8)
+                    io_stats["recv_dwell_s"] += time.perf_counter() - t0
                     if header is None:
                         break
                     (length,) = _LEN.unpack(header)

@@ -940,6 +940,12 @@ def _enc_profile_stacks(msg, peer_wire: int = WIRE_VERSION
         # Pre-v3 peer (can't parse 0x13) or an absurd drain: pickle
         # carries it instead.
         return None
+    if msg.get("stacks_oncpu") or msg.get("thread_cpu"):
+        # Observatory-era drains (on-CPU stack weights, per-thread CPU
+        # window) exceed what the 0x13 frame carries; the pickle body is
+        # the designated ride-along path for new stats payloads — no new
+        # frame id for a 2 s cadence message.
+        return None
     out = [_head(PROFILE_STACKS, msg.get("rpc_id")),
            _s(msg.get("component") or ""),
            _U32.pack(int(msg.get("samples") or 0)),

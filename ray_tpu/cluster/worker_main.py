@@ -174,9 +174,10 @@ def main():
 
     # Continuous stack sampler: this worker's wall-clock profile, drained
     # to the GCS profile-stacks table on the flush cadence below.
-    from ray_tpu._private import flight_recorder
+    from ray_tpu._private import flight_recorder, loopmon
 
     flight_recorder.start("worker")
+    cpu_sampler = loopmon.cpu_sampler("worker")
 
     # Periodic profile-span flush to the GCS (reference: profiling.cc's
     # batched AddProfileData timer).
@@ -188,15 +189,30 @@ def main():
             try:
                 core.flush_events()
                 rec = flight_recorder.get()
+                msg = None
                 if rec is not None:
-                    stacks = rec.drain()
+                    stacks, stacks_cpu = rec.drain_tagged()
                     if stacks:
                         n = sum(stacks.values())
-                        core.gcs.send_oneway(
-                            {"type": "add_profile_stacks",
-                             "component": rec.component,
-                             "samples": n, "stacks": stacks})
+                        msg = {"type": "add_profile_stacks",
+                               "component": rec.component,
+                               "samples": n, "stacks": stacks,
+                               "stacks_oncpu": stacks_cpu}
                         flight_recorder.flush_metrics(rec, n)
+                # Off-CPU truth rides the same flush: per-thread CPU and
+                # ctx-switch deltas for the worker process (workers have
+                # no asyncio loop — thread coverage IS their observatory).
+                if cpu_sampler is not None:
+                    tc = cpu_sampler.drain()
+                    if tc:
+                        tc["component"] = "worker"
+                        if msg is None:
+                            msg = {"type": "add_profile_stacks",
+                                   "component": "worker", "samples": 0,
+                                   "stacks": {}}
+                        msg["thread_cpu"] = tc
+                if msg is not None:
+                    core.gcs.send_oneway(msg)
             except Exception:  # noqa: BLE001 - shutdown race
                 return
 

@@ -2,7 +2,7 @@
 here a stdlib HTTP server + a single self-contained HTML page).
 
 JSON API: /api/nodes /api/actors /api/objects /api/resources /api/tasks
-/api/jobs (per-job profiler rollup)
+/api/jobs (per-job profiler rollup) /api/loops (event-loop observatory)
 HTML: / renders the same data with auto-refresh.
 
 Works against whatever runtime the driver is connected to (local or cluster):
@@ -93,11 +93,11 @@ function seriesValues(s) {
 }
 async function refresh() {
   const [nodes, actors, objects, resources, tasks, nstats, memory, serve,
-         timeline, events, traces, pgs, timeseries, jobs] =
+         timeline, events, traces, pgs, timeseries, jobs, loops] =
     await Promise.all(
       ["nodes","actors","objects","resources","tasks","node_stats",
        "memory","serve","timeline","events","traces","pgs",
-       "timeseries","jobs"].map(
+       "timeseries","jobs","loops"].map(
         p => fetch("/api/" + p).then(r => r.json())));
   let h = "<h2>node utilization</h2><table><tr><th>node</th><th>cpu</th>" +
           "<th>mem</th><th>load</th><th>store objs</th>" +
@@ -244,6 +244,45 @@ async function refresh() {
     if (dropped) h += `<div style="color:#f66">${dropped} cluster events ` +
                       `dropped (ring full)</div>`;
   } else h += "<i>no rollups yet (cluster mode only)</i>";
+  // event-loop observatory: per-loop lag/dwell/callback split from the
+  // loopmon windows, plus the cross-loop slow-callback ledger.
+  const loopComps = Object.entries((loops || {}).components || {});
+  h += `<h2>event loops (${loopComps.length} monitored)</h2>`;
+  if (loopComps.length) {
+    h += "<table><tr><th>loop</th><th>window</th><th>dwell%</th>" +
+         "<th>cb%</th><th>callbacks</th><th>lag max</th><th>queue max</th>" +
+         "<th>cpu cores</th><th>ctx v/i</th></tr>";
+    for (const [comp, w] of loopComps) {
+      const wall = Math.max(w.wall_s || 0, 1e-9);
+      const lag = w.lag || {};
+      const tc = w.thread_cpu || {};
+      const cores = tc.cpu_s != null
+        ? (tc.cpu_s / Math.max(tc.wall_s || wall, 1e-9)).toFixed(2) : "-";
+      h += `<tr><td>${esc(comp)}</td><td class=num>${wall.toFixed(1)}s</td>` +
+           `<td class=num>${(100 * (w.dwell_s || 0) / wall).toFixed(1)}%</td>` +
+           `<td class=num>${(100 * (w.cb_s || 0) / wall).toFixed(1)}%</td>` +
+           `<td class=num>${w.cb_count ?? 0}</td>` +
+           `<td class=num>${(lag.max_ms || 0).toFixed(1)}ms</td>` +
+           `<td class=num>${w.queue_max ?? 0}</td>` +
+           `<td class=num>${cores}</td>` +
+           `<td class=num>${tc.vol ?? 0}/${tc.invol ?? 0}</td></tr>`;
+    }
+    h += "</table>";
+    const slowRows = [];
+    for (const [comp, lst] of Object.entries((loops || {}).slow || {}))
+      for (const r of lst) slowRows.push([comp, r]);
+    slowRows.sort((a, b) => b[1][3] - a[1][3]);
+    if (slowRows.length) {
+      h += "<h3>slow callbacks</h3><table><tr><th>loop</th><th>callback</th>" +
+           "<th>n</th><th>total</th><th>max</th></tr>";
+      for (const [comp, [name, n, tot, mx]] of slowRows.slice(0, 15))
+        h += `<tr><td>${esc(comp)}</td><td>${esc(name)}</td>` +
+             `<td class=num>${n}</td>` +
+             `<td class=num>${(tot * 1e3).toFixed(1)}ms</td>` +
+             `<td class=num>${(mx * 1e3).toFixed(1)}ms</td></tr>`;
+      h += "</table>";
+    }
+  } else h += "<i>no loop windows yet (loopmon off or local mode)</i>";
   // task/placement timeline lanes (chrome-trace events, one lane per
   // worker/actor — placement-kernel behavior visually inspectable)
   h += "<h2>timeline</h2>" + laneView(Array.isArray(timeline) ? timeline : []);
@@ -397,6 +436,19 @@ def _collect(endpoint: str):
         core = global_worker().core
         try:
             return core.placement_group_table()
+        except Exception:  # noqa: BLE001 - GCS restart window
+            return {}
+    if endpoint == "loops":
+        # Event-loop observatory windows (loopmon drains rolled by the
+        # GCS every 2s): lag/dwell/callback split + slow-callback ledger.
+        core = global_worker().core
+        gcs = getattr(core, "gcs", None)
+        if gcs is None:
+            return {}
+        try:
+            out = gcs.call({"type": "get_loop_stats"})
+            out.pop("ok", None)
+            return out
         except Exception:  # noqa: BLE001 - GCS restart window
             return {}
     if endpoint == "events":

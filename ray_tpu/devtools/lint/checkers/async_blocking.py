@@ -165,7 +165,10 @@ class AsyncBlockingChecker(Checker):
                    "pickle) reachable from cluster async handlers")
     # serve/ is included because the Router is an asyncio actor: one
     # blocking call in its event loop stalls EVERY endpoint's routing.
-    paths = ("ray_tpu/cluster/", "ray_tpu/serve/")
+    # loopmon wraps *every* loop callback, so a blocking call there is a
+    # blocking call in all monitored loops at once.
+    paths = ("ray_tpu/cluster/", "ray_tpu/serve/",
+             "ray_tpu/_private/loopmon.py")
 
     def run(self, project: Project) -> Iterator[Finding]:
         for prefix in self.paths:

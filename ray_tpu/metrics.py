@@ -199,6 +199,45 @@ def flight_recorder_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def loopmon_metrics() -> Dict[str, "Metric"]:
+    """``loopmon_*`` series for the event-loop observatory: per-component
+    loop-lag maxima, select-dwell vs callback-run seconds, ready-queue
+    depth, and the off-CPU truth gauges (process CPU cores-equivalent,
+    context-switch counters) the on/off-CPU split rows read. Mirrored
+    into Prometheus by the GCS rollup tick. Lazily registered;
+    idempotent."""
+    return {
+        "lag_max_ms": get_or_create(
+            Gauge, "loopmon_lag_max_ms", tag_keys=("component",),
+            description="max scheduled-vs-actual heartbeat delta (loop "
+                        "lag) in the last stats window"),
+        "dwell_s": get_or_create(
+            Count, "loopmon_select_dwell_seconds",
+            tag_keys=("component",),
+            description="event-loop wall seconds spent blocked in "
+                        "selector select/poll (IO + timer wait)"),
+        "cb_s": get_or_create(
+            Count, "loopmon_callback_run_seconds",
+            tag_keys=("component",),
+            description="event-loop wall seconds spent running "
+                        "callbacks/task steps"),
+        "queue_depth": get_or_create(
+            Gauge, "loopmon_ready_queue_depth_max",
+            tag_keys=("component",),
+            description="max ready-callback queue depth sampled by the "
+                        "loop-lag heartbeat in the last window"),
+        "cpu_cores": get_or_create(
+            Gauge, "loopmon_proc_cpu_cores", tag_keys=("component",),
+            description="process CPU consumption in cores-equivalent "
+                        "over the last stats window (utime+stime delta "
+                        "/ wall) — the on/off-CPU split numerator"),
+        "ctx_switches": get_or_create(
+            Count, "loopmon_ctx_switches", tag_keys=("component", "kind"),
+            description="process context switches (kind=voluntary|"
+                        "involuntary) observed by the off-CPU sampler"),
+    }
+
+
 def slo_metrics() -> Dict[str, "Metric"]:
     """``slo_*`` series for the monitor's rule engine: the alert gauge
     (1 = firing) Prometheus alerting keys on, rule evaluations, and the

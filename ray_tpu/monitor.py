@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence
 logger = logging.getLogger(__name__)
 
 from ._private.timeseries import (
-    merge_hist, quantile_from_hist, window_rate, window_sum,
+    gauge_window, merge_hist, quantile_from_hist, window_rate, window_sum,
 )
 from .autoscaler import LoadMetrics, StandardAutoscaler
 from .autoscaler.node_provider import NodeProvider
@@ -51,7 +51,12 @@ class SloRule:
         ``budget``, must stay <= ``burn_threshold``;
       * ``gauge-floor`` — the newest gauge sample in the window must
         stay >= ``threshold`` (no sample in the window = not firing, so
-        a cluster that hasn't produced the gauge yet never pages).
+        a cluster that hasn't produced the gauge yet never pages);
+      * ``gauge-ceiling`` — SUSTAINED breach: every gauge sample in the
+        window (and at least ``min_count`` of them) must exceed
+        ``threshold`` before the rule fires. One spiky bucket — a GC
+        pause, a cold import — never pages; a head event loop that
+        stays lagged for the whole window does.
     """
 
     def __init__(self, name: str, kind: str, series: str,
@@ -60,7 +65,8 @@ class SloRule:
                  total_series: str = "", budget: float = 0.01,
                  burn_threshold: float = 1.0,
                  long_window_s: Optional[float] = None):
-        if kind not in ("floor", "ceiling", "burn", "gauge-floor"):
+        if kind not in ("floor", "ceiling", "burn", "gauge-floor",
+                        "gauge-ceiling"):
             raise ValueError(f"unknown SLO rule kind {kind!r}")
         self.name = name
         self.kind = kind
@@ -102,6 +108,16 @@ def default_slo_rules() -> List[SloRule]:
                 budget=_env_f("RAY_TPU_SLO_ERROR_BUDGET", 0.01),
                 burn_threshold=_env_f("RAY_TPU_SLO_BURN_THRESHOLD", 2.0),
                 window_s=300.0, long_window_s=1800.0, min_count=50),
+        # Event-loop observatory: sustained head loop lag is the one
+        # signal that precedes every control-plane latency regression
+        # (all GCS work queues behind it). The gauge is the per-window
+        # MAX heartbeat lag (loopmon); gauge-ceiling semantics require
+        # every window of the last minute to breach, so one blocking
+        # import or GC pause never pages. min_count=3 refuses to call
+        # a single bucket "sustained".
+        SloRule("head_loop_lag", "gauge-ceiling", "head_loop_lag_ms",
+                threshold=_env_f("RAY_TPU_SLO_HEAD_LOOP_LAG_MS", 250.0),
+                window_s=60.0, min_count=3),
         # Head HA: a standby falling behind the leader's replication
         # stream stretches the failover recovery window — page before it
         # becomes a data-loss-shaped hole. Gauge is leader-side (set while
@@ -189,6 +205,14 @@ class SloEngine:
                 return out  # gauge never produced: the floor can't apply
             out["value"] = gauge[-1].get("last")
             out["firing"] = (out["value"] or 0.0) < rule.threshold
+            return out
+        if rule.kind == "gauge-ceiling":
+            vals = gauge_window(pts, since)
+            if not vals or len(vals) < rule.min_count:
+                return out  # no/too few samples: can't claim "sustained"
+            # Sustained = the BEST bucket of the window still breaches.
+            out["value"] = min(vals)
+            out["firing"] = out["value"] > rule.threshold
             return out
         # burn: bad fraction vs budget over short AND long windows.
         total_pts = self._points(payload, rule.total_series)

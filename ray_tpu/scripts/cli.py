@@ -705,12 +705,14 @@ def cmd_trace(args) -> None:
 
 
 def cmd_profile(args) -> None:
-    """Flight-recorder report: top-N frames by self-time from the GCS
-    profile-stacks table. With ``--seconds N`` the table is snapshot-
-    diffed around a live window (profile what's running NOW); 0 uses the
-    cumulative counts. Also writes the window as a collapsed-stack file
-    flamegraph tools consume directly (flamegraph.pl / speedscope)."""
-    from ray_tpu._private.flight_recorder import self_time_table
+    """Flight-recorder report: top-N frames by wall samples from the GCS
+    profile-stacks table, with the on-CPU column alongside so a thread
+    blocked in ``recv`` reads ~0 on-CPU instead of masquerading as hot
+    self-time. With ``--seconds N`` the table is snapshot-diffed around a
+    live window (profile what's running NOW); 0 uses the cumulative
+    counts. Also writes the window as a collapsed-stack file flamegraph
+    tools consume directly (flamegraph.pl / speedscope)."""
+    from ray_tpu._private.flight_recorder import attribution_table
 
     component = {"head": "gcs"}.get(args.component, args.component)
     gcs = _gcs_client(args.address)
@@ -730,14 +732,26 @@ def cmd_profile(args) -> None:
         after = snap()
     finally:
         gcs.close()
-    # Window = after - before, merged across the selected components.
+    # Window = after - before, merged across the selected components —
+    # both the wall-sample counts and the fractional on-CPU weights.
     window: Dict[str, int] = {}
+    window_cpu: Dict[str, float] = {}
+    have_cpu = False
     for comp, info in after.items():
-        base = before.get(comp, {}).get("stacks", {})
+        base = before.get(comp, {})
+        base_stacks = base.get("stacks", {})
+        base_cpu = base.get("stacks_oncpu") or {}
+        comp_cpu = info.get("stacks_oncpu")
+        if comp_cpu is not None:
+            have_cpu = True
         for stack, n in info["stacks"].items():
-            d = n - base.get(stack, 0)
+            d = n - base_stacks.get(stack, 0)
             if d > 0:
                 window[stack] = window.get(stack, 0) + d
+                if comp_cpu is not None:
+                    dc = comp_cpu.get(stack, 0.0) - base_cpu.get(stack, 0.0)
+                    window_cpu[stack] = (window_cpu.get(stack, 0.0)
+                                         + max(0.0, dc))
     total = sum(window.values())
     if not total:
         print("no stack samples in the window — is the flight recorder "
@@ -745,10 +759,19 @@ def cmd_profile(args) -> None:
         return
     comps = ",".join(sorted(after)) or args.component
     print(f"{total} stack samples ({comps}); top {args.top} frames "
-          f"by self-time:")
-    print(f"{'SELF%':>7} {'SELF':>8} {'CUM':>8}  FRAME")
-    for frame, self_n, cum_n, pct in self_time_table(window, top=args.top):
-        print(f"{pct:>6.1f}% {self_n:>8} {cum_n:>8}  {frame}")
+          f"by wall samples (WALL = samples the frame was on a stack, "
+          f"ONCPU = schedstat-weighted share actually running):")
+    print(f"{'WALL%':>7} {'WALL':>8} {'ONCPU':>8} {'CUM':>8}  FRAME")
+    rows = attribution_table(window, window_cpu if have_cpu else None,
+                             top=args.top)
+    for frame, wall_n, oncpu_n, cum_n, pct in rows:
+        oncpu_txt = (f"{oncpu_n:>8.1f}" if oncpu_n is not None
+                     else f"{'-':>8}")
+        print(f"{pct:>6.1f}% {wall_n:>8} {oncpu_txt} {cum_n:>8}  {frame}")
+    if not have_cpu:
+        print("(no on-CPU tagging in this window — loopmon disabled or "
+              "procfs unavailable; WALL==ONCPU would be a lie, so it is "
+              "shown as '-')")
     out_path = args.out or f"/tmp/ray_tpu_profile_{args.component}.folded"
     with open(out_path, "w") as f:
         for stack, n in sorted(window.items(), key=lambda kv: -kv[1]):
@@ -816,6 +839,41 @@ def _render_top_frame(gcs) -> str:
         if p:
             lines.append(f"{label:<10} {p[-1][1]['last']:>10.1f}   "
                          f"{sparkline([c['last'] for _, c in p])}")
+    # Event-loop observatory rows: head loop lag p50/p99 (the queueing
+    # delay every GCS callback inherits) and the per-component on/off-CPU
+    # split (cores actually running vs loop wall split dwell/callbacks).
+    from ray_tpu._private.timeseries import (latest_value, merge_hist,
+                                             quantile_from_hist)
+
+    lag_cells = [c for t, c in pts("loop_lag_ms:gcs") if t >= now - 60]
+    if lag_cells:
+        hist = merge_hist(lag_cells)
+        p50 = quantile_from_hist(hist, 0.50)
+        p99 = quantile_from_hist(hist, 0.99)
+        lag_max = max((c["max"] for _, c in pts("loop_lag_max_ms:gcs")),
+                      default=0.0)
+        lines.append(
+            f"head lag   p50<={p50:.0f}ms p99<={p99:.0f}ms "
+            f"max={lag_max:.1f}ms (loop-lag heartbeat, 1m)")
+    cpu_comps = sorted(n[len("proc_cpu_cores:"):]
+                       for n in series if n.startswith("proc_cpu_cores:"))
+    split_rows = []
+    for comp in cpu_comps:
+        cores = latest_value(pts(f"proc_cpu_cores:{comp}"))
+        if cores is None:
+            continue
+        dwell = sum(c["sum"] for t, c in
+                    pts(f"loop_dwell_s:{comp}") if t >= now - 60)
+        cb = sum(c["sum"] for t, c in
+                 pts(f"loop_cb_s:{comp}") if t >= now - 60)
+        loop_txt = (f" loop: cb {cb / 60 * 100:>4.1f}% "
+                    f"dwell {dwell / 60 * 100:>4.1f}%"
+                    if (dwell or cb) else "")
+        split_rows.append(f"  {comp:<11} on-CPU {cores:>5.2f} cores"
+                          f"{loop_txt}")
+    if split_rows:
+        lines.append("on/off-CPU (2s window; off-CPU = wall - on-CPU)")
+        lines.extend(split_rows)
     # Pending-by-reason gauges (the scheduling-explainability stream):
     # shown whenever anything is pending, so a stuck fan-out explains
     # itself in the first `cli top` frame.
@@ -876,6 +934,105 @@ def cmd_top(args) -> None:
             time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
+    finally:
+        gcs.close()
+
+
+def build_ledger_window(gcs, since_s: float = 60.0) -> Dict:
+    """Observatory aggregates over the last ``since_s`` of time-series
+    data, shaped for :func:`tracing.conservation_ledger`. Shared by
+    ``cli loops`` and the bench harness's ``--ledger`` mode."""
+    from ray_tpu._private.timeseries import window_sum
+
+    ts = gcs.call({"type": "get_timeseries", "last": int(since_s) + 10})
+    series = ts["series"]
+    now = time.time()
+    # Points are keyed by BUCKET START (10 s bins): the partially-filled
+    # current bucket's timestamp precedes a short window's `since`, so an
+    # exact cut would drop the freshest — often the only — cell. Pad by
+    # one bucket; conservation_ledger caps buckets at the measured gap,
+    # so the over-inclusion can shift attribution but never invent wall.
+    since = now - since_s - 10.0
+
+    def wsum(name):
+        return window_sum((series.get(name) or {}).get("points", []),
+                          since)
+
+    # Head handler seconds already counted inside the traced phases —
+    # gcs_place and result_register run as GCS loop callbacks, so they
+    # are subtracted from callback_run to keep the buckets disjoint.
+    handler_s = (wsum("phase_seconds:gcs_place")
+                 + wsum("phase_seconds:result_register"))
+    lag_cells = [c for t, c in
+                 (series.get("loop_lag_ms:gcs") or {}).get("points", [])
+                 if t >= since]
+    lag_s = sum(float(c.get("sum", 0.0)) for c in lag_cells) / 1000.0
+    return {
+        "tasks": wsum("tasks_finished"),
+        "lag_s": lag_s,
+        "cb_s": wsum("loop_cb_s:gcs"),
+        "handler_s": handler_s,
+        "dwell_s": wsum("loop_dwell_s:gcs"),
+        "socket_dwell_s": wsum("socket_dwell_s:driver"),
+        "ctx": wsum("ctx_vol:gcs") + wsum("ctx_invol:gcs"),
+    }
+
+
+def cmd_loops(args) -> None:
+    """Event-loop observatory report: per-loop lag/dwell/callback split,
+    per-process on/off-CPU truth, the slow-callback ledger, and the
+    wall-clock conservation ledger (phases + gap buckets vs e2e)."""
+    from ray_tpu._private.timeseries import quantile_from_hist
+    from ray_tpu._private.tracing import (conservation_ledger,
+                                          group_traces, ledger_table)
+
+    gcs = _gcs_client(args.address)
+    try:
+        stats = gcs.call({"type": "get_loop_stats"})
+        comps = stats.get("components", {})
+        if not comps:
+            print("no loop windows yet — loopmon disabled "
+                  "(RAY_TPU_LOOPMON=0) or cluster just started")
+        else:
+            print(f"{'LOOP':<11} {'WALL':>6} {'DWELL%':>7} {'CB%':>6} "
+                  f"{'CBS':>7} {'LAGp99':>7} {'LAGmax':>7} {'QMAX':>5} "
+                  f"{'CPU':>5} {'CTXv/i':>11}")
+            for comp in sorted(comps):
+                w = comps[comp]
+                wall = max(float(w.get("wall_s", 0.0)), 1e-9)
+                lag = w.get("lag") or {}
+                hist = {"buckets": lag.get("buckets", {}),
+                        "sum": lag.get("sum_ms", 0.0),
+                        "count": lag.get("count", 0)}
+                p99 = quantile_from_hist(hist, 0.99)
+                tc = w.get("thread_cpu") or {}
+                cpu_cores = (float(tc["cpu_s"]) /
+                             max(float(tc.get("wall_s", wall)), 1e-9)
+                             if tc.get("cpu_s") is not None else None)
+                print(f"{comp:<11} {wall:>5.1f}s "
+                      f"{100 * w.get('dwell_s', 0) / wall:>6.1f}% "
+                      f"{100 * w.get('cb_s', 0) / wall:>5.1f}% "
+                      f"{w.get('cb_count', 0):>7} "
+                      f"{(f'{p99:.0f}ms' if p99 is not None else '-'):>7} "
+                      f"{lag.get('max_ms', 0.0):>5.1f}ms "
+                      f"{w.get('queue_max', 0):>5} "
+                      f"{(f'{cpu_cores:.2f}' if cpu_cores is not None else '-'):>5} "
+                      f"{int(tc.get('vol', 0)):>5}/{int(tc.get('invol', 0)):<5}")
+        slow = stats.get("slow", {})
+        rows = [(comp, r) for comp, lst in slow.items() for r in lst]
+        rows.sort(key=lambda cr: -cr[1][3])
+        if rows:
+            print(f"\nslow callbacks (>= threshold; worst first):")
+            print(f"{'LOOP':<11} {'N':>5} {'TOTAL':>9} {'MAX':>9}  CALLBACK")
+            for comp, (name, n, tot, mx) in rows[:args.top]:
+                print(f"{comp:<11} {int(n):>5} {tot * 1e3:>7.1f}ms "
+                      f"{mx * 1e3:>7.1f}ms  {name}")
+        spans = gcs.call({"type": "get_trace_data",
+                          "limit": 50_000})["spans"]
+        traces = group_traces(spans)
+        window = build_ledger_window(gcs)
+        print()
+        print(ledger_table(conservation_ledger(traces, window)))
     finally:
         gcs.close()
 
@@ -1439,6 +1596,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--once", action="store_true",
                     help="print one frame and exit")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("loops", help="event-loop observatory: lag/dwell/"
+                                      "callback split, slow-callback "
+                                      "ledger, conservation ledger")
+    sp.add_argument("--address")
+    sp.add_argument("--top", type=int, default=10,
+                    help="slow-callback rows to print")
+    sp.set_defaults(fn=cmd_loops)
 
     sp = sub.add_parser("pgs", help="placement-group table (gang "
                                     "reservations and lifecycle state)")

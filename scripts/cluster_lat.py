@@ -39,6 +39,7 @@ import os
 import statistics
 import sys
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -128,8 +129,106 @@ class _apply_env:
                 os.environ[k] = old
 
 
+# ---------------------------------------------------------------------------
+# environment fingerprint: is this measurement window quiet or noisy?
+# ---------------------------------------------------------------------------
+# Round-4's lesson was that a throughput number without its co-tenant
+# context is unfalsifiable. Every recorded run now carries a fingerprint
+# of the machine during ITS window: CPU steal % (hypervisor co-tenants),
+# PSI pressure (kernel's own stall accounting), whole-machine context-
+# switch rate, and load-average drift — so a future regression hunt can
+# discard rows whose window was simply noisy.
+
+def _read_proc_stat() -> tuple:
+    """(total_jiffies, steal_jiffies, ctxt_switches) from /proc/stat;
+    zeros off-Linux."""
+    total = steal = ctxt = 0
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu "):
+                    fields = [int(x) for x in line.split()[1:]]
+                    total = sum(fields)
+                    if len(fields) > 7:
+                        steal = fields[7]
+                elif line.startswith("ctxt "):
+                    ctxt = int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return total, steal, ctxt
+
+
+def _read_psi() -> dict:
+    """{resource: some-avg10 %} from /proc/pressure/*; {} off-Linux or
+    pre-PSI kernels."""
+    out = {}
+    for res in ("cpu", "io", "memory"):
+        try:
+            with open(f"/proc/pressure/{res}") as f:
+                for line in f:
+                    if line.startswith("some"):
+                        for tok in line.split():
+                            if tok.startswith("avg10="):
+                                out[res] = float(tok[len("avg10="):])
+        except (OSError, ValueError):
+            pass
+    return out
+
+
+class _EnvFingerprint:
+    """Deltas over one measurement window; ``finish()`` returns the row
+    every recorded run/arm attaches as ``env``."""
+
+    def __init__(self):
+        self.t0 = time.monotonic()
+        self.stat0 = _read_proc_stat()
+        try:
+            self.load0 = os.getloadavg()
+        except OSError:
+            self.load0 = (0.0, 0.0, 0.0)
+
+    def finish(self) -> dict:
+        wall = max(time.monotonic() - self.t0, 1e-9)
+        total1, steal1, ctxt1 = _read_proc_stat()
+        total0, steal0, ctxt0 = self.stat0
+        d_total = max(total1 - total0, 1)
+        try:
+            load1 = os.getloadavg()
+        except OSError:
+            load1 = (0.0, 0.0, 0.0)
+        return {
+            "wall_s": round(wall, 2),
+            "steal_pct": round(100.0 * (steal1 - steal0) / d_total, 3),
+            "ctxt_per_s": round((ctxt1 - ctxt0) / wall, 1),
+            "load1": round(load1[0], 2),
+            "load1_delta": round(load1[0] - self.load0[0], 2),
+            "psi_avg10": _read_psi(),
+        }
+
+
+# Noise verdict thresholds: CPU steal means a hypervisor co-tenant took
+# our cycles mid-window; PSI "some" avg10 means OUR threads stalled on a
+# contended resource. Both directly invalidate a latency comparison, so
+# either marks the window noisy. Load/ctxt rates are informational (the
+# benchmark itself drives them).
+_NOISY_STEAL_PCT = 0.5
+_NOISY_PSI_CPU = 5.0
+_NOISY_PSI_IO = 10.0
+
+
+def env_verdict(env: Optional[dict]) -> str:
+    if not env:
+        return "unknown"
+    psi = env.get("psi_avg10") or {}
+    noisy = (env.get("steal_pct", 0.0) >= _NOISY_STEAL_PCT
+             or psi.get("cpu", 0.0) >= _NOISY_PSI_CPU
+             or psi.get("io", 0.0) >= _NOISY_PSI_IO)
+    return "noisy" if noisy else "quiet"
+
+
 def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
-            job_report: bool = False, columnar: str = "auto") -> dict:
+            job_report: bool = False, columnar: str = "auto",
+            env_knobs: Optional[dict] = None) -> dict:
     import ray_tpu
     from ray_tpu.cluster.testing import Cluster
 
@@ -139,10 +238,14 @@ def one_run(serial_n: int, batch_k: int, record_ts: bool = False,
     extra_env = {"RAY_TPU_MAX_LINEAGE_SIZE": str(max(batch_k * 3, 1000))} \
         if job_report else {}
     env_over = _columnar_env(columnar)
+    env_over.update(env_knobs or {})
     extra_env.update(env_over)
     with _apply_env(env_over):
-        return _one_run_inner(serial_n, batch_k, record_ts, job_report,
-                              extra_env or None, columnar)
+        fp = _EnvFingerprint()
+        out = _one_run_inner(serial_n, batch_k, record_ts, job_report,
+                             extra_env or None, columnar)
+        out["env"] = fp.finish()
+        return out
 
 
 def _one_run_inner(serial_n: int, batch_k: int, record_ts: bool,
@@ -263,6 +366,71 @@ def trace_run(batch_k: int, top_k: int, sample: int = 8) -> None:
         time.sleep(2.5)
         spans = core.cluster_trace_spans()
         print(straggler_report(spans, top_k=top_k))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def ledger_run(batch_k: int, sample: int = 4,
+               record: bool = True) -> dict:
+    """Wall-clock conservation ledger over a warm fan-out: one fresh
+    cluster, a cold warm-up batch, then the measured warm batch with
+    per-task tracing at 1/``sample``. Phases + observatory gap buckets
+    (head loop lag, callback run, socket dwell, ctx-switch proxy) are
+    reconciled against per-task e2e wall and the coverage printed; the
+    row is appended to BENCH_CONTROL_PLANE.json as kind
+    ``conservation_ledger`` (PERF.md's table is this output)."""
+    import ray_tpu
+    from ray_tpu._private.tracing import (conservation_ledger, group_traces,
+                                          ledger_table)
+    from ray_tpu.cluster.testing import Cluster
+    from ray_tpu.scripts.cli import build_ledger_window
+
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = str(sample)
+    fp = _EnvFingerprint()
+    c = Cluster(num_workers=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(20)])
+        ray_tpu.get([noop.remote() for _ in range(batch_k)])  # warm-up
+        t_mark = time.time()
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(batch_k)])  # measured
+        dt_warm = time.perf_counter() - t0
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core
+        # Worker spans + loopmon/thread-cpu windows flush on 2 s timers;
+        # wait them out so the ledger sees the whole batch.
+        time.sleep(2.6)
+        spans = core.cluster_trace_spans()
+        traces = group_traces(spans)
+        # Only traces that START inside the measured window: the warm
+        # batch, not the warm-up (span epochs are wall-anchored).
+        warm = {tr: rec for tr, rec in traces.items()
+                if rec.get("phases")
+                and min(w[0] for w in rec["phases"].values()) >= t_mark}
+        window = build_ledger_window(
+            core.gcs, since_s=time.time() - t_mark)
+        led = conservation_ledger(warm, window)
+        print(ledger_table(led), file=sys.stderr)
+        return {
+            "batch_k": batch_k, "trace_sample": sample,
+            "warm_tasks_per_sec": round(batch_k / dt_warm, 1),
+            "sampled_tasks": led["tasks"],
+            "e2e_us": round(led["e2e_us"], 1),
+            "phase_us": {p: round(v, 1)
+                         for p, v in led["phase_us"].items()},
+            "gap_us": round(led["gap_us"], 1),
+            "buckets_us": {b: round(v, 1)
+                           for b, v in led["buckets_us"].items()},
+            "coverage": round(led["coverage"], 4),
+            "env": fp.finish(),
+        }
     finally:
         ray_tpu.shutdown()
         c.shutdown()
@@ -476,21 +644,38 @@ def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
 _COLUMNAR_PHASES = ("submit_rpc", "dispatch_relay", "result_register")
 
 
+# A/B knob families: each arm flips one coherent feature end to end.
+_AB_KNOBS = {
+    "columnar": _COLUMNAR_KNOBS,
+    "loopmon": ("RAY_TPU_LOOPMON",),
+}
+
+
 def ab_main(args) -> None:
-    """Interleaved columnar A/B: each pair runs both arms back to back in
-    fresh clusters, with the arm ORDER alternated pair-by-pair so a
-    monotone co-tenant drift penalizes both arms equally. The headline is
-    the MEDIAN of per-pair warm-throughput ratios — each ratio compares
-    two runs minutes apart, not two windows hours apart."""
+    """Interleaved A/B (``--ab-knob`` picks the feature: the columnar hot
+    path, or the loopmon observatory for its overhead budget): each pair
+    runs both arms back to back in fresh clusters, with the arm ORDER
+    alternated pair-by-pair so a monotone co-tenant drift penalizes both
+    arms equally. The headline is the MEDIAN of per-pair warm-throughput
+    ratios — each ratio compares two runs minutes apart, not two windows
+    hours apart — and every pair carries its env fingerprint plus a
+    quiet/noisy verdict so noisy-window ratios are discountable."""
+    knobs = _AB_KNOBS[args.ab_knob]
     pairs = []
     for i in range(args.ab_pairs):
         order = ("on", "off") if i % 2 == 0 else ("off", "on")
         res = {}
         for arm in order:
-            r = one_run(args.serial, args.batch, columnar=arm)
+            if args.ab_knob == "columnar":
+                r = one_run(args.serial, args.batch, columnar=arm)
+            else:
+                val = "1" if arm == "on" else "0"
+                r = one_run(args.serial, args.batch,
+                            env_knobs={k: val for k in knobs})
             res[arm] = r
             print(f"# pair {i + 1}/{args.ab_pairs} arm={arm}: "
                   f"warm={r['batch_warm_tasks_per_sec']}/s "
+                  f"env={env_verdict(r.get('env'))} "
                   f"phases={r['phases_ms_per_1k']}", file=sys.stderr)
         pairs.append(res)
 
@@ -498,28 +683,48 @@ def ab_main(args) -> None:
         ph = run["phases_ms_per_1k"]
         return sum(ph.get(p) or 0.0 for p in _COLUMNAR_PHASES)
 
+    def pair_verdict(p):
+        vs = {env_verdict(p[a].get("env")) for a in ("on", "off")}
+        return ("noisy" if "noisy" in vs
+                else "unknown" if "unknown" in vs else "quiet")
+
     ratios = sorted(p["on"]["batch_warm_tasks_per_sec"]
                     / p["off"]["batch_warm_tasks_per_sec"] for p in pairs)
     cost_ratios = sorted(
         phase_cost(p["on"]) / phase_cost(p["off"]) for p in pairs
         if phase_cost(p["off"]) > 0)
+    verdicts = [pair_verdict(p) for p in pairs]
+    quiet_ratios = sorted(
+        p["on"]["batch_warm_tasks_per_sec"]
+        / p["off"]["batch_warm_tasks_per_sec"]
+        for p, v in zip(pairs, verdicts) if v == "quiet")
     out = {
         "protocol": {"ab_pairs": args.ab_pairs, "serial_n": args.serial,
                      "batch_k": args.batch, "interleaved": True,
                      "fresh_cluster_per_run": True,
-                     "knobs": list(_COLUMNAR_KNOBS)},
+                     "knob": args.ab_knob,
+                     "knobs": list(knobs)},
         "unix": int(time.time()),
         "warm_ratio_median": round(statistics.median(ratios), 4),
         "warm_ratios": [round(r, 4) for r in ratios],
+        "env_verdicts": verdicts,
+        "env_verdict": ("noisy" if "noisy" in verdicts else
+                        "unknown" if "unknown" in verdicts else "quiet"),
+        "warm_ratio_median_quiet":
+            round(statistics.median(quiet_ratios), 4) if quiet_ratios
+            else None,
         "columnar_phase_cost_ratio_median":
             round(statistics.median(cost_ratios), 4) if cost_ratios
             else None,
         "pairs": [
-            {arm: {"warm_tasks_per_sec": p[arm]["batch_warm_tasks_per_sec"],
-                   "cold_tasks_per_sec": p[arm]["batch_tasks_per_sec"],
-                   "phases_ms_per_1k": p[arm]["phases_ms_per_1k"]}
-             for arm in ("on", "off")}
-            for p in pairs],
+            {**{arm: {"warm_tasks_per_sec":
+                          p[arm]["batch_warm_tasks_per_sec"],
+                      "cold_tasks_per_sec": p[arm]["batch_tasks_per_sec"],
+                      "phases_ms_per_1k": p[arm]["phases_ms_per_1k"],
+                      "env": p[arm].get("env")}
+                for arm in ("on", "off")},
+             "env_verdict": v}
+            for p, v in zip(pairs, verdicts)],
     }
     if args.sim_nodes:
         rows = []
@@ -543,7 +748,7 @@ def ab_main(args) -> None:
                 bench = json.load(f)
         except (OSError, ValueError):
             bench = []
-        bench.append({"kind": "columnar_ab", **out})
+        bench.append({"kind": f"{args.ab_knob}_ab", **out})
         with open(path, "w") as f:
             json.dump(bench, f, indent=2)
 
@@ -563,12 +768,23 @@ def main():
                          "submit + dispatch waves) for every run: on/off "
                          "force both env knobs, auto leaves ambient env")
     ap.add_argument("--ab-pairs", type=int, default=0,
-                    help="interleaved columnar A/B: N (on,off) run pairs "
-                         "with arm order alternated pair-by-pair; reports "
-                         "per-pair warm-throughput ratios and their median "
-                         "(robust to slow co-tenant drift) and appends the "
-                         "result to BENCH_CONTROL_PLANE.json. --sim-nodes "
-                         "rows are also run once per arm.")
+                    help="interleaved A/B: N (on,off) run pairs with arm "
+                         "order alternated pair-by-pair; reports per-pair "
+                         "warm-throughput ratios and their median (robust "
+                         "to slow co-tenant drift), stamps each pair "
+                         "quiet/noisy from its env fingerprint, and "
+                         "appends the result to BENCH_CONTROL_PLANE.json. "
+                         "--sim-nodes rows are also run once per arm.")
+    ap.add_argument("--ab-knob", choices=tuple(_AB_KNOBS), default="columnar",
+                    help="which feature the A/B arms flip: the columnar "
+                         "hot path, or the loopmon observatory (its "
+                         "overhead budget check)")
+    ap.add_argument("--ledger", action="store_true",
+                    help="run ONE warm fan-out and print the wall-clock "
+                         "conservation ledger (phases + observatory gap "
+                         "buckets vs per-task e2e); appends a "
+                         "conservation_ledger row to "
+                         "BENCH_CONTROL_PLANE.json")
     ap.add_argument("--traces", action="store_true",
                     help="run ONE traced cluster window and print the "
                          "per-task straggler report instead of the "
@@ -592,6 +808,25 @@ def main():
 
     if args.traces:
         trace_run(args.batch, args.trace_top, args.trace_sample)
+        return
+
+    if args.ledger:
+        row = ledger_run(args.batch, sample=args.trace_sample)
+        row["env_verdict"] = env_verdict(row.get("env"))
+        if args.note:
+            row["note"] = args.note
+        print(json.dumps(row))
+        if not args.no_record:
+            path = os.path.join(REPO, "BENCH_CONTROL_PLANE.json")
+            try:
+                with open(path) as f:
+                    bench = json.load(f)
+            except (OSError, ValueError):
+                bench = []
+            bench.append({"kind": "conservation_ledger",
+                          "unix": int(time.time()), **row})
+            with open(path, "w") as f:
+                json.dump(bench, f, indent=2)
         return
 
     if args.ab_pairs > 0:
@@ -652,7 +887,9 @@ def main():
             {"batch_warm_tasks_per_sec": r["batch_warm_tasks_per_sec"],
              "batch_tasks_per_sec": r["batch_tasks_per_sec"],
              "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
-             "phases_ms_per_1k": r["phases_ms_per_1k"]}
+             "phases_ms_per_1k": r["phases_ms_per_1k"],
+             "env": r.get("env"),
+             "env_verdict": env_verdict(r.get("env"))}
             for r in runs]
     if args.record and runs and ts_snap is not None:
         out["timeseries"] = ts_snap
@@ -698,6 +935,8 @@ def main():
             "p50_ms": out["p50_ms"],
             "p99_ms": out["p99_ms"],
             "phases_ms_per_1k": out.get("phases_ms_per_1k"),
+            "env": runs[-1].get("env"),
+            "env_verdict": env_verdict(runs[-1].get("env")),
             "note": args.note,
         })
         with open(path, "w") as f:

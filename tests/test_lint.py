@@ -492,10 +492,13 @@ class TestThreadSharedState:
     def test_mutation_of_real_flight_recorder_is_caught(self, tmp_path):
         src = real_source("ray_tpu/_private/flight_recorder.py")
         locked = ("        with self._counts_lock:\n"
-                  "            counts, self._counts = self._counts, {}\n")
+                  "            counts, self._counts = self._counts, {}\n"
+                  "            self._oncpu = {}\n")
         assert locked in src
         mutated = src.replace(
-            locked, "        counts, self._counts = self._counts, {}\n")
+            locked,
+            "        counts, self._counts = self._counts, {}\n"
+            "        self._oncpu = {}\n")
         findings = lint(
             tmp_path, {"ray_tpu/_private/flight_recorder.py": mutated},
             self.RULE)

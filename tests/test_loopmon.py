@@ -1,0 +1,370 @@
+"""Event-loop observatory units: loopmon detection/attribution, the
+kill switch, off-CPU truth (procfs thread clocks), the gauge-ceiling SLO
+kind, and the wall-clock conservation ledger."""
+
+import asyncio
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import loopmon
+
+
+# ---------------------------------------------------------------------------
+# LoopMonitor: blocking-callback detection + lag heartbeat
+# ---------------------------------------------------------------------------
+
+def test_blocking_callback_detected_and_attributed():
+    """An injected 50 ms blocking callback must land in the slow-callback
+    ledger under its own name, show up in the callback run-time total,
+    and stall the lag heartbeat by roughly its duration."""
+
+    def block_50ms():
+        time.sleep(0.05)
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        # sample=1: every callback wrapped, so the ONE injected blocker
+        # is guaranteed a named ledger row (production defaults to 1/8).
+        mon = loopmon.LoopMonitor("unit", loop, hb_ms=10.0, slow_ms=20.0,
+                                  sample=1)
+        assert mon.install()
+        loop.call_soon(block_50ms)
+        await asyncio.sleep(0.25)
+        out = mon.drain()
+        mon.uninstall()
+        return out
+
+    out = asyncio.run(scenario())
+    slow = {row[0]: row for row in out["slow"]}
+    name = next((n for n in slow if "block_50ms" in n), None)
+    assert name is not None, out["slow"]
+    assert slow[name][1] >= 1                       # count
+    assert slow[name][3] >= 0.045                   # max_s ~ the sleep
+    assert out["cb_s"] >= 0.045
+    assert out["cb_count"] >= 1
+    # The heartbeat that was due during the block measured the stall.
+    assert out["lag"]["max_ms"] >= 30.0, out["lag"]
+    assert out["lag"]["count"] >= 3
+    # The loop DID poll (selector wrapper active).
+    assert out["polls"] > 0
+    assert out["dwell_s"] > 0.0
+
+
+def test_lag_heartbeat_on_stalled_loop():
+    """A loop stalled outside any monitored callback (sync sleep in the
+    coroutine body) still registers lag: the heartbeat compares due-vs-
+    actual wakeup, which no per-callback timer can see."""
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        mon = loopmon.LoopMonitor("unit", loop, hb_ms=10.0, slow_ms=500.0)
+        mon.install()
+        await asyncio.sleep(0.05)      # a few clean beats
+        time.sleep(0.08)               # stall the loop thread itself
+        await asyncio.sleep(0.05)      # let the late beat run
+        snap = mon.snapshot()
+        mon.uninstall()
+        return snap
+
+    snap = asyncio.run(scenario())
+    assert snap["lag"]["max_ms"] >= 50.0, snap["lag"]
+    assert snap["lag"]["count"] >= 5
+    # Histogram buckets account for every beat.
+    assert sum(snap["lag"]["buckets"].values()) == snap["lag"]["count"]
+    # Re-anchoring: the stall produced ONE big lag sample, not a backlog
+    # of missed beats all reporting huge lag.
+    big = sum(n for b, n in snap["lag"]["buckets"].items()
+              if b == "+inf" or float(b) >= 50.0)
+    assert big <= 2, snap["lag"]["buckets"]
+
+
+def test_uninstall_restores_stock_loop():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        mon = loopmon.LoopMonitor("unit", loop, hb_ms=10.0)
+        mon.install()
+        assert "call_soon" in loop.__dict__
+        mon.uninstall()
+        assert "call_soon" not in loop.__dict__
+        assert "call_later" not in loop.__dict__
+        sel = getattr(loop, "_selector", None)
+        if sel is not None:
+            assert getattr(sel.select, "__name__", "") != "timed_select"
+
+    asyncio.run(scenario())
+
+
+def test_kill_switch_leaves_loops_untouched(monkeypatch):
+    """RAY_TPU_LOOPMON=0: install() is a no-op, the loop keeps its stock
+    scheduling attributes, and the cpu sampler is absent too."""
+    monkeypatch.setenv("RAY_TPU_LOOPMON", "0")
+    assert not loopmon.enabled()
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        assert loopmon.install("kill-test") is None
+        assert "call_soon" not in loop.__dict__
+        assert "call_soon_threadsafe" not in loop.__dict__
+        sel = getattr(loop, "_selector", None)
+        if sel is not None:
+            assert getattr(sel.select, "__name__", "") != "timed_select"
+
+    asyncio.run(scenario())
+    assert loopmon.get("kill-test") is None
+    assert loopmon.cpu_sampler("kill-test") is None
+    # The flight recorder honors the same switch: no tagging reads.
+    from ray_tpu._private.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder("kill-test", hz=100)
+    assert rec._tag_cpu is False
+
+
+def test_install_registry_keyed_by_component():
+    async def scenario():
+        mon = loopmon.install("reg-a")
+        assert mon is not None and mon.installed
+        # Idempotent for the same component + loop.
+        assert loopmon.install("reg-a") is mon
+        assert loopmon.get("reg-a") is mon
+        loopmon.uninstall("reg-a")
+        assert loopmon.get("reg-a") is None
+        assert not mon.installed
+
+    asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# off-CPU truth: thread CPU clocks + ctx switches from procfs
+# ---------------------------------------------------------------------------
+
+requires_procfs = pytest.mark.skipif(
+    not os.path.isdir("/proc/self/task"),
+    reason="procfs thread dirs required")
+
+
+@requires_procfs
+def test_thread_cpu_sampler_window_deltas():
+    stop = threading.Event()
+
+    def burn():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+    # Start the burner BEFORE the priming drain: first-sight threads
+    # contribute nothing to the window they appear in (by design), so a
+    # thread born mid-window is only measured from the next drain on.
+    t = threading.Thread(target=burn, daemon=True)
+    t.start()
+    sampler = loopmon.ThreadCpuSampler("unit")
+    assert sampler.drain() is not None  # priming pass (first-sight zeros)
+    try:
+        time.sleep(0.3)
+        out = sampler.drain()
+    finally:
+        stop.set()
+        t.join()
+    assert out is not None
+    assert out["wall_s"] >= 0.25
+    assert out["cpu_s"] > 0.05, out        # the burner ran on-CPU
+    assert out["nthreads"] >= 2
+    assert out["threads"], out             # per-comm breakdown present
+    assert out["vol"] + out["invol"] >= 0
+
+
+@requires_procfs
+def test_blocked_in_recv_reports_zero_oncpu():
+    """The PR 12 self-time lie, pinned shut: a thread blocked in
+    socket.recv accumulates WALL samples but ~0 on-CPU weight, while a
+    spinning thread's stacks carry high on-CPU weight."""
+    from ray_tpu._private.flight_recorder import FlightRecorder
+
+    a, b = socket.socketpair()
+    stop = threading.Event()
+
+    def blocked_in_recv():
+        try:
+            a.recv(1)  # nothing ever arrives until teardown
+        except OSError:
+            pass
+
+    def busy_spin():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+    rec = FlightRecorder("unit", hz=1000)  # hz irrelevant: manual sampling
+    t_blocked = threading.Thread(target=blocked_in_recv,
+                                 name="recv-t", daemon=True)
+    t_busy = threading.Thread(target=busy_spin, name="busy-t", daemon=True)
+    t_blocked.start()
+    t_busy.start()
+    try:
+        own = threading.get_ident()
+        for _ in range(12):
+            rec._sample_once(own)
+            time.sleep(0.02)
+        assert rec.cpu_tagging is True
+        counts = rec.snapshot()
+        oncpu = rec.snapshot_oncpu()
+    finally:
+        stop.set()
+        b.send(b"x")
+        t_busy.join()
+        t_blocked.join()
+        a.close()
+        b.close()
+
+    def agg(substr):
+        wall = sum(n for k, n in counts.items() if substr in k)
+        cpu = sum(v for k, v in oncpu.items() if substr in k)
+        return wall, cpu
+
+    wall_blocked, cpu_blocked = agg("blocked_in_recv")
+    wall_busy, cpu_busy = agg("busy_spin")
+    assert wall_blocked >= 8, counts       # sampled while blocked
+    assert wall_busy >= 8, counts
+    # Blocked thread: near-zero on-CPU. Busy thread: most of its wall.
+    assert cpu_blocked <= 0.1 * wall_blocked, (cpu_blocked, wall_blocked)
+    assert cpu_busy >= 0.5 * wall_busy, (cpu_busy, wall_busy)
+
+
+def test_attribution_table_degrades_without_oncpu():
+    from ray_tpu._private.flight_recorder import attribution_table
+
+    counts = {"a.py:f1;a.py:f2": 10, "a.py:f1": 5}
+    rows = attribution_table(counts, None, top=10)
+    assert rows and all(r[2] is None for r in rows)   # oncpu column absent
+    rows = attribution_table(counts, {"a.py:f1;a.py:f2": 2.5}, top=10)
+    by_frame = {r[0]: r for r in rows}
+    assert by_frame["a.py:f2"][2] == pytest.approx(2.5)
+    assert by_frame["a.py:f1"][1] == 5                 # leaf wall samples
+    assert by_frame["a.py:f1"][3] == 15                # cumulative
+
+
+# ---------------------------------------------------------------------------
+# gauge-ceiling SLO: sustained breach semantics
+# ---------------------------------------------------------------------------
+
+def _gauge_points(values, bucket_s=10.0, now=None):
+    now = now if now is not None else time.time()
+    pts = []
+    t = now - bucket_s * len(values)
+    for v in values:
+        pts.append((t, {"last": v, "min": v, "max": v, "sum": v, "n": 1}))
+        t += bucket_s
+    return pts
+
+
+def test_gauge_ceiling_rule_fires_only_on_sustained_breach():
+    from ray_tpu.monitor import SloEngine, SloRule
+
+    rule = SloRule("head_loop_lag", "gauge-ceiling", "head_loop_lag_ms",
+                   threshold=250.0, window_s=60.0, min_count=3)
+    mon = SloEngine.__new__(SloEngine)
+    now = time.time()
+
+    def ev(values):
+        payload = {"series": {"head_loop_lag_ms":
+                              {"points": _gauge_points(values, now=now)}}}
+        return mon._eval_rule(rule, payload, now)
+
+    # One spiky bucket among quiet ones: NOT sustained, never fires.
+    out = ev([10.0, 900.0, 12.0, 8.0])
+    assert out["firing"] is False
+    # Every bucket breaching: sustained, fires with the window MIN.
+    out = ev([300.0, 400.0, 280.0, 350.0])
+    assert out["firing"] is True
+    assert out["value"] == 280.0
+    # Too few samples: can't claim "sustained".
+    out = ev([400.0, 500.0])
+    assert out["firing"] is False
+    # No samples at all: silent.
+    out = ev([])
+    assert out["firing"] is False and out["value"] is None
+
+
+def test_head_loop_lag_rule_in_default_set():
+    from ray_tpu.monitor import default_slo_rules
+
+    rules = {r.name: r for r in default_slo_rules()}
+    assert "head_loop_lag" in rules
+    assert rules["head_loop_lag"].kind == "gauge-ceiling"
+    assert rules["head_loop_lag"].series == "head_loop_lag_ms"
+
+
+# ---------------------------------------------------------------------------
+# wall-clock conservation ledger
+# ---------------------------------------------------------------------------
+
+def _trace(t0, phase_windows):
+    return {"task_id": "t", "phases": {p: [t0 + a, t0 + b]
+                                       for p, (a, b) in
+                                       phase_windows.items()},
+            "total_ms": 0.0}
+
+
+def test_conservation_ledger_phases_plus_gaps_within_epsilon():
+    from ray_tpu._private.tracing import (GAP_BUCKETS, conservation_ledger,
+                                          ledger_table)
+
+    # Two identical tasks: 1000 µs e2e, 700 µs inside phases, 300 µs gap.
+    windows = {"driver_serialize": (0.0, 100e-6),
+               "submit_rpc": (100e-6, 400e-6),
+               "worker_exec": (500e-6, 700e-6),
+               "driver_fetch": (900e-6, 1000e-6)}
+    traces = {"a": _trace(10.0, windows), "b": _trace(20.0, windows)}
+    window = {"tasks": 2,
+              "lag_s": 200e-6,        # 100 µs/task head loop lag
+              "cb_s": 300e-6,         # 150 µs/task callbacks...
+              "handler_s": 200e-6,    # ...100 µs/task already in phases
+              "dwell_s": 1.0,
+              "socket_dwell_s": 100e-6,   # 50 µs/task blocked in recv
+              "ctx": 20}                  # 10/task * 2 µs proxy
+    led = conservation_ledger(traces, window)
+    assert led["tasks"] == 2
+    assert led["e2e_us"] == pytest.approx(1000.0, abs=1e-6)
+    assert led["phase_sum_us"] == pytest.approx(700.0, abs=1e-6)
+    assert led["gap_us"] == pytest.approx(300.0, abs=1e-6)
+    b = led["buckets_us"]
+    assert set(b) == set(GAP_BUCKETS)
+    assert b["head_loop_lag"] == pytest.approx(100.0, abs=1e-6)
+    assert b["callback_run"] == pytest.approx(50.0, abs=1e-6)
+    assert b["socket_dwell"] == pytest.approx(50.0, abs=1e-6)
+    assert b["ctx_switch"] == pytest.approx(20.0, abs=1e-6)
+    # Conservation: phases + explained gaps never exceed e2e, and here
+    # they reconcile to within ε.
+    total = led["phase_sum_us"] + led["explained_us"]
+    assert total <= led["e2e_us"] + 1e-6
+    assert led["coverage"] == pytest.approx(920.0 / 1000.0, abs=1e-9)
+    table = ledger_table(led)
+    assert "gap:head_loop_lag" in table and "coverage" in table
+
+
+def test_conservation_ledger_never_invents_wall_time():
+    """Gap buckets claiming more than the measured gap are scaled DOWN:
+    the ledger may under-explain, never over-explain."""
+    from ray_tpu._private.tracing import conservation_ledger
+
+    windows = {"driver_serialize": (0.0, 900e-6),
+               "driver_fetch": (950e-6, 1000e-6)}   # gap = 50 µs
+    traces = {"a": _trace(0.0, windows)}
+    window = {"tasks": 1, "lag_s": 400e-6, "cb_s": 0.0, "handler_s": 0.0,
+              "dwell_s": 0.0, "socket_dwell_s": 400e-6, "ctx": 0}
+    led = conservation_ledger(traces, window)
+    assert led["gap_us"] == pytest.approx(50.0, abs=1e-6)
+    assert led["explained_us"] <= led["gap_us"] + 1e-6
+    assert led["coverage"] <= 1.0
+    # Proportional scaling kept the bucket ratio.
+    b = led["buckets_us"]
+    assert b["head_loop_lag"] == pytest.approx(b["socket_dwell"], rel=1e-6)
+
+
+def test_conservation_ledger_empty():
+    from ray_tpu._private.tracing import conservation_ledger, ledger_table
+
+    led = conservation_ledger({}, None)
+    assert led["tasks"] == 0 and led["coverage"] == 0.0
+    assert "no sampled traces" in ledger_table(led)
