@@ -15,6 +15,12 @@ TPU-first:
 Transport is a length-prefixed pickle protocol over TCP (protocol.py); bulk
 object payloads ride the same channel chunked. The shared-memory C++ arena
 (ray_tpu/native) backs the local object store when built.
+
+Object tracking is ownership-sharded (ownership.py): each driver owns the
+inline results its job creates and serves them from an in-process owner
+table over wire-v9 frames; the GCS keeps only membership plus a
+consistent-hash directory of owners (kill switch ``RAY_TPU_OWNERSHIP=0``).
 """
 
+from .ownership import OwnerRing, OwnerServer, OwnerTable  # noqa: F401
 from .testing import Cluster  # noqa: F401

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .._private.config import Config
 from .._native import create_store
+from . import wire
 from .protocol import Connection, ResilientClient, RpcClient, RpcServer
 
 ERR_PREFIX = b"E"
@@ -185,6 +186,19 @@ class NodeController:
         self._kill_grace_s = float(os.environ.get(
             "RAY_TPU_KILL_GRACE_S", "1.0"))
         self._inflight_fetch: Dict[bytes, asyncio.Task] = {}  # pull dedupe
+        # Ownership plane (wire v9): inline results are published straight
+        # to their owning driver's table instead of the GCS object table.
+        # _owner_dir caches GCS get_owner lookups per job key (positive
+        # hits live longer than misses); _owner_clients holds one RpcClient
+        # per owner-serve address, used from to_thread only.
+        self._ownership_on = wire.ownership_enabled()
+        self._owner_dir: Dict[bytes, Tuple[float, Any]] = {}
+        self._owner_clients: Dict[Tuple[str, int], RpcClient] = {}
+        # Diverted entries flow through ONE publisher thread (started
+        # lazily): the completion hot path only strips + enqueues, never
+        # waits on an owner round trip.
+        self._owner_pub_q: Any = None
+        self._owner_pub_thread: Any = None
         # Borrower-side holds for actor-call args: actor calls bypass the
         # GCS task table (no dep pins there), so this node registers as
         # holder of the call's ref args from enqueue until the call
@@ -282,8 +296,6 @@ class NodeController:
         """Send register_node over ``client``. Idempotent on the GCS side
         (same node_id updates in place, rebinds the push connection), so it
         doubles as the reconnect re-registration after a head failover."""
-        from . import wire
-
         reg = client.call({
             "type": "register_node", "node_id": self.node_id,
             "address": list(self.address), "resources": self.resources,
@@ -325,6 +337,14 @@ class NodeController:
             if w.proc.poll() is None:
                 w.proc.terminate()
         await self.server.stop()
+        if self._owner_pub_q is not None:
+            self._owner_pub_q.put(None)  # publisher thread exit sentinel
+        for cli in list(self._owner_clients.values()):
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._owner_clients.clear()
         if self._gcs:
             self._gcs.close()
         if self.transfer_server is not None:
@@ -1037,6 +1057,14 @@ class NodeController:
     async def _remote_fetch(self, oid: bytes, timeout: float = 60.0) -> bytes:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            if self._owner_active():
+                # Owner-tracked inline results never reach the directory:
+                # ask the oid's owner first (cached job lookup + one
+                # owner_fetch; a miss costs one loopback RTT per cycle).
+                blob = await asyncio.to_thread(self._owner_fetch_blob, oid)
+                if blob is not None:
+                    await self._store_put(oid, blob)
+                    return blob
             resp = await asyncio.to_thread(self._gcs.call, {
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": min(5.0, timeout),
@@ -1093,6 +1121,183 @@ class NodeController:
             client = RpcClient(*addr)
             self._peer_clients[addr] = client
         return client
+
+    # ------------------------------------------------------- ownership plane
+    def _owner_active(self) -> bool:
+        return self._ownership_on \
+            and getattr(self._gcs, "peer_wire", 1) >= 9
+
+    def _owner_lookup(self, job: bytes):
+        """THREAD-side: resolve a job's owner-serve address via the GCS
+        directory (cached). Positive hits cache 10 s, misses 2 s — a
+        driver that never registered costs one probe per job per 2 s."""
+        now = time.monotonic()
+        ent = self._owner_dir.get(job)
+        if ent is not None and ent[0] > now:
+            return ent[1]
+        addr = None
+        try:
+            resp = self._gcs.call({"type": "get_owner", "job_id": job},
+                                  timeout=5.0)
+            info = resp.get("owner") if resp.get("ok") else None
+            if info and info.get("alive") and info.get("address"):
+                addr = (str(info["address"][0]), int(info["address"][1]))
+        except Exception:  # noqa: BLE001 - treated as a (short-lived) miss
+            addr = None
+        self._owner_dir[job] = (now + (10.0 if addr else 2.0), addr)
+        if len(self._owner_dir) > 4096:
+            self._owner_dir.pop(next(iter(self._owner_dir)))
+        return addr
+
+    def _owner_client(self, addr: Tuple[str, int]) -> RpcClient:
+        """THREAD-side: cached client to one owner-serve loop, with the
+        wire version probed once so publishes ride the binary codec."""
+        cli = self._owner_clients.get(addr)
+        if cli is None or cli._closed:
+            cli = RpcClient(*addr)
+            try:
+                cli.probe_wire()
+            except Exception:  # noqa: BLE001 - pickle frames still work
+                pass
+            self._owner_clients[addr] = cli
+        return cli
+
+    def _publish_to_owners(self, waves: Dict[Tuple[str, int], list]) -> set:
+        """THREAD-side: one acked owner_publish per owner for this wave.
+        Same-host owners get size+address only (the completion ring
+        already carried the bytes; our fetch_batch serves a ring miss);
+        cross-host owners get the blob — the bytes had to travel anyway,
+        and previously travelled to the GCS instead. Returns the set of
+        addresses whose publish FAILED (those entries stay on the legacy
+        GCS path so the bytes always land somewhere reachable)."""
+        failed = set()
+        my_host = self.address[0]
+        for addr, items in waves.items():
+            same_host = addr[0] == my_host
+            send = [[e[0], e[1], None if same_host else e[2]]
+                    for e in items]
+            msg = {"type": "owner_publish", "node_id": self.node_id,
+                   "address": list(self.address), "items": send}
+            try:
+                cli = self._owner_client(addr)
+                if same_host:
+                    # Address-only pointers: oneway — the bytes stay in
+                    # our inline stash either way, and a lost publish is
+                    # caught by the GCS owner-verify probe. Skipping the
+                    # ack halves the owner-side serve work per wave.
+                    cli.send_oneway(msg)
+                else:
+                    # Blob-bearing (cross-host): acked — the owner copy
+                    # is the authoritative one once our stash evicts.
+                    resp = cli.call(msg, timeout=10.0)
+                    if not resp.get("ok"):
+                        failed.add(addr)
+            except Exception:  # noqa: BLE001 - owner died / unreachable
+                self._owner_clients.pop(addr, None)
+                failed.add(addr)
+        return failed
+
+    def _owner_enqueue(self, ents: list) -> None:
+        """Hand diverted inline entries to the publisher thread (lazily
+        started). LOOP-side and O(1): the completion wave never waits on
+        an owner lookup or publish round trip."""
+        import queue
+
+        if self._owner_pub_q is None:
+            self._owner_pub_q = queue.Queue()
+            self._owner_pub_thread = __import__("threading").Thread(
+                target=self._owner_pub_loop, daemon=True,
+                name="owner-publish")
+            self._owner_pub_thread.start()
+        self._owner_pub_q.put(ents)
+
+    def _owner_pub_loop(self) -> None:
+        """Publisher thread: resolve owners (cached get_owner), send one
+        acked owner_publish per owner per drain, and fall back to the
+        legacy GCS registration (blob included) for anything unowned or
+        unreachable — bytes always land somewhere reachable. The finish
+        message has ALREADY been sent by the time entries drain here; a
+        driver woken early just re-polls until the publish lands, and a
+        lost publish is caught by the GCS's owner-verify probe, which
+        re-drives the task from lineage."""
+        import queue
+
+        q = self._owner_pub_q
+        while not self._shutting_down:
+            try:
+                ents = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if ents is None:
+                return
+            try:
+                batch = list(ents)
+                # Coalesce a short window: completion waves trickle
+                # entries in task-sized dribbles, and every publish wakes
+                # the owning DRIVER's serve loop (GIL theft from its
+                # submit/get hot path — measured 60% slower submit RTTs
+                # with per-wave publishes). 5 ms of batching turns ~1
+                # publish per task into ~1 per wave; the ring already
+                # delivered the bytes same-host, so nothing waits on it.
+                time.sleep(0.005)
+                try:  # drain everything the window accumulated
+                    while True:
+                        more = q.get_nowait()
+                        if more is None:
+                            return
+                        batch.extend(more)
+                except queue.Empty:
+                    pass
+                waves: Dict[Tuple[str, int], list] = {}
+                orphans: list = []
+                for ent in batch:
+                    addr = self._owner_lookup(bytes(ent[0][12:16]))
+                    if addr is None:
+                        orphans.append(ent)
+                    else:
+                        waves.setdefault(addr, []).append(ent)
+                if waves:
+                    failed = self._publish_to_owners(waves)
+                    for addr in failed:
+                        orphans.extend(waves.get(addr, []))
+                for ent in orphans:
+                    self._gcs.send_oneway(
+                        {"type": "add_object_location",
+                         "object_id": ent[0], "node_id": self.node_id,
+                         "size": ent[1], "blob": ent[2]})
+            except Exception:  # noqa: BLE001 - the loop must survive
+                time.sleep(0.05)
+
+    def _owner_fetch_blob(self, oid: bytes) -> Optional[bytes]:
+        """THREAD-side: fetch one owner-tracked blob straight from its
+        owner (inline bytes, or a location redirect to the node whose
+        ring delivered it). None = not owner-resolvable; the caller
+        falls back to the directory."""
+        if len(oid) < 16:
+            return None
+        addr = self._owner_lookup(bytes(oid[12:16]))
+        if addr is None:
+            return None
+        try:
+            cli = self._owner_client(addr)
+            resp = cli.call({"type": "owner_fetch", "object_ids": [oid]},
+                            timeout=5.0)
+            if not resp.get("ok"):
+                return None
+            blob = resp.get("blobs", {}).get(oid)
+            if blob is not None:
+                return blob
+            loc = resp.get("locations", {}).get(oid)
+            if loc:
+                loc = (str(loc[0]), int(loc[1]))
+                if loc != tuple(self.address):
+                    fetched = self._peer(loc).call(
+                        {"type": "fetch_object", "object_id": oid},
+                        timeout=30.0)
+                    return fetched.get("blob")
+        except Exception:  # noqa: BLE001 - owner died mid-fetch
+            self._owner_clients.pop(addr, None)
+        return None
 
     # ---------------------------------------------------------------- workers
     def _claim_worker(self, exclusive: bool) -> Optional[WorkerHandle]:
@@ -1300,6 +1505,25 @@ class NodeController:
         # buffer SYNCHRONOUSLY here — this already runs one deferred pass
         # after the completion wave, and chaining a second deferral
         # (_flush_gcs_out) measurably taxed serial round-trip latency.
+        if self._owner_active():
+            # Ownership divert: strip inline result entries out of the
+            # done items and hand them to the publisher thread — the GCS
+            # object table never sees them, and this path adds only a
+            # queue put to the completion wave.
+            divert: list = []
+            for item in buf:
+                added = item.get("added")
+                if not added:
+                    continue
+                keep = [e for e in added
+                        if len(e) <= 2 or e[2] is None or len(e[0]) < 16]
+                if len(keep) != len(added):
+                    divert.extend(e for e in added
+                                  if not (len(e) <= 2 or e[2] is None
+                                          or len(e[0]) < 16))
+                    item["added"] = keep
+            if divert:
+                self._owner_enqueue(divert)
         self._gcs_out.append({"type": "task_done_batch",
                               "node_id": self.node_id, "items": buf})
         out, self._gcs_out = self._gcs_out, []
@@ -1661,13 +1885,21 @@ class NodeController:
                 # Actor-method completion (or an unknown worker): no done
                 # item will carry these registrations — report directly
                 # (inline bytes ride the pickled dict, no binary codec).
+                # Inline entries divert to their owner like done items do.
+                owned = []
                 for ent in added:
+                    if self._owner_active() and len(ent) > 2 \
+                            and ent[2] is not None and len(ent[0]) >= 16:
+                        owned.append(ent)
+                        continue
                     reg = {"type": "add_object_location",
                            "object_id": ent[0],
                            "node_id": self.node_id, "size": ent[1]}
                     if len(ent) > 2 and ent[2] is not None:
                         reg["blob"] = ent[2]
                     self._gcs_send(reg)
+                if owned:
+                    self._owner_enqueue(owned)
             return None
 
         @s.handler("lease_worker")

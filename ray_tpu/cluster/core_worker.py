@@ -102,6 +102,14 @@ class ClusterCoreWorker:
         self._pub_lock = threading.Lock()
         if role == "driver":
             self._ensure_ring()
+        # Ownership plane (wire v9): this process OWNS every object its
+        # job tree creates. Drivers run an owner table + serve loop and
+        # register with the GCS owner directory; controllers then publish
+        # results owner-to-owner and the head keeps only the membership
+        # row (reference: the per-worker ownership table of
+        # reference_count.h — the owner, not the GCS, resolves its refs).
+        self._owner_table: Any = None
+        self._owner_server: Any = None
         self._transfer_cli: Any = None  # None=unprobed, False=unavailable
         self._transfer_has_store = False
         self._sub_client = None
@@ -184,6 +192,8 @@ class ClusterCoreWorker:
                 target=self._stats_flush_loop, daemon=True,
                 name="driver-stats-flush")
             self._stats_thread.start()
+            if wire.ownership_enabled():
+                self._init_ownership()
 
     # ------------------------------------------------------------- refcount
     def add_local_ref(self, oid) -> None:
@@ -290,6 +300,10 @@ class ClusterCoreWorker:
                                "worker": self.worker_uid, "held": held})
         except (ConnectionError, OSError):
             pass  # the periodic refresh loop re-asserts in <= 2 s
+        # Owner directory row: replicated, so a failover restored it — but
+        # a cold head restart did not. Registration is idempotent.
+        if self._owner_server is not None:
+            self._register_owner(client)
         if self._sub_client is not None:
             try:
                 self._sub_client.close()
@@ -690,6 +704,101 @@ class ClusterCoreWorker:
         self._count_result("ring", n_ring)
         self._count_result("inline", n_inline, inline_bytes)
         return out
+
+    # ------------------------------------------------------ ownership plane
+    def _init_ownership(self) -> None:
+        """Stand up this driver's owner table + serve loop and register
+        with the GCS owner directory. Failure anywhere (pre-v9 head, bind
+        error) leaves ownership off for this driver — results then ride
+        the legacy GCS-tracked path, which stays fully supported."""
+        from . import ownership
+
+        try:
+            if self._gcs_wire_version() < 9:
+                return  # pre-v9 head has no owner directory
+            table = ownership.OwnerTable()
+            server = ownership.OwnerServer(
+                table, host="0.0.0.0", on_publish=self._owner_republish)
+            server.start()
+            self._owner_table = table
+            self._owner_server = server
+            self._register_owner()
+            # Keep the owner lease warm from t0: the ref refresher doubles
+            # as the owner heartbeat, and an idle driver (registered but
+            # not yet submitting) must not expire before its first task.
+            with self._ref_lock:
+                self._arm_ref_timer()
+        except Exception:  # noqa: BLE001 - ownership is an optimization
+            if self._owner_server is not None:
+                try:
+                    self._owner_server.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._owner_table = None
+            self._owner_server = None
+
+    def _owner_address(self) -> list:
+        """Routable address of the owner-serve loop: the IP the GCS
+        connection uses locally (correct across hosts), loopback when it
+        can't be read."""
+        host = "127.0.0.1"
+        try:
+            host = self.gcs._ensure()._sock.getsockname()[0]
+            if host in ("0.0.0.0", ""):
+                host = "127.0.0.1"
+        except Exception:  # noqa: BLE001 - single-host fallback
+            pass
+        return [host, self._owner_server.port]
+
+    def _register_owner(self, client=None) -> None:
+        """Idempotent directory registration (replicated at the GCS, so a
+        failover restores it; re-asserted on every reconnect anyway)."""
+        if self._owner_server is None:
+            return
+        msg = {"type": "register_owner",
+               "job_id": self.job_id.binary(),
+               "address": self._owner_address(),
+               "worker": self.worker_uid,
+               "node_id": ""}
+        try:
+            if client is not None:
+                client.call(msg, timeout=5.0)
+            else:
+                self.gcs.call(msg, timeout=10.0)
+        except Exception:  # noqa: BLE001 - re-asserted on reconnect
+            pass
+
+    def _owner_republish(self, fresh) -> None:
+        """Owner-serve callback: a controller just published records into
+        this driver's owner table. Blob-bearing records re-enter the ring
+        data plane so get()/wait()/futures wake through the exact harvest
+        path same-host results already use; blob-less records are
+        address-only pointers (the completion ring carried the bytes, or a
+        fetch from the named node will) and need no delivery here."""
+        for oid, size, blob in fresh:
+            if blob is None:
+                continue
+            try:
+                if not self.publish_completion(oid, size, inline=blob):
+                    self._cache_blob(oid, blob)
+            except Exception:  # noqa: BLE001 - table consult is the backstop
+                self._cache_blob(oid, blob)
+
+    def _owner_pointer_fetch(self, oids) -> Dict[bytes, dict]:
+        """Locations for pending oids the owner table tracks ADDRESS-ONLY
+        (ring record lost to a full/disabled ring): shaped like directory
+        infos so _fetch_many pulls them from the holding node directly —
+        the GCS never saw these objects."""
+        table = self._owner_table
+        if table is None or not len(table):
+            return {}
+        infos: Dict[bytes, dict] = {}
+        for oid in oids:
+            loc = table.locate(oid)
+            if loc is not None and not loc["inline"] \
+                    and loc["addr"] is not None:
+                infos[oid] = {"addresses": [list(loc["addr"])]}
+        return infos
 
     # ---------------------------------------------------------- submit pipe
     def _queue_submit(self, msg: Dict) -> None:
@@ -1535,6 +1644,16 @@ class ClusterCoreWorker:
             step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
             if step <= 0:
                 raise GetTimeoutError(f"object {oid.hex()[:16]} not ready")
+            if self._ring_active():
+                self._ring_harvest()
+            blob = self._local_blob(oid)
+            if blob is not None:
+                return blob
+            infos = self._owner_pointer_fetch([oid])
+            if infos:
+                blob = self._fetch_many(infos).get(oid)
+                if blob is not None:
+                    return blob
             resp = self.gcs.call({
                 "type": "get_object_locations", "object_id": oid,
                 "wait": True, "timeout": step,
@@ -1574,7 +1693,12 @@ class ClusterCoreWorker:
             blob = self.local_store.get_bytes(oid)
             if blob is not None:
                 return blob
-        return self._blob_cache.get(oid)
+        blob = self._blob_cache.get(oid)
+        if blob is None and self._owner_table is not None:
+            # Owner-published inline result whose ring republish was
+            # missed (ring full/disabled): the table itself holds bytes.
+            blob = self._owner_table.get_blob(oid)
+        return blob
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
@@ -1739,6 +1863,22 @@ class ClusterCoreWorker:
                     ask.append(oid)
                     if len(ask) >= 1024:
                         break
+            infos = self._owner_pointer_fetch(ask)
+            if infos:
+                # Address-only owner-table pointers (ring record lost):
+                # fetch straight from the holding node — the directory
+                # has no row for owner-tracked results.
+                t0 = time.perf_counter()
+                fetched = self._fetch_many(infos)
+                for oid, blob in fetched.items():
+                    _resolve(oid, blob, "owner")
+                self._phase_add("driver_fetch", time.perf_counter() - t0,
+                                len(fetched))
+                if not pending:
+                    break
+                ask = [o for o in ask if o in pending]
+                if not ask:
+                    continue  # window fully owner-served: refill it
             resp = self.gcs.call(
                 {"type": "locations_batch", "object_ids": ask,
                  "wait_s": wait_s, "probe": probe,
@@ -1823,6 +1963,13 @@ class ClusterCoreWorker:
                 if oid in ready:
                     continue
                 if self._local_blob(oid) is not None:
+                    ready.add(oid)
+                    self._direct_observed(oid)
+                    continue
+                if self._owner_table is not None \
+                        and self._owner_table.locate(oid) is not None:
+                    # Owner-tracked pointer: the bytes are one node fetch
+                    # away, which is as ready as a directory location.
                     ready.add(oid)
                     self._direct_observed(oid)
                     continue
@@ -1984,6 +2131,8 @@ class ClusterCoreWorker:
         oids = [r.id.binary() for r in refs]
         for oid in oids:
             self._blob_cache.pop(oid, None)
+        if self._owner_table is not None:
+            self._owner_table.discard(oids)
         try:
             self.gcs.call({"type": "free_objects", "object_ids": oids})
         except (ConnectionError, OSError):
@@ -2183,6 +2332,13 @@ class ClusterCoreWorker:
         self._ref_shutdown.set()
         self._ref_dirty.set()  # unblock the flusher so it can exit
         self._flush_refs()
+        if self._owner_server is not None:
+            try:
+                self._owner_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._owner_server = None
+            self._owner_table = None
         # Exiting process drops all its holds (reference: owner death).
         with self._ref_lock:
             held, self._ref_counts = list(self._ref_counts), {}

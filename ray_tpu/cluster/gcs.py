@@ -20,7 +20,7 @@ import numpy as np
 
 from .._private.config import Config
 from .._private.resources import NUM_PREDEFINED, ResourceSet, dense_matrix
-from . import wire
+from . import ownership, wire
 from .protocol import Connection, RpcServer
 
 # The pending reasons trended as per-tick gauges. A literal (not an import)
@@ -210,6 +210,21 @@ class GcsServer:
         self._ref_zero_since: Dict[bytes, float] = {}
         self._dep_pins: Dict[bytes, int] = {}
         self._contained: Dict[bytes, List[bytes]] = {}
+        # ---- ownership directory (membership only — the object/result
+        # plane lives at the owners). job bytes -> {address, worker_uid,
+        # node_id, alive, shard, ts}; the shard index comes from the
+        # consistent-hash ring so the layout is stable across owner
+        # churn and is the unit the auditor reasons about. Owner
+        # liveness rides the existing ref lease (_ref_worker_seen):
+        # a driver that stops refreshing for the lease window is a dead
+        # owner, and its objects recover through lineage re-drive.
+        self.owners: Dict[bytes, Dict[str, Any]] = {}
+        self._owner_ring = ownership.OwnerRing()
+        # Debounce for async owner-holds probes (oid -> monotonic stamp),
+        # mirroring the spill-restore debounce: one in-flight verification
+        # per object, never a probe storm from a hot poll loop.
+        self._owner_probe_ts: Dict[bytes, float] = {}
+        self._owner_clients: Dict[Tuple[str, int], Any] = {}
         self._error_order: Any = _deque()
         self._finished_order: Any = _deque()
         # task_done reports that arrived before their task had any record
@@ -557,6 +572,7 @@ class GcsServer:
             "task_table": c(self.task_table),
             "lineage": c(self.lineage),
             "error_objects": c(self.error_objects),
+            "owners": c(self.owners),
             "placement_groups": {
                 pid: {k: v for k, v in rec.items() if k != "waiters"}
                 for pid, rec in self.placement_groups.items()
@@ -622,6 +638,11 @@ class GcsServer:
         self.task_table = state.get("task_table", {})
         self.lineage = state.get("lineage", {})
         self.error_objects = state.get("error_objects", {})
+        self.owners = state.get("owners", {})
+        for ent in self.owners.values():
+            # Restored owners must re-prove liveness under the new leader's
+            # ref lease before recovery trusts them with objects again.
+            ent["ts"] = time.monotonic()
         self.placement_groups = state.get("placement_groups", {})
         self.quarantined = state.get("quarantine", {})
         self._fn_strikes = state.get("fn_strikes", {})
@@ -688,7 +709,7 @@ class GcsServer:
         "object_spilled", "free_objects", "remove_object_locations",
         "remove_object_location", "put_function", "kv_put", "set_resource",
         "create_placement_group", "remove_placement_group",
-        "drain_node", "clear_quarantine",
+        "drain_node", "clear_quarantine", "register_owner",
     })
 
     def _install_replication(self) -> None:
@@ -1380,7 +1401,8 @@ class GcsServer:
     # gauge's tag domain — zeros are exported so recoveries are visible).
     _AUDIT_KINDS = ("leaked_object", "stale_location", "phantom_location",
                     "stale_spill", "orphaned_task", "lineage_orphan",
-                    "inline_divergence", "stale_ring")
+                    "inline_divergence", "stale_ring",
+                    "dual_tracked_object", "dead_owner_orphan")
 
     def note_node_audit(self, node_id: str, audit: Dict[str, Any]) -> None:
         """One controller inventory snapshot (rode node_stats). The last
@@ -1514,6 +1536,57 @@ class GcsServer:
             flag("inline_divergence", tracked=int(self._inline_total),
                  actual=int(actual))
 
+        # --- owner-shard invariants (ownership plane). Exactly one
+        # authority per object: an inline entry in THIS directory whose
+        # job has a live owner is only a fault if the owner tracks it too
+        # (legacy fallbacks — dead-owner recovery, pre-v9 controllers —
+        # legitimately land inline results here while the owner stays
+        # ignorant of them), so suspects are confirmed with a live
+        # owner_locate probe before flagging.
+        if self.owners:
+            dual_suspects: Dict[Tuple[str, int], List[bytes]] = {}
+            for oid, entry in list(self.objects.items()):
+                if entry.get("inline") is None:
+                    continue
+                ent = self._owner_entry(oid)
+                if ent is None:
+                    continue
+                addr = tuple(ent.get("address") or ())
+                if len(addr) == 2:
+                    dual_suspects.setdefault(addr, []).append(oid)
+            for addr, oids in dual_suspects.items():
+                held: Optional[Set[bytes]] = None
+                if verify:
+                    held = await asyncio.to_thread(
+                        self._owner_probe_holds, addr, oids[:256])
+                for oid in oids[:256]:
+                    if held is not None and oid in held:
+                        flag("dual_tracked_object", object_id=oid.hex(),
+                             owner=f"{addr[0]}:{addr[1]}")
+            # Dead-owner orphans: lineage this directory still routes to a
+            # dead owner, while someone (a ref holder or a staged dep)
+            # still wants the object. Recoverable when the producing task
+            # record survives for a lineage re-drive — the recovery the
+            # next fetch miss triggers.
+            for job, ent in list(self.owners.items()):
+                if self._owner_is_alive(ent):
+                    continue
+                for oid, tid in list(self.lineage.items()):
+                    if ownership.owner_key(oid) != job:
+                        continue
+                    if oid in self.objects or oid in self.error_objects:
+                        continue
+                    if oid not in self._ref_holders \
+                            and self._dep_pins.get(oid, 0) == 0:
+                        continue  # unreferenced: the ref GC reclaims it
+                    rec = self.task_table.get(tid)
+                    recoverable = bool(
+                        rec is not None and not rec["cancelled"]
+                        and rec["state"] in ("FINISHED", "PENDING",
+                                             "DISPATCHED"))
+                    flag("dead_owner_orphan", object_id=oid.hex(),
+                         job=job.hex(), recoverable=recoverable)
+
         by_kind: Dict[str, int] = {}
         for f in findings:
             by_kind[f["kind"]] = by_kind.get(f["kind"], 0) + 1
@@ -1534,6 +1607,7 @@ class GcsServer:
                    "nodes_checked": nodes_checked,
                    "objects_checked": len(self.objects),
                    "tasks_checked": len(self.task_table),
+                   "owners_checked": len(self.owners),
                    "verified": bool(verify)}
         self._last_audit = summary
         try:
@@ -1682,13 +1756,114 @@ class GcsServer:
         # fetch, which the consuming node's pull path does transparently.
         entry = self.objects.get(oid)
         if not entry:
-            return False
+            # Ownership plane: an entry-less FINISHED result whose job has
+            # a live registered owner is ready — the bytes live at the
+            # owner, and the consuming controller owner-fetches them.
+            # (Owner-table eviction / lost publishes surface downstream as
+            # a fetch miss, which re-enters recovery via the GCS poll.)
+            return self._owner_dep_ready(oid)
         if entry.get("inline") is not None:
             return True  # the directory itself holds the bytes
         return any(
             n in self.nodes and self.nodes[n].alive
             for n in (*entry["locations"], *self._spilled_set(entry))
         )
+
+    # ------------------------------------------------- ownership directory
+    _OWNER_LEASE_S = 20.0   # matches the ref lease in _ref_gc_loop
+
+    def _owner_is_alive(self, ent: Dict[str, Any]) -> bool:
+        """Owner liveness rides the ref lease: fresh ref_refresh beats from
+        the owner's worker uid keep it alive; absent those (e.g. right
+        after a failover restore, before drivers re-register), the
+        registration/restore stamp gets one full lease window."""
+        if not ent.get("alive", True):
+            return False
+        now = time.monotonic()
+        worker = ent.get("worker_uid")
+        seen = self._ref_worker_seen.get(worker) if worker else None
+        if seen is not None and now - seen <= self._OWNER_LEASE_S:
+            return True
+        return now - float(ent.get("ts") or 0.0) <= self._OWNER_LEASE_S
+
+    def _owner_entry(self, oid: bytes) -> Optional[Dict[str, Any]]:
+        """The LIVE owner of an object's job, or None (no owner registered
+        — legacy/pre-v9/kill-switched driver — or owner dead)."""
+        if not self.owners:
+            return None
+        ent = self.owners.get(ownership.owner_key(oid))
+        if ent is None or not self._owner_is_alive(ent):
+            return None
+        return ent
+
+    def _owner_dep_ready(self, oid: bytes) -> bool:
+        ent = self._owner_entry(oid)
+        if ent is None:
+            return False
+        tid = self.lineage.get(oid)
+        rec = self.task_table.get(tid) if tid else None
+        return rec is not None and rec["state"] == "FINISHED"
+
+    def _owner_verify(self, oid: bytes, ent: Dict[str, Any]) -> None:
+        """Debounced async check that a live owner actually HOLDS a result
+        the directory no longer tracks. The hot path trusts the owner; this
+        runs only after a consumer has polled the GCS for an object it
+        could not resolve (lost publish, owner-table eviction). On a
+        confirmed miss the producing task re-drives through lineage —
+        exactly the recovery path node death uses."""
+        now = time.monotonic()
+        last = self._owner_probe_ts.get(oid, 0.0)
+        if now - last < 2.0:
+            return
+        self._owner_probe_ts[oid] = now
+        while len(self._owner_probe_ts) > 100_000:
+            self._owner_probe_ts.pop(next(iter(self._owner_probe_ts)))
+        addr = tuple(ent.get("address") or ())
+        if len(addr) != 2:
+            return
+        self._spawn(self._owner_verify_task(oid, addr))
+
+    def _owner_probe_holds(self, addr: Tuple[str, int],
+                           oids: List[bytes]) -> Optional[Set[bytes]]:
+        """Blocking owner_locate against one owner endpoint (runs in a
+        worker thread). None = unreachable; else the subset of ``oids``
+        the owner tracks."""
+        from .protocol import RpcClient
+
+        try:
+            cli = self._owner_clients.get(addr)
+            if cli is None or cli._closed:
+                cli = RpcClient(*addr, timeout=2.0)
+                cli.probe_wire(timeout=2.0)
+                self._owner_clients[addr] = cli
+            resp = cli.call({"type": "owner_locate", "object_ids": oids},
+                            timeout=2.0)
+            return set(resp.get("objects") or ())
+        except Exception:  # noqa: BLE001 - unreachable owner
+            self._owner_clients.pop(addr, None)
+            return None
+
+    async def _owner_verify_task(self, oid: bytes,
+                                 addr: Tuple[str, int]) -> None:
+        held = await asyncio.to_thread(self._owner_probe_holds, addr, [oid])
+        if held is None or oid in held:
+            # Unreachable (the lease sweep decides death, not one socket
+            # error) or confirmed held: nothing to recover.
+            return
+        tid = self.lineage.get(oid)
+        rec = self.task_table.get(tid) if tid else None
+        if rec is None or rec["cancelled"] or rec["state"] != "FINISHED":
+            return
+        if time.time() - float(rec.get("ts_finish") or 0.0) \
+                < ownership.owner_grace_s():
+            return  # publish may still be in flight controller->owner
+        rec["state"] = "PENDING"
+        rec["node_id"] = None
+        self._pin_deps(rec)
+        self.record_event("owner_miss_redrive",
+                          task_id=rec["task_id"].hex()[:16],
+                          object_id=oid.hex()[:16])
+        self._spawn(self._drive_task(rec))
 
     async def _wait_deps(self, rec: Dict[str, Any]) -> bool:
         """Hold the task un-placed until every dependency has a live copy,
@@ -2157,6 +2332,18 @@ class GcsServer:
                         self._ref_dec(worker, oid)
                     self._ref_worker_held.pop(worker, None)
                     self._ref_worker_seen.pop(worker, None)
+            # Owner-death sweep: an owner whose lease lapsed is marked dead
+            # (never revived — a re-register writes a fresh entry), which
+            # flips every downstream decision for its objects to the
+            # legacy path: dep staging stops trusting it, recovery
+            # re-drives through lineage, and re-executed results register
+            # in this directory again.
+            for job, ent in self.owners.items():
+                if ent.get("alive", True) and not self._owner_is_alive(ent):
+                    ent["alive"] = False
+                    self.record_event("owner_dead", job=job.hex(),
+                                      worker=ent.get("worker_uid") or "",
+                                      shard=ent.get("shard", 0))
             victims = [oid for oid, t in self._ref_zero_since.items()
                        if now - t > grace
                        and self._dep_pins.get(oid, 0) == 0]
@@ -2251,6 +2438,16 @@ class GcsServer:
         if rec is None or rec["cancelled"]:
             return False
         if rec["state"] == "FINISHED":
+            owner = self._owner_entry(oid)
+            if owner is not None:
+                # Owner-tracked result: the bytes live at the owner, which
+                # this directory deliberately no longer mirrors — a blind
+                # re-drive here would re-execute every owner-tracked task
+                # a consumer ever polls for. Verify asynchronously (one
+                # debounced owner_locate off-loop) and re-drive only on a
+                # confirmed miss older than the publish grace window.
+                self._owner_verify(oid, owner)
+                return True
             rec["state"] = "PENDING"
             rec["node_id"] = None
             self._pin_deps(rec)  # re-executing: args must stay alive again
@@ -3717,6 +3914,54 @@ class GcsServer:
             return {"ok": True,
                     "wire": 0 if wire.pickle_only() else wire.WIRE_VERSION}
 
+        @s.handler("register_owner")
+        async def register_owner(msg, conn):
+            """A driver registers as the owner of its job's objects: the
+            directory keeps ONLY this membership row (job -> owner
+            endpoint, placed on a consistent-hash shard) — the objects
+            themselves never touch the head again. Replicated: after a
+            failover the new leader must still route borrowers to owners,
+            or every in-flight ref would re-drive. Idempotent (drivers
+            re-register on every reconnect)."""
+            job = msg["job_id"]
+            shard = self._owner_ring.lookup(job)
+            self.owners[job] = {
+                "address": list(msg["address"]),
+                "worker_uid": msg.get("worker") or "",
+                "node_id": msg.get("node_id") or "",
+                "alive": True, "shard": shard, "ts": time.monotonic()}
+            self.record_event("owner_registered", job=job.hex(),
+                              shard=shard)
+            return {"ok": True, "shard": shard,
+                    "shards": self._owner_ring.shards}
+
+        @s.handler("get_owner")
+        async def get_owner(msg, conn):
+            """Directory lookup: the owner endpoint for one job (or None
+            — unregistered, pre-v9, or kill-switched). Read-only; callers
+            cache it per job with a short TTL, so the warm path pays one
+            lookup per (controller, job), not per object."""
+            ent = self.owners.get(msg["job_id"])
+            if ent is None:
+                return {"ok": True, "owner": None}
+            return {"ok": True, "owner": {
+                "address": list(ent["address"]),
+                "worker": ent.get("worker_uid") or "",
+                "shard": ent.get("shard", 0),
+                "alive": self._owner_is_alive(ent)}}
+
+        @s.handler("list_owners")
+        async def list_owners(msg, conn):
+            """Full owner-shard directory (doctor / audit / dashboards)."""
+            rows = [{"job": job.hex(), "address": list(ent["address"]),
+                     "worker": ent.get("worker_uid") or "",
+                     "node_id": ent.get("node_id") or "",
+                     "shard": ent.get("shard", 0),
+                     "alive": self._owner_is_alive(ent)}
+                    for job, ent in self.owners.items()]
+            return {"ok": True, "owners": rows,
+                    "shards": self._owner_ring.shards}
+
         def _locations_snapshot(object_ids, probe_recovery: bool) -> dict:
             out = {}
             for oid in object_ids:
@@ -4023,6 +4268,7 @@ class GcsServer:
                 # sequential per-item releases and the summed release land
                 # on the same availability.
                 self._release(node_id, res_sum)
+            waiters = self._object_waiters
             for item, rec in finishes:
                 ts1 = float(item.get("ts_exec_end") or 0.0)
                 if ts1 > 0.0:
@@ -4032,6 +4278,15 @@ class GcsServer:
                 if "exec_s" in item:
                     rec["exec_s"] = float(item.get("exec_s") or 0.0)
                 self._finish_record(item["task_id"])
+                if self.owners:
+                    # Ownership plane: inline results no longer register
+                    # here, so the FINISH is what wakes parked long-polls
+                    # and dep waiters for the owner-tracked return oids —
+                    # the poller then resolves against the owner (whose
+                    # publish raced ahead on the direct link).
+                    for oid in rec["return_ids"]:
+                        if oid in waiters and oid not in self.objects:
+                            self._wake_object_waiters(oid)
             if early_new:
                 order = self._early_task_done_order
                 early.update(early_new)
@@ -4222,6 +4477,11 @@ class GcsServer:
                 entry["inline"] = blob
                 self._inline_total += len(blob)
                 self._inline_order.append(oid)
+                # Counter the ownership acceptance test pins to ZERO on the
+                # warm path: with owners registered, inline results must
+                # never reach this directory (only legacy peers, the kill
+                # switch, and dead-owner recovery land here).
+                self._stat_add("inline:gcs_registered", 0.0, 1)
                 if evict:
                     _evict_inline()
             entry["locations"].add(node_id)

@@ -558,6 +558,23 @@ class RpcClient:
         with self._wlock:
             self._send_buffers(bufs, 1)
 
+    def probe_wire(self, timeout: float = 5.0) -> int:
+        """Probe the server's advertised wire version (any server exposing
+        a ``wire_probe`` handler) and lift this client's send floor to it.
+        Cached per connection — peers that don't answer stay at the
+        conservative v1 floor, so every frame they get is parseable."""
+        w = getattr(self, "_srv_wire", None)
+        if w is None:
+            try:
+                resp = self.call({"type": "wire_probe"}, timeout=timeout)
+                w = int(resp.get("wire", 1)) if resp.get("ok") else 1
+            except Exception:  # noqa: BLE001 - old peer / flaky link => v1
+                w = 1
+            self._srv_wire = w
+            if w > self.peer_wire:
+                self.peer_wire = w
+        return int(w)
+
     def send_oneway_many(self, msgs: List[Dict[str, Any]]) -> None:
         """Coalesced oneways: N frames, ONE locked scatter-write. FIFO
         order within the list is preserved on the wire, so e.g. a wave's

@@ -65,11 +65,16 @@ MAGIC = 0xBF
 # (shared header segments) plus packed per-task columns (ids, return ids,
 # arg tails) instead of N per-task structs, and the GCS relays each node's
 # whole wave the same way — receivers rebuild byte-identical spec blobs by
-# concatenating the template segments around the varying columns.
+# concatenating the template segments around the varying columns;
+# v9 adds the ownership frames (OWNER_LOCATE / OWNER_FETCH /
+# OWNER_PUBLISH and their responses): object results are tracked by the
+# driver that created them (the owner) instead of the GCS object table —
+# controllers publish completed results owner-to-owner and borrowers
+# locate/fetch from the owner, so the head keeps only membership.
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 8
+WIRE_VERSION = 9
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -146,6 +151,21 @@ LIST_TASKS_RESP3 = 0x1F
 # that the controller explodes locally into byte-identical spec blobs.
 SUBMIT_BATCH_COLS = 0x20
 DISPATCH_WAVE = 0x21
+# Ownership frames (v9). The object plane moves out of the GCS: each
+# driver owns the objects its job tree creates and serves them from an
+# in-process owner table. OWNER_PUBLISH is the controller->owner push of
+# completed inline results (bytes when the owner is remote, size+location
+# only when the completion ring on the same host already carried the
+# bytes); OWNER_FETCH is the borrower's pull (answered with bytes or a
+# node location redirect); OWNER_LOCATE is the lightweight existence /
+# size probe the consistency auditor and doctor use to verify owner-shard
+# invariants without moving payloads.
+OWNER_LOCATE = 0x22
+OWNER_LOCATE_RESP = 0x23
+OWNER_FETCH = 0x24
+OWNER_FETCH_RESP = 0x25
+OWNER_PUBLISH = 0x26
+OWNER_PUBLISH_RESP = 0x27
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -186,6 +206,12 @@ FRAME_MIN_WIRE = {
     LIST_TASKS_RESP3: 7,
     SUBMIT_BATCH_COLS: 8,
     DISPATCH_WAVE: 8,
+    OWNER_LOCATE: 9,
+    OWNER_LOCATE_RESP: 9,
+    OWNER_FETCH: 9,
+    OWNER_FETCH_RESP: 9,
+    OWNER_PUBLISH: 9,
+    OWNER_PUBLISH_RESP: 9,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -237,6 +263,14 @@ def dispatch_wave_enabled() -> bool:
     (``RAY_TPU_DISPATCH_WAVE=0`` materializes per-task spec blobs and
     relays legacy assign_batch frames instead)."""
     return os.environ.get("RAY_TPU_DISPATCH_WAVE", "1") != "0"
+
+
+def ownership_enabled() -> bool:
+    """Kill switch for the ownership object plane
+    (``RAY_TPU_OWNERSHIP=0`` reverts to GCS-tracked results: drivers stop
+    registering as owners, so controllers fall back to the legacy
+    inline-to-GCS registration path per-object)."""
+    return os.environ.get("RAY_TPU_OWNERSHIP", "1") != "0"
 
 
 class WireError(ValueError):
@@ -1408,6 +1442,156 @@ def _dec_dispatch_wave(r: _Reader, rpc_id) -> Dict[str, Any]:
             "singles": singles, "rpc_id": rpc_id}
 
 
+def _enc_owner_locate(msg, peer_wire: int = WIRE_VERSION
+                      ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x22: pickle carries it
+    oids = msg["object_ids"]
+    out = [_head(OWNER_LOCATE, msg.get("rpc_id")), _U32.pack(len(oids))]
+    for oid in oids:
+        out.append(_b8(oid))
+    return out
+
+
+def _dec_owner_locate(r: _Reader, rpc_id) -> Dict[str, Any]:
+    oids = _read_id_list(r, r.count(r.u32()))
+    r.done()
+    return {"type": "owner_locate", "object_ids": oids, "rpc_id": rpc_id}
+
+
+def _enc_owner_locate_resp(msg, peer_wire: int = WIRE_VERSION
+                           ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x23: pickle carries it
+    objects = msg.get("objects", {})
+    out = [_head(OWNER_LOCATE_RESP, msg.get("rpc_id")),
+           _U32.pack(len(objects))]
+    for oid, info in objects.items():
+        out.append(_b8(oid))
+        out.append(_U64.pack(int(info.get("size", 0))))
+        out.append(_U8.pack(1 if info.get("inline") else 0))
+    return out
+
+
+def _dec_owner_locate_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    objects = {}
+    for _ in range(n):
+        oid = r.b8()
+        objects[oid] = {"size": r.u64(), "inline": bool(r.u8())}
+    r.done()
+    return {"ok": True, "objects": objects, "rpc_id": rpc_id}
+
+
+def _enc_owner_fetch(msg, peer_wire: int = WIRE_VERSION
+                     ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x24: pickle carries it
+    oids = msg["object_ids"]
+    out = [_head(OWNER_FETCH, msg.get("rpc_id")), _U32.pack(len(oids))]
+    for oid in oids:
+        out.append(_b8(oid))
+    return out
+
+
+def _dec_owner_fetch(r: _Reader, rpc_id) -> Dict[str, Any]:
+    oids = _read_id_list(r, r.count(r.u32()))
+    r.done()
+    return {"type": "owner_fetch", "object_ids": oids, "rpc_id": rpc_id}
+
+
+def _enc_owner_fetch_resp(msg, peer_wire: int = WIRE_VERSION
+                          ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x25: pickle carries it
+    blobs = msg.get("blobs", {})
+    locations = msg.get("locations", {})
+    out = [_head(OWNER_FETCH_RESP, msg.get("rpc_id")), _U32.pack(len(blobs))]
+    for oid, blob in blobs.items():
+        out.append(_b8(oid))
+        out.append(_U64.pack(len(blob)))
+        out.append(blob)    # pass-through buffer: no copy on encode
+    out.append(_U32.pack(len(locations)))
+    for oid, addr in locations.items():
+        out.append(_b8(oid))
+        out.append(_s(str(addr[0])))
+        out.append(_U16.pack(int(addr[1])))
+    return out
+
+
+def _dec_owner_fetch_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    n = r.count(r.u32())
+    blobs = {}
+    for _ in range(n):
+        oid = r.b8()
+        blobs[oid] = r.b64()
+    m = r.count(r.u32())
+    locations = {}
+    for _ in range(m):
+        oid = r.b8()
+        locations[oid] = [r.s(), r.u16()]
+    r.done()
+    return {"ok": True, "blobs": blobs, "locations": locations,
+            "rpc_id": rpc_id}
+
+
+def _enc_owner_publish(msg, peer_wire: int = WIRE_VERSION
+                       ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x26: pickle carries it
+    items = msg["items"]
+    addr = msg.get("address")
+    out = [_head(OWNER_PUBLISH, msg.get("rpc_id")),
+           _s(msg.get("node_id") or "")]
+    if addr:
+        out.append(_U8.pack(1))
+        out.append(_s(str(addr[0])))
+        out.append(_U16.pack(int(addr[1])))
+    else:
+        out.append(_U8.pack(0))
+    out.append(_U32.pack(len(items)))
+    for ent in items:
+        out.append(_b8(ent[0]))
+        out.append(_U64.pack(int(ent[1])))
+        blob = ent[2] if len(ent) > 2 else None
+        if blob is None:
+            out.append(_U8.pack(0))
+        else:
+            out.append(_U8.pack(1))
+            out.append(_U32.pack(len(blob)))
+            out.append(blob)    # pass-through buffer: no copy on encode
+    return out
+
+
+def _dec_owner_publish(r: _Reader, rpc_id) -> Dict[str, Any]:
+    node_id = r.s()
+    addr = [r.s(), r.u16()] if r.u8() else None
+    n = r.count(r.u32())
+    items = []
+    for _ in range(n):
+        oid = r.b8()
+        size = r.u64()
+        blob = r.b32() if r.u8() else None
+        items.append([oid, size, blob])
+    r.done()
+    return {"type": "owner_publish", "node_id": node_id, "address": addr,
+            "items": items, "rpc_id": rpc_id}
+
+
+def _enc_owner_publish_resp(msg, peer_wire: int = WIRE_VERSION
+                            ) -> Optional[List[bytes]]:
+    if peer_wire < 9:
+        return None  # pre-v9 peer can't parse 0x27: pickle carries it
+    return [_head(OWNER_PUBLISH_RESP, msg.get("rpc_id")),
+            _U32.pack(int(msg.get("count", 0)))]
+
+
+def _dec_owner_publish_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    count = r.u32()
+    r.done()
+    return {"ok": True, "count": count, "rpc_id": rpc_id}
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -1429,6 +1613,9 @@ _ENCODERS = {
     "cancel_task": _enc_cancel_task,
     "submit_batch_cols": _enc_submit_batch_cols,
     "dispatch_wave": _enc_dispatch_wave,
+    "owner_locate": _enc_owner_locate,
+    "owner_fetch": _enc_owner_fetch,
+    "owner_publish": _enc_owner_publish,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -1443,6 +1630,9 @@ _RESP_ENCODERS = {
     "repl_tail": _enc_repl_tail_resp,
     "ha_status": _enc_ha_status_resp,
     "submit_batch_cols": _enc_submit_batch_resp,
+    "owner_locate": _enc_owner_locate_resp,
+    "owner_fetch": _enc_owner_fetch_resp,
+    "owner_publish": _enc_owner_publish_resp,
 }
 
 _DECODERS = {
@@ -1479,6 +1669,12 @@ _DECODERS = {
     CANCEL_TASK: _dec_cancel_task,
     SUBMIT_BATCH_COLS: _dec_submit_batch_cols,
     DISPATCH_WAVE: _dec_dispatch_wave,
+    OWNER_LOCATE: _dec_owner_locate,
+    OWNER_LOCATE_RESP: _dec_owner_locate_resp,
+    OWNER_FETCH: _dec_owner_fetch,
+    OWNER_FETCH_RESP: _dec_owner_fetch_resp,
+    OWNER_PUBLISH: _dec_owner_publish,
+    OWNER_PUBLISH_RESP: _dec_owner_publish_resp,
 }
 
 

@@ -640,6 +640,15 @@ def cmd_doctor(args) -> None:
             "node_stats": gcs.call({"type": "get_node_stats"})["stats"],
             "resources": gcs.call({"type": "cluster_resources"})})
         dump("handlers.json", gcs.call({"type": "debug_stats"}))
+        # Owner-shard directory: which driver owns each job's objects,
+        # its liveness, and the shard layout — the audit's
+        # dual_tracked_object / dead_owner_orphan findings read against
+        # this table.
+        try:
+            owners = gcs.call({"type": "list_owners"})
+        except Exception:  # noqa: BLE001 - pre-ownership head
+            owners = {"owners": [], "shards": 0}
+        dump("owners.json", owners)
         comps = gcs.call({"type": "get_profile_stacks"})["components"]
         for comp, info in comps.items():
             path = os.path.join(bundle, "profiles", f"{comp}.folded")
@@ -650,6 +659,11 @@ def cmd_doctor(args) -> None:
         checked = (f"{summary.get('objects_checked', 0)} objects, "
                    f"{summary.get('tasks_checked', 0)} tasks, "
                    f"{summary.get('nodes_checked', 0)} node inventories")
+        own_rows = owners.get("owners") or []
+        if own_rows:
+            live = sum(1 for o in own_rows if o.get("alive"))
+            print(f"owner directory: {len(own_rows)} owner(s), "
+                  f"{live} alive, {owners.get('shards', 0)} shards")
         if not findings:
             print(f"doctor: all consistency checks passed ({checked})")
             print(f"postmortem bundle: {bundle}")

@@ -485,11 +485,24 @@ class _SimController:
     completes every dispatched task instantly (one coalesced write per
     assign wave: location registrations + the task_done batch)."""
 
-    def __init__(self, port: int, idx: int, cpus: float):
+    def __init__(self, port: int, idx: int, cpus: float,
+                 owner_addr=None):
         from ray_tpu.cluster import wire
         from ray_tpu.cluster.protocol import RpcClient
 
         self.node_id = f"sim{idx:04d}" + os.urandom(8).hex()
+        # Ownership arm: results publish owner-to-owner (the driver's
+        # owner-serve loop), never touching the GCS object table.
+        self.own_cli = None
+        if owner_addr is not None:
+            self.own_cli = RpcClient(owner_addr[0], owner_addr[1])
+            try:
+                resp = self.own_cli.call({"type": "wire_probe"})
+                if resp.get("ok"):
+                    self.own_cli.peer_wire = max(
+                        self.own_cli.peer_wire, int(resp.get("wire") or 1))
+            except (ConnectionError, OSError):
+                pass
         self.cli = RpcClient("127.0.0.1", port, push_handler=self._on_push)
         self.cli.call({
             "type": "register_node", "node_id": self.node_id,
@@ -512,10 +525,24 @@ class _SimController:
         else:
             return
         out = []
-        for t in tasks:
-            for oid in t.get("return_ids", []):
-                out.append({"type": "add_object_location", "object_id": oid,
-                            "node_id": self.node_id, "size": 0})
+        # Ownership arm: results are owner-tracked and publish
+        # owner-to-owner, off the GCS bus — the per-return directory
+        # write disappears from the head's frame load entirely.
+        if self.own_cli is not None:
+            items = [[oid, 0, None] for t in tasks
+                     for oid in t.get("return_ids", [])]
+            try:
+                self.own_cli.send_oneway({
+                    "type": "owner_publish", "node_id": self.node_id,
+                    "address": ["127.0.0.1", 0], "items": items})
+            except (ConnectionError, OSError):
+                pass
+        else:
+            for t in tasks:
+                for oid in t.get("return_ids", []):
+                    out.append({"type": "add_object_location",
+                                "object_id": oid,
+                                "node_id": self.node_id, "size": 0})
         out.append({"type": "task_done_batch", "node_id": self.node_id,
                     "items": [{"task_id": t.get("task_id"),
                                "resources": t.get("resources", {}),
@@ -535,20 +562,32 @@ class _SimController:
 
     def close(self):
         self.cli.close()
+        if self.own_cli is not None:
+            self.own_cli.close()
 
 
 def sim_scaling_row(num_nodes: int, num_tasks: int,
-                    columnar: str = "auto") -> dict:
+                    columnar: str = "auto",
+                    ownership: str = "auto") -> dict:
     """One E2E control-plane run against ``num_nodes`` simulated
     controllers: submit -> place -> relay -> complete -> directory.
     ``columnar`` pins the hot-path arm for the whole row (the in-process
-    GCS reads the wave knob from this process's env)."""
-    with _apply_env(_columnar_env(columnar)):
-        return _sim_scaling_row_inner(num_nodes, num_tasks, columnar)
+    GCS reads the wave knob from this process's env); ``ownership`` pins
+    the object-plane arm: on the "on" arm the driver runs a real
+    owner-serve loop, controllers publish completions owner-to-owner
+    instead of writing per-return ``add_object_location`` frames at the
+    head, and completion is observed from the driver's own owner table —
+    the exact traffic shape of the ownership plane."""
+    env = _columnar_env(columnar)
+    if ownership != "auto":
+        env["RAY_TPU_OWNERSHIP"] = "1" if ownership == "on" else "0"
+    with _apply_env(env):
+        return _sim_scaling_row_inner(num_nodes, num_tasks, columnar,
+                                      ownership)
 
 
 def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
-                           columnar: str) -> dict:
+                           columnar: str, ownership: str = "auto") -> dict:
     import threading
 
     from ray_tpu.cluster import wire
@@ -557,9 +596,20 @@ def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
     sim = _SimGcs()
     nodes = []
     stop_hb = threading.Event()
+    own_table = own_server = None
     try:
+        own = ownership == "on"
+        owner_addr = None
+        if own:
+            from ray_tpu.cluster import ownership as own_mod
+
+            own_table = own_mod.OwnerTable()
+            own_server = own_mod.OwnerServer(own_table, host="127.0.0.1")
+            own_server.start()
+            owner_addr = ("127.0.0.1", own_server.port)
         cpus = max(4.0, 2.0 * num_tasks / num_nodes)
-        nodes = [_SimController(sim.port, i, cpus) for i in range(num_nodes)]
+        nodes = [_SimController(sim.port, i, cpus, owner_addr=owner_addr)
+                 for i in range(num_nodes)]
 
         def hb_loop():
             while not stop_hb.wait(0.4):
@@ -607,22 +657,37 @@ def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
                     t["_spec"] = wire.encode_task_spec(t)
                 msg = {"type": "submit_batch", "tasks": chunk}
             driver.call(msg)
-        pending = set(oids)
         deadline = time.monotonic() + 120.0
-        while pending and time.monotonic() < deadline:
-            ask = list(pending)[:4096]
-            resp = driver.call({"type": "locations_batch",
-                                "object_ids": ask, "wait_s": 1.0,
-                                "probe": False}, timeout=35.0)
-            for oid in resp.get("objects", {}):
-                pending.discard(oid)
+        if own:
+            # Ownership arm: completion is observed where a real driver
+            # observes it — its own owner table, filled by the
+            # controllers' owner_publish frames that never touch the GCS.
+            completed = 0
+            while completed < num_tasks and time.monotonic() < deadline:
+                completed = own_table.stats()["inserted"]
+                if completed < num_tasks:
+                    own_table.arrived.wait(0.05)
+                    own_table.arrived.clear()
+        else:
+            pending = set(oids)
+            while pending and time.monotonic() < deadline:
+                ask = list(pending)[:4096]
+                resp = driver.call({"type": "locations_batch",
+                                    "object_ids": ask, "wait_s": 1.0,
+                                    "probe": False}, timeout=35.0)
+                for oid in resp.get("objects", {}):
+                    pending.discard(oid)
+            completed = num_tasks - len(pending)
         dt = time.perf_counter() - t0
         handlers = driver.call({"type": "debug_stats"})["handlers"]
         row = {
             "nodes": num_nodes, "tasks": num_tasks,
-            "completed": num_tasks - len(pending),
-            "tasks_per_sec": round((num_tasks - len(pending)) / dt, 1),
+            "completed": completed,
+            "tasks_per_sec": round(completed / dt, 1),
             "columnar": columnar,
+            "ownership": ownership,
+            "loc_writes": handlers.get(
+                "add_object_location", {}).get("count", 0),
             "relay_opaque": handlers.get("relay:opaque", {}).get("count", 0),
             "relay_pickled": handlers.get(
                 "relay:pickled", {}).get("count", 0),
@@ -630,6 +695,8 @@ def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
             "submit_cols": handlers.get(
                 "submit_batch_cols", {}).get("count", 0),
         }
+        if own_server is not None:
+            row["owner_publishes"] = own_server.stats["publishes"]
         driver.close()
         return row
     finally:
@@ -637,6 +704,8 @@ def _sim_scaling_row_inner(num_nodes: int, num_tasks: int,
         for n in nodes:
             n.close()
         sim.stop()
+        if own_server is not None:
+            own_server.stop()
 
 
 # The phases the columnar path targets; the A/B report tracks their
@@ -648,6 +717,17 @@ _COLUMNAR_PHASES = ("submit_rpc", "dispatch_relay", "result_register")
 _AB_KNOBS = {
     "columnar": _COLUMNAR_KNOBS,
     "loopmon": ("RAY_TPU_LOOPMON",),
+    "ownership": ("RAY_TPU_OWNERSHIP",),
+}
+
+# Which per-task phases each knob is expected to move; the A/B report
+# tracks their combined cost next to the throughput ratio. ownership
+# targets the result plane: driver-side result pulls (driver_fetch) and
+# the per-completion store/registration cost (result_register).
+_AB_PHASES = {
+    "columnar": _COLUMNAR_PHASES,
+    "loopmon": _COLUMNAR_PHASES,
+    "ownership": ("driver_fetch", "result_register"),
 }
 
 
@@ -679,9 +759,11 @@ def ab_main(args) -> None:
                   f"phases={r['phases_ms_per_1k']}", file=sys.stderr)
         pairs.append(res)
 
+    cost_phases = _AB_PHASES[args.ab_knob]
+
     def phase_cost(run):
         ph = run["phases_ms_per_1k"]
-        return sum(ph.get(p) or 0.0 for p in _COLUMNAR_PHASES)
+        return sum(ph.get(p) or 0.0 for p in cost_phases)
 
     def pair_verdict(p):
         vs = {env_verdict(p[a].get("env")) for a in ("on", "off")}
@@ -713,7 +795,8 @@ def ab_main(args) -> None:
         "warm_ratio_median_quiet":
             round(statistics.median(quiet_ratios), 4) if quiet_ratios
             else None,
-        "columnar_phase_cost_ratio_median":
+        "phase_cost_phases": list(cost_phases),
+        "phase_cost_ratio_median":
             round(statistics.median(cost_ratios), 4) if cost_ratios
             else None,
         "pairs": [
@@ -726,12 +809,21 @@ def ab_main(args) -> None:
              "env_verdict": v}
             for p, v in zip(pairs, verdicts)],
     }
+    if args.ab_knob == "columnar":
+        # Legacy key name kept so older bench rows stay grep-compatible.
+        out["columnar_phase_cost_ratio_median"] = \
+            out["phase_cost_ratio_median"]
     if args.sim_nodes:
         rows = []
         for n in (int(x) for x in args.sim_nodes.split(",") if x):
             pair = {}
             for arm in ("on", "off"):
-                pair[arm] = sim_scaling_row(n, args.sim_tasks, columnar=arm)
+                if args.ab_knob == "ownership":
+                    pair[arm] = sim_scaling_row(n, args.sim_tasks,
+                                                ownership=arm)
+                else:
+                    pair[arm] = sim_scaling_row(n, args.sim_tasks,
+                                                columnar=arm)
                 print(f"# sim {n} nodes [{arm}]: {pair[arm]}",
                       file=sys.stderr)
             off_tps = pair["off"]["tasks_per_sec"] or 1.0
@@ -777,8 +869,10 @@ def main():
                          "--sim-nodes rows are also run once per arm.")
     ap.add_argument("--ab-knob", choices=tuple(_AB_KNOBS), default="columnar",
                     help="which feature the A/B arms flip: the columnar "
-                         "hot path, or the loopmon observatory (its "
-                         "overhead budget check)")
+                         "hot path, the loopmon observatory (its "
+                         "overhead budget check), or the ownership "
+                         "object plane (owner-tracked results vs GCS "
+                         "object-table registration)")
     ap.add_argument("--ledger", action="store_true",
                     help="run ONE warm fan-out and print the wall-clock "
                          "conservation ledger (phases + observatory gap "

@@ -76,6 +76,17 @@ def test_failover_mid_batch_zero_lost_zero_dup(ha_env):
         out = ray_tpu.get(refs, timeout=240)
         assert out == [i + 1 for i in range(n)]
 
+        # Ownership handoff rode the epoch-fenced log: the promoted
+        # leader's owner directory still knows this driver (register_owner
+        # is replicated, and the reconnect hook re-registers besides).
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core
+        if core._owner_table is not None:
+            owners = core.gcs.call({"type": "list_owners"})["owners"]
+            assert any(bytes.fromhex(o["job"]) == core.job_id.binary()
+                       and o["alive"] for o in owners), owners
+
         # the promoted leader's books balance: cli doctor exits 0
         time.sleep(3.0)  # let inventories re-publish to the new leader
         env = dict(os.environ)

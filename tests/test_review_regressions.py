@@ -175,8 +175,15 @@ def test_locations_batch_long_poll_parks_and_wakes():
                               "object_ids": [ref2.id.binary()],
                               "wait_s": 10.0}, timeout=30.0)
         took = time.monotonic() - t0
-        assert resp["objects"], resp
         assert took < 8.0, f"woke by event, not timeout ({took:.1f}s)"
+        if core._owner_table is None:
+            # Legacy arm: the result registers at the GCS, so the wake
+            # response carries it.
+            assert resp["objects"], resp
+        # Ownership arm: the finish still wakes the parked poll (that is
+        # the contract the park exists for), but the bytes live at the
+        # owner — the woken poller resolves against its owner table, which
+        # is exactly what get() does.
         assert ray_tpu.get(ref2) == 42
     finally:
         ray_tpu.shutdown()

@@ -933,6 +933,126 @@ class TestColumnarFrames:
                     pytest.fail(f"non-WireError escaped decode: {e!r}")
 
 
+class TestOwnershipFrames:
+    """v9 ownership frames: OWNER_PUBLISH is the controller->owner push
+    of finished results (pointer-only same-host, blob-bearing cross-host),
+    OWNER_FETCH the borrower's pull (bytes or a node redirect), and
+    OWNER_LOCATE the lightweight existence probe. Pre-v9 peers must get
+    pickle for all six."""
+
+    def test_owner_locate_round_trip(self):
+        msg = {"type": "owner_locate",
+               "object_ids": [b"A" * 24, b"B" * 24], "rpc_id": 3}
+        out = _rt(msg)
+        assert out["type"] == "owner_locate"
+        assert out["object_ids"] == [b"A" * 24, b"B" * 24]
+        resp = {"ok": True, "objects": {
+            b"A" * 24: {"size": 64, "inline": True},
+            b"B" * 24: {"size": 0, "inline": False}}, "rpc_id": 3}
+        out = _rt(resp, req_type="owner_locate")
+        assert out["objects"][b"A" * 24] == {"size": 64, "inline": True}
+        assert out["objects"][b"B" * 24] == {"size": 0, "inline": False}
+
+    def test_owner_fetch_round_trip(self):
+        msg = {"type": "owner_fetch", "object_ids": [b"C" * 24],
+               "rpc_id": 5}
+        out = _rt(msg)
+        assert out["type"] == "owner_fetch"
+        assert out["object_ids"] == [b"C" * 24]
+        resp = {"ok": True,
+                "blobs": {b"C" * 24: b"payload-bytes"},
+                "locations": {b"D" * 24: ["10.0.0.7", 7102]}, "rpc_id": 5}
+        out = _rt(resp, req_type="owner_fetch")
+        assert out["blobs"] == {b"C" * 24: b"payload-bytes"}
+        assert out["locations"] == {b"D" * 24: ["10.0.0.7", 7102]}
+
+    def test_owner_publish_round_trip(self):
+        # Mixed items: a blob-bearing cross-host publish and a
+        # pointer-only same-host one on the same frame.
+        msg = {"type": "owner_publish", "node_id": "node-1",
+               "address": ["10.0.0.9", 7201],
+               "items": [[b"E" * 24, 11, b"inline-blob"],
+                         [b"F" * 24, 7, None]], "rpc_id": 8}
+        body = b"".join(wire.encode(msg))
+        assert body[1] == wire.OWNER_PUBLISH
+        out = wire.decode(body)
+        assert out["node_id"] == "node-1"
+        assert out["address"] == ["10.0.0.9", 7201]
+        assert out["items"] == [[b"E" * 24, 11, b"inline-blob"],
+                                [b"F" * 24, 7, None]]
+        # Address-less publish (owner republish path).
+        noaddr = dict(msg, address=None)
+        out = wire.decode(b"".join(wire.encode(noaddr)))
+        assert out["address"] is None
+        resp = {"ok": True, "count": 2, "rpc_id": 8}
+        out = _rt(resp, req_type="owner_publish")
+        assert out["count"] == 2 and out["ok"] is True
+
+    def test_pre_v9_peer_gets_pickle_fallback(self):
+        reqs = [
+            {"type": "owner_locate", "object_ids": [b"A" * 24]},
+            {"type": "owner_fetch", "object_ids": [b"A" * 24]},
+            {"type": "owner_publish", "node_id": "n", "address": None,
+             "items": [[b"A" * 24, 1, b"x"]]},
+        ]
+        for msg in reqs:
+            assert wire.encode(msg, peer_wire=8) is None
+            assert wire.encode(msg, peer_wire=9) is not None
+        resps = [
+            ("owner_locate", {"ok": True, "objects": {}}),
+            ("owner_fetch", {"ok": True, "blobs": {}, "locations": {}}),
+            ("owner_publish", {"ok": True, "count": 0}),
+        ]
+        for req_type, msg in resps:
+            assert wire.encode_response(req_type, msg, peer_wire=8) is None
+            assert wire.encode_response(req_type, msg,
+                                        peer_wire=9) is not None
+
+    def test_truncated_ownership_frames_raise(self):
+        msgs = [
+            ({"type": "owner_locate", "object_ids": [b"A" * 24],
+              "rpc_id": 1}, None),
+            ({"ok": True, "objects": {b"A" * 24: {"size": 5,
+                                                  "inline": True}},
+              "rpc_id": 1}, "owner_locate"),
+            ({"type": "owner_fetch", "object_ids": [b"A" * 24],
+              "rpc_id": 2}, None),
+            ({"ok": True, "blobs": {b"A" * 24: b"bytes"},
+              "locations": {b"B" * 24: ["h", 9]}, "rpc_id": 2},
+             "owner_fetch"),
+            ({"type": "owner_publish", "node_id": "n",
+              "address": ["h", 1],
+              "items": [[b"A" * 24, 5, b"blob0"]], "rpc_id": 3}, None),
+            ({"ok": True, "count": 1, "rpc_id": 3}, "owner_publish"),
+        ]
+        for msg, req_type in msgs:
+            if req_type:
+                body = b"".join(wire.encode_response(req_type, msg))
+            else:
+                body = b"".join(wire.encode(msg))
+            for cut in range(0, len(body), max(1, len(body) // 17)):
+                with pytest.raises(wire.WireError):
+                    wire.decode(body[:cut])
+            with pytest.raises(wire.WireError):
+                wire.decode(body + b"\x00")
+
+    def test_garbage_ownership_bodies_raise(self):
+        rng = random.Random(47)
+        for code in (wire.OWNER_LOCATE, wire.OWNER_LOCATE_RESP,
+                     wire.OWNER_FETCH, wire.OWNER_FETCH_RESP,
+                     wire.OWNER_PUBLISH, wire.OWNER_PUBLISH_RESP):
+            for _ in range(50):
+                body = (struct.pack("<BBQ", wire.MAGIC, code, 0)
+                        + bytes(rng.getrandbits(8)
+                                for _ in range(rng.randint(0, 64))))
+                try:
+                    wire.decode(body)
+                except wire.WireError:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    pytest.fail(f"non-WireError escaped decode: {e!r}")
+
+
 def _coverage_spec_blob():
     return wire.encode_task_spec({
         "task_id": b"T" * 16, "fn_id": b"F" * 16, "name": "f",
@@ -1052,6 +1172,22 @@ _FRAME_CASES = {
     wire.DISPATCH_WAVE: ("req", lambda: {
         "type": "dispatch_wave", "runs": [_coverage_run()],
         "singles": [_coverage_spec_blob()]}),
+    wire.OWNER_LOCATE: ("req", lambda: {
+        "type": "owner_locate", "object_ids": [b"R" * 24], "rpc_id": 7}),
+    wire.OWNER_LOCATE_RESP: (("resp", "owner_locate"), lambda: {
+        "ok": True, "objects": {b"R" * 24: {"size": 5, "inline": True}},
+        "rpc_id": 7}),
+    wire.OWNER_FETCH: ("req", lambda: {
+        "type": "owner_fetch", "object_ids": [b"R" * 24], "rpc_id": 8}),
+    wire.OWNER_FETCH_RESP: (("resp", "owner_fetch"), lambda: {
+        "ok": True, "blobs": {b"R" * 24: b"bytes"},
+        "locations": {b"S" * 24: ["h", 2]}, "rpc_id": 8}),
+    wire.OWNER_PUBLISH: ("req", lambda: {
+        "type": "owner_publish", "node_id": "n", "address": ["h", 1],
+        "items": [[b"R" * 24, 5, b"bytes"], [b"S" * 24, 7, None]],
+        "rpc_id": 9}),
+    wire.OWNER_PUBLISH_RESP: (("resp", "owner_publish"), lambda: {
+        "ok": True, "count": 2, "rpc_id": 9}),
     wire.HA_STATUS: ("req", lambda: {"type": "ha_status", "rpc_id": 3}),
     wire.HA_STATUS_RESP: (("resp", "ha_status"), lambda: {
         "ok": True, "epoch": 4, "is_leader": True, "role": "leader",
