@@ -11,13 +11,24 @@
 // Wire protocol (all little-endian):
 //   GET : c->s [op=1:1][id:24]            s->c [status:1][size:8][payload]
 //   PUT : c->s [op=2:1][id:24][size:8][payload]   s->c [status:1]
+//   GETR: c->s [op=3:1][id:24][offset:8][length:8]
+//         s->c [status:1][total:8][n:8][payload n bytes]
 // A connection handles sequential requests until EOF.
+//
+// GETR is the chunked data plane (reference: object_buffer_pool chunked
+// Push): n = min(length, total - offset), so a receiver pulls an object as
+// a pipeline of fixed-size ranges, writing each into its (unsealed) arena
+// slot as it lands. length=0 is a pure size probe. Because every response
+// carries the authoritative total, a pull broken by sender death resumes
+// at the next un-landed offset against ANY other holder — the per-chunk
+// offset IS the resume cursor.
 
 #include "shm_store.cc"  // same TU: Handle layout + tps_* internals
 
 #include <atomic>
 #include <cstdio>
 #include <cstdlib>
+#include <vector>
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -28,6 +39,7 @@ namespace {
 
 constexpr uint8_t kOpGet = 1;
 constexpr uint8_t kOpPut = 2;
+constexpr uint8_t kOpGetRange = 3;
 constexpr int kChunk = 1 << 20;  // 1MB send granularity (ref ray_config_def.h:242)
 
 bool send_all(int fd, const uint8_t* buf, uint64_t n) {
@@ -62,6 +74,19 @@ struct ServerCtx {
   int port;
   pthread_t thread;
   std::atomic<bool> stop{false};
+  // Data-plane accounting: bytes served out of the arena and request
+  // count, read by the Python side for transfer_bytes_out. Relaxed is
+  // fine — these are monotonic gauges, not synchronization.
+  std::atomic<uint64_t> bytes_out{0};
+  std::atomic<uint64_t> requests{0};
+  // Live-connection registry: conn threads are detached, so stop must
+  // shut their sockets down and wait for the last one to leave before
+  // the ctx can be freed (a detached thread touching a deleted ctx is a
+  // use-after-free — caught by the TSAN stress harness).
+  pthread_mutex_t conn_mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_cond_t conn_cv = PTHREAD_COND_INITIALIZER;
+  std::vector<int> conn_fds;
+  int live_conns = 0;
 };
 
 struct ConnArgs {
@@ -83,9 +108,45 @@ void handle_get(ServerCtx* ctx, int fd, const uint8_t* id) {
   }
   if (rc == kOk) {
     auto* h = static_cast<Handle*>(ctx->store);
-    send_all(fd, h->base + off, size);  // zero-copy out of the arena
+    if (send_all(fd, h->base + off, size)) {  // zero-copy out of the arena
+      ctx->bytes_out.fetch_add(size, std::memory_order_relaxed);
+    }
     tps_release(ctx->store, id);
   }
+}
+
+// One range of a sealed object: [status:1][total:8][n:8][payload].
+// status 0 = ok, 1 = miss, 2 = offset past end. length 0 probes the size.
+void handle_get_range(ServerCtx* ctx, int fd, const uint8_t* id) {
+  uint8_t operands[16];
+  if (!recv_all(fd, operands, sizeof(operands))) return;
+  uint64_t offset, length;
+  std::memcpy(&offset, operands, 8);
+  std::memcpy(&length, operands + 8, 8);
+  uint64_t off = 0, size = 0;
+  int rc = tps_get(ctx->store, id, &off, &size);
+  uint8_t status = rc == kOk ? 0 : 1;
+  uint64_t total = rc == kOk ? size : 0;
+  uint64_t n = 0;
+  if (rc == kOk) {
+    if (offset > total) {
+      status = 2;
+    } else {
+      uint64_t avail = total - offset;
+      n = length < avail ? length : avail;
+    }
+  }
+  uint8_t head[17];
+  head[0] = status;
+  std::memcpy(head + 1, &total, 8);
+  std::memcpy(head + 9, &n, 8);
+  if (send_all(fd, head, sizeof(head)) && n > 0) {
+    auto* h = static_cast<Handle*>(ctx->store);
+    if (send_all(fd, h->base + off + offset, n)) {
+      ctx->bytes_out.fetch_add(n, std::memory_order_relaxed);
+    }
+  }
+  if (rc == kOk) tps_release(ctx->store, id);
 }
 
 
@@ -137,16 +198,33 @@ void* conn_loop(void* arg) {
   uint8_t req[1 + kIdLen];
   while (!ca->ctx->stop.load(std::memory_order_relaxed)) {
     if (!recv_all(ca->fd, req, sizeof(req))) break;
+    ca->ctx->requests.fetch_add(1, std::memory_order_relaxed);
     if (req[0] == kOpGet) {
       handle_get(ca->ctx, ca->fd, req + 1);
     } else if (req[0] == kOpPut) {
       handle_put(ca->ctx, ca->fd, req + 1);
+    } else if (req[0] == kOpGetRange) {
+      handle_get_range(ca->ctx, ca->fd, req + 1);
     } else {
       break;
     }
   }
   close(ca->fd);
+  // deregister LAST: after the count drops, stop may free the ctx
+  ServerCtx* ctx = ca->ctx;
+  int fd = ca->fd;
   delete ca;
+  pthread_mutex_lock(&ctx->conn_mu);
+  for (size_t i = 0; i < ctx->conn_fds.size(); ++i) {
+    if (ctx->conn_fds[i] == fd) {
+      ctx->conn_fds[i] = ctx->conn_fds.back();
+      ctx->conn_fds.pop_back();
+      break;
+    }
+  }
+  ctx->live_conns--;
+  pthread_cond_broadcast(&ctx->conn_cv);
+  pthread_mutex_unlock(&ctx->conn_mu);
   return nullptr;
 }
 
@@ -159,12 +237,27 @@ void* accept_loop(void* arg) {
       break;  // listen socket closed by tts_serve_stop
     }
     auto* ca = new ConnArgs{ctx, fd};
+    pthread_mutex_lock(&ctx->conn_mu);
+    ctx->conn_fds.push_back(fd);
+    ctx->live_conns++;
+    pthread_mutex_unlock(&ctx->conn_mu);
     pthread_t t;
     if (pthread_create(&t, nullptr, conn_loop, ca) == 0) {
       pthread_detach(t);
     } else {
       close(fd);
       delete ca;
+      pthread_mutex_lock(&ctx->conn_mu);
+      for (size_t i = 0; i < ctx->conn_fds.size(); ++i) {
+        if (ctx->conn_fds[i] == fd) {
+          ctx->conn_fds[i] = ctx->conn_fds.back();
+          ctx->conn_fds.pop_back();
+          break;
+        }
+      }
+      ctx->live_conns--;
+      pthread_cond_broadcast(&ctx->conn_cv);
+      pthread_mutex_unlock(&ctx->conn_mu);
     }
   }
   return nullptr;
@@ -225,6 +318,19 @@ int tts_serve_port(void* sctx) {
   return sctx ? static_cast<ServerCtx*>(sctx)->port : -1;
 }
 
+// Cumulative bytes served / requests handled by this server (the
+// transfer_bytes_out source of truth; payload bytes only, no headers).
+void tts_serve_stats(void* sctx, uint64_t* bytes_out, uint64_t* requests) {
+  auto* ctx = static_cast<ServerCtx*>(sctx);
+  if (ctx == nullptr) {
+    if (bytes_out) *bytes_out = 0;
+    if (requests) *requests = 0;
+    return;
+  }
+  if (bytes_out) *bytes_out = ctx->bytes_out.load(std::memory_order_relaxed);
+  if (requests) *requests = ctx->requests.load(std::memory_order_relaxed);
+}
+
 void tts_serve_stop(void* sctx) {
   if (sctx == nullptr) return;
   auto* ctx = static_cast<ServerCtx*>(sctx);
@@ -232,6 +338,15 @@ void tts_serve_stop(void* sctx) {
   shutdown(ctx->listen_fd, SHUT_RDWR);
   close(ctx->listen_fd);
   pthread_join(ctx->thread, nullptr);
+  // Kick every live connection out of its blocking recv/send, then wait
+  // for the detached handlers to deregister — only then is the ctx free
+  // (use-after-free otherwise; see the ServerCtx registry comment).
+  pthread_mutex_lock(&ctx->conn_mu);
+  for (int fd : ctx->conn_fds) shutdown(fd, SHUT_RDWR);
+  while (ctx->live_conns > 0) {
+    pthread_cond_wait(&ctx->conn_cv, &ctx->conn_mu);
+  }
+  pthread_mutex_unlock(&ctx->conn_mu);
   delete ctx;
 }
 
@@ -270,6 +385,36 @@ int tts_fetch_fd(int fd, const uint8_t* id, void* store_handle) {
   }
   tps_seal(store_handle, id);
   return 0;
+}
+
+// Fetches ONE range of object `id` over an existing connection, receiving
+// straight into caller memory `dst` (an unsealed arena slot on the pull
+// path). length=0 probes the size without moving payload. Returns the
+// number of payload bytes landed (>= 0) with *total_out set to the
+// object's full size, or negative: -1 remote miss, -4 protocol error
+// (offset past end / malformed), -5 connection broken mid-stream — the
+// caller's already-landed prefix stays valid, so a retry against another
+// holder resumes at offset + <bytes landed so far>.
+int64_t tts_fetch_range_fd(int fd, const uint8_t* id, uint64_t offset,
+                           uint64_t length, uint8_t* dst,
+                           uint64_t* total_out) {
+  if (total_out) *total_out = 0;
+  uint8_t req[1 + kIdLen + 16];
+  req[0] = kOpGetRange;
+  std::memcpy(req + 1, id, kIdLen);
+  std::memcpy(req + 1 + kIdLen, &offset, 8);
+  std::memcpy(req + 1 + kIdLen + 8, &length, 8);
+  uint8_t head[17];
+  if (!send_all(fd, req, sizeof(req)) || !recv_all(fd, head, sizeof(head)))
+    return -5;
+  uint64_t total, n;
+  std::memcpy(&total, head + 1, 8);
+  std::memcpy(&n, head + 9, 8);
+  if (total_out) *total_out = total;
+  if (head[0] == 1) return -1;
+  if (head[0] != 0 || n > length) return -4;
+  if (n > 0 && !recv_all(fd, dst, n)) return -5;
+  return static_cast<int64_t>(n);
 }
 
 // Fetches object `id` from host:port directly into the local arena.

@@ -45,8 +45,29 @@ def _lib() -> Optional[ctypes.CDLL]:
                                   ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
     lib.tts_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.tts_fetch_range_fd.restype = ctypes.c_int64
+    lib.tts_fetch_range_fd.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.tts_serve_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
     lib._tts_bound = True
     return lib
+
+
+class TransferBrokenError(Exception):
+    """The sender died (or the stream broke) mid-pull. ``offset`` is the
+    number of bytes already landed in the destination buffer — a retry
+    against another holder resumes from exactly there."""
+
+    def __init__(self, offset: int, reason: str = "connection broken"):
+        super().__init__(f"{reason} at offset {offset}")
+        self.offset = offset
+
+
+class RemoteMissError(Exception):
+    """The remote node does not hold (a sealed copy of) the object."""
 
 
 class TransferServer:
@@ -65,6 +86,17 @@ class TransferServer:
             lib.tps_close(self._handle)
             raise RuntimeError("transfer server failed to start")
         self.port = lib.tts_serve_port(self._ctx)
+
+    def stats(self) -> Tuple[int, int]:
+        """(payload bytes served, requests handled) since start — the
+        node's authoritative ``transfer_bytes_out`` source."""
+        if not self._ctx:
+            return (0, 0)
+        bytes_out = ctypes.c_uint64(0)
+        requests = ctypes.c_uint64(0)
+        self._lib.tts_serve_stats(self._ctx, ctypes.byref(bytes_out),
+                                  ctypes.byref(requests))
+        return (bytes_out.value, requests.value)
 
     def stop(self) -> None:
         if self._ctx:
@@ -133,6 +165,70 @@ class TransferClient:
                     continue
                 return rc == 0
         return False
+
+    def probe_size(self, host: str, port: int,
+                   object_id: bytes) -> Optional[int]:
+        """Ask a holder for an object's total size (a zero-length range
+        request — no payload moves). None on miss; TransferBrokenError when
+        the holder is unreachable."""
+        fd = self._lib.tts_connect(host.encode(), port)
+        if fd < 0:
+            raise TransferBrokenError(0, "connect failed")
+        try:
+            total = ctypes.c_uint64(0)
+            n = self._lib.tts_fetch_range_fd(fd, _pad_id(object_id), 0, 0,
+                                             None, ctypes.byref(total))
+            if n == -1:
+                return None
+            if n < 0:
+                raise TransferBrokenError(0)
+            return total.value
+        finally:
+            self._lib.tts_disconnect(fd)
+
+    def fetch_chunks(self, host: str, port: int, object_id: bytes,
+                     view, offset: int = 0,
+                     chunk_size: int = 1 << 20) -> int:
+        """Pull ``view[offset:]`` as a pipeline of fixed-size ranges over a
+        dedicated connection, writing each chunk into ``view`` (the
+        destination's unsealed arena slot) as it lands. Returns the chunk
+        count on completion; raises TransferBrokenError carrying the resume
+        offset when the sender dies mid-stream, RemoteMissError when the
+        holder no longer has the object.
+
+        A dedicated (non-pooled) connection per pull keeps concurrent
+        admitted pulls from the same source streaming in parallel instead
+        of serializing on the shared request/response socket."""
+        total = len(view)
+        oid = _pad_id(object_id)
+        fd = self._lib.tts_connect(host.encode(), port)
+        if fd < 0:
+            raise TransferBrokenError(offset, "connect failed")
+        chunks = 0
+        try:
+            while offset < total:
+                want = min(chunk_size, total - offset)
+                dst = (ctypes.c_ubyte * want).from_buffer(view, offset)
+                remote_total = ctypes.c_uint64(0)
+                n = self._lib.tts_fetch_range_fd(
+                    fd, oid, offset, want, dst, ctypes.byref(remote_total))
+                # Release the buffer export before any raise: a traceback
+                # pins this frame, and a pinned export blocks arena close.
+                del dst
+                if n == -1:
+                    raise RemoteMissError(object_id.hex())
+                if n < 0 or remote_total.value != total:
+                    # Broken stream, or the holder's copy disagrees on size
+                    # (a different object under the same id would corrupt
+                    # the slot — treat as a bad source and resume elsewhere)
+                    raise TransferBrokenError(offset)
+                if n == 0:
+                    raise TransferBrokenError(offset, "empty range response")
+                offset += n
+                chunks += 1
+            return chunks
+        finally:
+            self._lib.tts_disconnect(fd)
 
     def fetch_bytes(self, host: str, port: int,
                     object_id: bytes) -> Optional[bytes]:

@@ -131,6 +131,21 @@ class NodeController:
         except Exception:  # noqa: BLE001 - python-store fallback path
             self.transfer_server = None
             self.transfer_port = 0
+        # Transfer manager: admission (per-source inflight cap + FIFO/
+        # largest-first queue) and chunked resumable pulls over the native
+        # plane. None on the python-store fallback — pulls then ride the
+        # RPC fetch path unscheduled.
+        self.transfer_manager = None
+        if self.transfer_server is not None:
+            try:
+                from .._native.transfer import TransferClient
+                from .transfer_manager import TransferManager
+
+                self._transfer_cli = TransferClient(self.store_name)
+                self.transfer_manager = TransferManager(
+                    self.store, self._transfer_cli, self.transfer_server)
+            except Exception:  # noqa: BLE001
+                self.transfer_manager = None
         # The arena outlives SIGKILL'd processes (/dev/shm persists); make
         # every normal exit path unlink it, even when stop() never runs
         # (e.g. the head's colocated controller thread dying with the
@@ -347,6 +362,13 @@ class NodeController:
         self._owner_clients.clear()
         if self._gcs:
             self._gcs.close()
+        if self.transfer_manager is not None:
+            self.transfer_manager.close()
+        if getattr(self, "_transfer_cli", None) is not None:
+            try:
+                self._transfer_cli.close()
+            except Exception:  # noqa: BLE001
+                pass
         if self.transfer_server is not None:
             self.transfer_server.stop()
         self.store.close()
@@ -496,6 +518,16 @@ class NodeController:
                     # node down, not one worker).
                     self._oom_guard(stats)
                     stats["store"] = self.store.stats()
+                    # Data-plane counters + event drain ride the report
+                    # (same no-connection-of-its-own discipline as the
+                    # flight recorder): the head rolls the deltas into its
+                    # time-series store and Prometheus, and records the
+                    # drained sender-death/pull-failure events.
+                    if self.transfer_manager is not None:
+                        stats["transfer"] = self.transfer_manager.stats()
+                        tev = self.transfer_manager.drain_events()
+                        if tev:
+                            stats["transfer_events"] = tev
                     # Consistency-audit inventory: what this node actually
                     # holds (arena + overflow + spill dir + ring health),
                     # cross-checked against the GCS object directory by
@@ -644,6 +676,11 @@ class NodeController:
             }
             if self._spilling:
                 audit["spilled"] = self.store.spill.ids()
+            if self.transfer_manager is not None:
+                # Inflight/queued pull inventory: the head flags pulls
+                # queued past grace (stuck_transfer) and pulls aimed at
+                # dead sources (orphan_transfer).
+                audit["transfers"] = self.transfer_manager.inventory()
             from .._native import completion_ring as _cring
 
             audit["stale_rings"] = _cring.scan_stale_rings()
@@ -1083,22 +1120,45 @@ class NodeController:
             if blob is not None:
                 return blob
             transfer = resp.get("transfer_addresses", [])
-            for i, addr in enumerate(resp.get("addresses", [])):
-                addr = tuple(addr)
-                if addr == self.address:
-                    continue
-                # Fast path: native data plane straight into our arena
-                # (bytes never enter Python). Fall back to RPC on any miss.
-                taddr = transfer[i] if i < len(transfer) else None
-                if (taddr and taddr[1] and self._transfer_client() is not None):
-                    ok = await asyncio.to_thread(
-                        self._transfer_client().fetch_into_store,
-                        taddr[0], int(taddr[1]), oid)
+            locations = resp.get("locations", [])
+            # Fast path: the transfer manager pulls over the native data
+            # plane — chunked straight into our arena (bytes never enter
+            # Python), admission-capped per source, resuming on sender
+            # death against the next holder. One call covers ALL native
+            # sources; only spilled/python-store holders (port 0) are left
+            # to the RPC restore path below.
+            if self.transfer_manager is not None:
+                sources = []
+                for i, taddr in enumerate(transfer):
+                    if not taddr or not taddr[1]:
+                        continue
+                    if (taddr[0], int(taddr[1])) == \
+                            (self.address[0], self.transfer_port):
+                        continue
+                    nid = locations[i] if i < len(locations) else taddr[0]
+                    sources.append((nid, taddr[0], int(taddr[1])))
+                if sources:
+                    from .transfer_manager import PullFailedError
+                    try:
+                        ok = await self.transfer_manager.pull(
+                            oid, sources, size_hint=int(resp.get("size", 0)),
+                            timeout=max(0.1, deadline - time.monotonic()))
+                    except (PullFailedError, asyncio.TimeoutError):
+                        ok = False
+                    except Exception:  # noqa: BLE001 - RPC path still open
+                        ok = False
                     if ok:
                         blob = self._local_blob(oid)
                         if blob is not None:
                             self._announce_blob(oid)
                             return blob
+            for i, addr in enumerate(resp.get("addresses", [])):
+                addr = tuple(addr)
+                if addr == self.address:
+                    continue
+                taddr = transfer[i] if i < len(transfer) else None
+                if taddr and taddr[1] and self.transfer_manager is not None:
+                    continue  # native source: the manager already tried it
                 try:
                     peer = self._peer(addr)
                     fetched = await asyncio.to_thread(

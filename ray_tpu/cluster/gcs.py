@@ -237,6 +237,10 @@ class GcsServer:
         self._early_task_done_order: Any = _deque()
         self._node_conns: Dict[str, Connection] = {}
         self.node_stats: Dict[str, Dict[str, Any]] = {}  # reporter data
+        # Last-seen cumulative transfer counters per node: the node_stats
+        # handler derives time-series deltas (bytes_in/out etc.) from the
+        # monotonic totals each heartbeat carries.
+        self._transfer_last: Dict[str, Dict[str, float]] = {}
         # ---- consistency auditor (the invariant-checking substrate the
         # head-sharding refactor needs before state leaves this process).
         # _node_audit: node_id -> deque of the last 2 inventory snapshots
@@ -1402,7 +1406,41 @@ class GcsServer:
     _AUDIT_KINDS = ("leaked_object", "stale_location", "phantom_location",
                     "stale_spill", "orphaned_task", "lineage_orphan",
                     "inline_divergence", "stale_ring",
-                    "dual_tracked_object", "dead_owner_orphan")
+                    "dual_tracked_object", "dead_owner_orphan",
+                    "stuck_transfer", "orphan_transfer")
+
+    def _roll_transfer_stats(self, node_id: str,
+                             transfer: Dict[str, Any]) -> None:
+        """Roll one node's heartbeat-carried transfer totals into the
+        time-series store (deltas) and Prometheus (tagged counters and
+        gauges). Totals are monotonic per controller process; a restarted
+        node resets them, so negative deltas are treated as a fresh
+        baseline rather than subtracted."""
+        try:
+            from ..metrics import transfer_metrics
+
+            metrics = transfer_metrics()
+            last = self._transfer_last.setdefault(node_id, {})
+            tags = {"node": node_id[:16]}
+            for name in ("bytes_in", "bytes_out", "chunk_retries",
+                         "sender_deaths", "pulls_ok", "pulls_failed"):
+                cur = float(transfer.get(name) or 0.0)
+                delta = cur - last.get(name, 0.0)
+                last[name] = cur
+                if delta <= 0:
+                    continue
+                self.timeseries.add_delta(f"transfer_{name}", delta)
+                m = metrics.get(name)
+                if m is not None:
+                    m.record(delta, tags=tags)
+            for name in ("inflight", "queue_depth"):
+                last[name] = float(transfer.get(name) or 0.0)
+                metrics[name].record(last[name], tags=tags)
+                total = sum(v.get(name, 0.0)
+                            for v in self._transfer_last.values())
+                self.timeseries.add_gauge(f"transfer_{name}", total)
+        except Exception:  # noqa: BLE001 - stats must never cost a beat
+            pass
 
     def note_node_audit(self, node_id: str, audit: Dict[str, Any]) -> None:
         """One controller inventory snapshot (rode node_stats). The last
@@ -1502,6 +1540,37 @@ class GcsServer:
                 # dead owners leaking tmpfs until the next sweep.
                 flag("stale_ring", node_id=nid,
                      count=int(cur["stale_rings"]))
+            # --- data-plane invariants (TransferManager inventory).
+            # A pull queued past grace while its source is alive means the
+            # admission scheduler stopped draining (stuck); a pull aimed at
+            # a dead source can never complete and should have failed over
+            # (orphan). Grace is generous — a deep queue under a loaded
+            # source is the scheduler WORKING, not stuck.
+            import os as _os
+
+            transfers = cur.get("transfers") or {}
+            t_grace = float(_os.environ.get(
+                "RAY_TPU_TRANSFER_AUDIT_GRACE_S", "15.0"))
+            for ent in transfers.get("queued") or ():
+                src = self.nodes.get(str(ent.get("source") or ""))
+                age = float(ent.get("age_s") or 0.0)
+                if src is not None and src.alive and age > t_grace:
+                    flag("stuck_transfer", node_id=nid,
+                         object_id=str(ent.get("object_id") or ""),
+                         source=str(ent.get("source") or ""),
+                         age_s=age)
+            for where in ("inflight", "queued"):
+                for ent in transfers.get(where) or ():
+                    src_id = str(ent.get("source") or "")
+                    src = self.nodes.get(src_id)
+                    age = float(ent.get("age_s") or 0.0)
+                    # Brief dead-source sightings are the failover WORKING
+                    # (the broken stream resumes elsewhere within the
+                    # snapshot cadence); only a lingering one is orphaned.
+                    if (src is None or not src.alive) and age > 2.0:
+                        flag("orphan_transfer", node_id=nid,
+                             object_id=str(ent.get("object_id") or ""),
+                             source=src_id, where=where, age_s=age)
 
         for nid, oids in suspects.items():
             node = self.nodes.get(nid)
@@ -1622,7 +1691,15 @@ class GcsServer:
         except Exception:  # noqa: BLE001 - metrics never fail the audit
             pass
         self.timeseries.add_gauge("audit_findings", float(len(findings)))
-        return {"findings": findings, "summary": summary}
+        # Latest per-node transfer inventory rides along for `cli
+        # transfers --inventory` (the auditor's raw view of every
+        # inflight/queued pull).
+        transfer_inv = {
+            nid: ring[-1].get("transfers")
+            for nid, ring in self._node_audit.items()
+            if ring and ring[-1].get("transfers")}
+        return {"findings": findings, "summary": summary,
+                "transfer_inventories": transfer_inv}
 
     def _probe_node_holds(self, addr, oids) -> Dict[bytes, bool]:
         """Thread-side: ask one controller which of ``oids`` it actually
@@ -2759,6 +2836,7 @@ class GcsServer:
                 for _, _, sink, _ in entries:
                     self._grant(sink, None)
                 continue
+            entries = self._locality_hints(entries, alive)
             if len(entries) * len(alive) <= 1024:
                 self._place_tick_greedy(entries, alive)
             else:
@@ -2768,6 +2846,88 @@ class GcsServer:
             # placement work, and is excluded).
             self._stat_add("phase:gcs_place",
                            time.monotonic() - t_place0, len(entries))
+
+    def _locality_hints(self, entries, alive: List[str]):
+        """Data-plane locality pass: give hint-less tasks with registered
+        dependencies a placement preference for the node already holding
+        the LARGEST share of their input bytes (moving the task beats
+        moving its inputs), tie-broken by the existing capacity order.
+        The input-bytes matrix joins each task's deps against the object
+        directory's size+location columns over the alive-node order.
+
+        Routing (``RAY_TPU_LOCALITY_KERNEL``): ``""`` (default) serves
+        from the scalar reference, ``"1"`` routes the jit'd kernel pass
+        (pinned bit-identical by the property tests), ``"0"`` disables
+        the pass entirely — the cross-node-bytes A/B arm of the shuffle
+        bench. Explicit user hints are never overridden; a -1 score
+        (no node holds anything) leaves the entry untouched."""
+        import os as _os
+
+        if _os.environ.get("RAY_TPU_LOCALITY_KERNEL", "") == "0" \
+                or not alive or not self.objects:
+            return entries
+        node_pos = {nid: j for j, nid in enumerate(alive)}
+        idx: List[int] = []
+        rows: List[List[int]] = []
+        for i, (_, loc, _, rec) in enumerate(entries):
+            if loc is not None or not isinstance(rec, dict):
+                continue
+            deps = rec.get("payload", {}).get("deps")
+            if not deps:
+                continue
+            row = [0] * len(alive)
+            found = False
+            for oid in deps:
+                entry = self.objects.get(oid)
+                if not entry:
+                    continue
+                size = int(entry.get("size") or 0)
+                if size <= 0:
+                    continue
+                for nid in entry["locations"]:
+                    j = node_pos.get(nid)
+                    if j is not None:
+                        row[j] += size
+                        found = True
+            if found:
+                idx.append(i)
+                rows.append(row)
+        if not idx:
+            return entries
+        mat = np.asarray(rows, dtype=np.int64)
+        try:
+            if _os.environ.get("RAY_TPU_LOCALITY_KERNEL", "") == "1":
+                from ..scheduler.kernel import score_locality_host
+
+                picks = score_locality_host(mat)
+            else:
+                from ..scheduler import reference as _ref
+
+                picks = _ref.score_locality_reference(mat)
+        except Exception:  # noqa: BLE001 — a hint is advisory, never fatal
+            return entries
+        out = list(entries)
+        hinted = 0
+        for i, p in zip(idx, picks):
+            if p >= 0:
+                d, _, sink, rec = out[i]
+                out[i] = (d, alive[int(p)], sink, rec)
+                # Data-locality hints queue AT the data when the node is
+                # momentarily busy (greedy's queue-at-data branch): the
+                # inputs are MiBs by construction, so waiting a beat for
+                # a CPU beats pulling them over the wire.
+                rec["data_locality"] = True
+                hinted += 1
+        if hinted:
+            self.timeseries.add_delta("locality_hints", hinted)
+            if _os.environ.get("RAY_TPU_LOCALITY_DEBUG"):
+                import sys as _sys
+                for k, (i, p) in enumerate(zip(idx, picks)):
+                    print(f"[locality] task={entries[i][3].get('name', '?')} "
+                          f"row={rows[k]} pick={int(p)} "
+                          f"node={alive[int(p)] if p >= 0 else None}",
+                          file=_sys.stderr, flush=True)
+        return out
 
     def _place_tick_greedy(self, entries, alive: List[str]) -> None:
         """Small-tick placement: most-headroom greedy over the live node
@@ -2786,6 +2946,19 @@ class GcsServer:
                         node.available.get(k, 0.0) + 1e-9 >= v
                         for k, v in d.items()):
                     pick = loc
+                elif (node is not None and node.alive
+                        and isinstance(rec, dict)
+                        and rec.get("data_locality")):
+                    # Queue-at-data: a locality-pass hint means the node
+                    # holds MiBs of this task's inputs — a transient CPU
+                    # shortage (e.g. the producing wave hasn't released
+                    # yet) should queue the task there, not ship the
+                    # bytes. Bounded to one extra node-worth of queued
+                    # demand so a genuinely saturated node still spills.
+                    if all(node.available.get(k, 0.0)
+                           + node.resources.get(k, 0.0) + 1e-9 >= v
+                           for k, v in d.items()):
+                        pick = loc
             if pick is None:
                 best = None
                 for nid in alive:
@@ -4581,7 +4754,8 @@ class GcsServer:
                 if not addrs and locations:
                     self._maybe_recover_object(oid)
                 return {"ok": True, "locations": locations,
-                        "addresses": addrs, "transfer_addresses": transfer}
+                        "addresses": addrs, "transfer_addresses": transfer,
+                        "size": int(entry.get("size") or 0) if entry else 0}
 
             self._detach(msg, conn, work())
             return None
@@ -4614,6 +4788,18 @@ class GcsServer:
             audit = stats.pop("audit", None)
             if audit:
                 self.note_node_audit(msg["node_id"], audit)
+            # Data-plane counters: the heartbeat carries monotonic totals;
+            # deltas roll into the time-series store, current values into
+            # Prometheus gauges. Events (sender deaths, failed pulls)
+            # drained node-side land in the cluster event log here.
+            transfer = stats.get("transfer")
+            if transfer:
+                self._roll_transfer_stats(msg["node_id"], transfer)
+            for ev in stats.pop("transfer_events", None) or []:
+                kind = str(ev.get("kind") or "transfer_event")
+                self.record_event(
+                    kind, node_id=msg["node_id"],
+                    **{k: v for k, v in ev.items() if k != "kind"})
             self.node_stats[msg["node_id"]] = stats
             return None
 

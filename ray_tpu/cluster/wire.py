@@ -71,10 +71,15 @@ MAGIC = 0xBF
 # driver that created them (the owner) instead of the GCS object table —
 # controllers publish completed results owner-to-owner and borrowers
 # locate/fetch from the owner, so the head keeps only membership.
+# v10 adds the data-plane frames (GET_OBJ_LOCATIONS /
+# GET_OBJ_LOCATIONS_RESP): the per-pull directory lookup — the hottest RPC
+# of a shuffle's reduce wave — carries the object id and its holders'
+# native transfer endpoints (plus the directory's size column, the transfer
+# scheduler's largest-first key) without pickle.
 # Senders emit each frame only to peers that advertised a wire version
 # that can parse it; everything else still goes out as older frames or
 # pickle, so mixed-version peers interoperate per-message.
-WIRE_VERSION = 9
+WIRE_VERSION = 10
 
 # Message codes (one byte each). Codes are part of the wire contract:
 # never renumber, only append.
@@ -166,6 +171,14 @@ OWNER_FETCH = 0x24
 OWNER_FETCH_RESP = 0x25
 OWNER_PUBLISH = 0x26
 OWNER_PUBLISH_RESP = 0x27
+# Data-plane frames (v10). GET_OBJ_LOCATIONS is the controller's per-pull
+# directory lookup (object id + wait/timeout); its response carries the
+# holder node ids, their RPC addresses, their native transfer endpoints
+# (port 0 = no native plane: spilled/python-store holders restore over
+# RPC), and the directory's size column — or the error/inline blob
+# short-circuits the directory already serves.
+GET_OBJ_LOCATIONS = 0x28
+GET_OBJ_LOCATIONS_RESP = 0x29
 
 # Minimum peer wire version able to parse each frame — the declarative
 # manifest the static lint (raylint wire-discipline) audits: every frame
@@ -212,6 +225,8 @@ FRAME_MIN_WIRE = {
     OWNER_FETCH_RESP: 9,
     OWNER_PUBLISH: 9,
     OWNER_PUBLISH_RESP: 9,
+    GET_OBJ_LOCATIONS: 10,
+    GET_OBJ_LOCATIONS_RESP: 10,
 }
 
 _PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -1592,6 +1607,77 @@ def _dec_owner_publish_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
     return {"ok": True, "count": count, "rpc_id": rpc_id}
 
 
+def _enc_get_obj_locations(msg, peer_wire: int = WIRE_VERSION
+                           ) -> Optional[List[bytes]]:
+    if peer_wire < 10:
+        return None  # pre-v10 peer can't parse 0x28: pickle carries it
+    return [_head(GET_OBJ_LOCATIONS, msg.get("rpc_id")),
+            _b8(msg["object_id"]),
+            _U8.pack(1 if msg.get("wait") else 0),
+            _F64.pack(float(msg.get("timeout", 60.0)))]
+
+
+def _dec_get_obj_locations(r: _Reader, rpc_id) -> Dict[str, Any]:
+    oid = r.b8()
+    wait = bool(r.u8())
+    timeout = r.f64()
+    r.done()
+    return {"type": "get_object_locations", "object_id": oid,
+            "wait": wait, "timeout": timeout, "rpc_id": rpc_id}
+
+
+def _enc_get_obj_locations_resp(msg, peer_wire: int = WIRE_VERSION
+                                ) -> Optional[List[bytes]]:
+    if peer_wire < 10:
+        return None  # pre-v10 peer can't parse 0x29: pickle carries it
+    blob = msg.get("error_blob")
+    if blob is not None:
+        return [_head(GET_OBJ_LOCATIONS_RESP, msg.get("rpc_id")),
+                _U8.pack(1), _U64.pack(len(blob)), blob]
+    blob = msg.get("inline_blob")
+    if blob is not None:
+        return [_head(GET_OBJ_LOCATIONS_RESP, msg.get("rpc_id")),
+                _U8.pack(2), _U64.pack(len(blob)), blob]
+    locations = msg.get("locations", [])
+    addrs = msg.get("addresses", [])
+    transfer = msg.get("transfer_addresses", [])
+    out = [_head(GET_OBJ_LOCATIONS_RESP, msg.get("rpc_id")), _U8.pack(0),
+           _U32.pack(len(locations))]
+    for nid in locations:
+        out.append(_s(str(nid)))
+    out.append(_U32.pack(len(addrs)))
+    for host, port in addrs:
+        out.append(_s(str(host)))
+        out.append(_U16.pack(int(port)))
+    out.append(_U32.pack(len(transfer)))
+    for host, port in transfer:
+        out.append(_s(str(host)))
+        out.append(_U16.pack(int(port)))
+    out.append(_U64.pack(int(msg.get("size") or 0)))
+    return out
+
+
+def _dec_get_obj_locations_resp(r: _Reader, rpc_id) -> Dict[str, Any]:
+    flag = r.u8()
+    if flag == 1:
+        blob = r.b64()
+        r.done()
+        return {"ok": True, "locations": [], "addresses": [],
+                "error_blob": blob, "rpc_id": rpc_id}
+    if flag == 2:
+        blob = r.b64()
+        r.done()
+        return {"ok": True, "locations": [], "addresses": [],
+                "inline_blob": blob, "rpc_id": rpc_id}
+    locations = [r.s() for _ in range(r.count(r.u32()))]
+    addrs = [[r.s(), r.u16()] for _ in range(r.count(r.u32()))]
+    transfer = [[r.s(), r.u16()] for _ in range(r.count(r.u32()))]
+    size = r.u64()
+    r.done()
+    return {"ok": True, "locations": locations, "addresses": addrs,
+            "transfer_addresses": transfer, "size": size, "rpc_id": rpc_id}
+
+
 # Request/push encoders keyed by message "type".
 _ENCODERS = {
     "submit_batch": _enc_submit_batch,
@@ -1616,6 +1702,7 @@ _ENCODERS = {
     "owner_locate": _enc_owner_locate,
     "owner_fetch": _enc_owner_fetch,
     "owner_publish": _enc_owner_publish,
+    "get_object_locations": _enc_get_obj_locations,
 }
 
 # Response encoders keyed by the *request* type they answer.
@@ -1633,6 +1720,7 @@ _RESP_ENCODERS = {
     "owner_locate": _enc_owner_locate_resp,
     "owner_fetch": _enc_owner_fetch_resp,
     "owner_publish": _enc_owner_publish_resp,
+    "get_object_locations": _enc_get_obj_locations_resp,
 }
 
 _DECODERS = {
@@ -1675,6 +1763,8 @@ _DECODERS = {
     OWNER_FETCH_RESP: _dec_owner_fetch_resp,
     OWNER_PUBLISH: _dec_owner_publish,
     OWNER_PUBLISH_RESP: _dec_owner_publish_resp,
+    GET_OBJ_LOCATIONS: _dec_get_obj_locations,
+    GET_OBJ_LOCATIONS_RESP: _dec_get_obj_locations_resp,
 }
 
 

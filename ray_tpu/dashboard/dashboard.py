@@ -283,6 +283,31 @@ async function refresh() {
       h += "</table>";
     }
   } else h += "<i>no loop windows yet (loopmon off or local mode)</i>";
+  // data plane: per-node transfer counters from the heartbeat snapshot
+  // (chunked pull-based object transfers between nodes' arenas).
+  const xferRows = Object.entries(nstats)
+    .filter(([, s]) => s && s.transfer).map(([nid, s]) => [nid, s.transfer]);
+  h += `<h2>data plane (${xferRows.length} nodes reporting)</h2>`;
+  if (xferRows.length) {
+    h += "<table><tr><th>node</th><th>bytes in</th><th>bytes out</th>" +
+         "<th>inflight</th><th>queued</th><th>retries</th>" +
+         "<th>sender deaths</th><th>pulls ok/fail</th></tr>";
+    const mb = b => ((b || 0) / 1048576).toFixed(1) + " MiB";
+    for (const [nid, t] of xferRows)
+      h += `<tr><td>${esc(nid).slice(0, 16)}</td>` +
+           `<td class=num>${mb(t.bytes_in)}</td>` +
+           `<td class=num>${mb(t.bytes_out)}</td>` +
+           `<td class=num>${t.inflight ?? 0}</td>` +
+           `<td class=num>${t.queue_depth ?? 0}</td>` +
+           `<td class=num>${t.chunk_retries ?? 0}</td>` +
+           `<td class=num>${t.sender_deaths ?? 0}</td>` +
+           `<td class=num>${t.pulls_ok ?? 0}/${t.pulls_failed ?? 0}</td></tr>`;
+    h += "</table>";
+    const caps = new Set(xferRows.map(([, t]) => t.max_inflight));
+    h += `<div style="color:#888">admission cap/source: ` +
+         `${[...caps].join(",")} — scheduler ` +
+         `${xferRows.every(([, t]) => t.sched_enabled) ? "on" : "OFF"}</div>`;
+  } else h += "<i>no transfer stats yet (single node or local mode)</i>";
   // task/placement timeline lanes (chrome-trace events, one lane per
   // worker/actor — placement-kernel behavior visually inspectable)
   h += "<h2>timeline</h2>" + laneView(Array.isArray(timeline) ? timeline : []);

@@ -322,6 +322,32 @@ def job_profiler_metrics() -> Dict[str, "Metric"]:
     }
 
 
+def transfer_metrics() -> Dict[str, "Metric"]:
+    """Data-plane counters/gauges rolled up head-side from each node's
+    heartbeat-carried transfer totals (the TransferManager's stats block).
+    Lazily registered; idempotent."""
+    return {
+        "bytes_in": get_or_create(
+            Count, "transfer_bytes_in", tag_keys=("node",),
+            description="payload bytes pulled from remote arenas (landed "
+                        "chunks, partial pulls included)"),
+        "bytes_out": get_or_create(
+            Count, "transfer_bytes_out", tag_keys=("node",),
+            description="payload bytes served by the node's native "
+                        "transfer server"),
+        "inflight": get_or_create(
+            Gauge, "transfer_inflight", tag_keys=("node",),
+            description="pulls currently streaming on the node"),
+        "queue_depth": get_or_create(
+            Gauge, "transfer_queue_depth", tag_keys=("node",),
+            description="pulls queued behind the per-source inflight cap"),
+        "chunk_retries": get_or_create(
+            Count, "transfer_chunk_retries", tag_keys=("node",),
+            description="chunk streams broken mid-pull and resumed "
+                        "against another holder"),
+    }
+
+
 def audit_metrics() -> Dict[str, "Metric"]:
     """``audit_*`` series for the GCS consistency auditor: findings per
     kind from the latest reconciliation pass (a gauge — zeros export so

@@ -460,6 +460,67 @@ def classify_pending_host(demand: np.ndarray, placement: np.ndarray,
     return np.asarray(out)[:T]
 
 
+# ---------------------------------------------------------------------------
+# score_locality / score_locality_reference — bit-identical by the same
+# contract as the passes above. The data plane's placement feed: prefer the
+# node already holding the largest share of a task's input bytes (moving the
+# task is cheaper than moving its inputs), tie-broken by the existing
+# capacity order (lowest node index). -1 = no node holds anything; the
+# placement pass then falls back to pure capacity order.
+
+
+@jax.jit
+def score_locality(
+    bytes_hi: jax.Array,  # [T, N] int32: input_bytes >> 31
+    bytes_lo: jax.Array,  # [T, N] int32: input_bytes & 0x7FFFFFFF
+) -> jax.Array:
+    """One data-parallel locality pass over the directory's input-bytes
+    matrix. int64 byte counts arrive split into two int32 planes (jax runs
+    x64-disabled); preference is the lexicographic argmax over (hi, lo) —
+    exactly "largest byte count wins". ``argmax`` over the boolean
+    on-maximum mask returns the FIRST maximal index, which is the lowest
+    node index — the capacity-order tie-break for free. All-zero rows
+    score -1. Deterministic, no RNG: bit-identity with the scalar
+    reference is exact equality of the int32 output."""
+    hi = bytes_hi.astype(jnp.int32)
+    lo = bytes_lo.astype(jnp.int32)
+    max_hi = hi.max(axis=1, keepdims=True)
+    on_hi = hi == max_hi
+    # Among nodes sharing the max hi plane, compare lo; -1 masks the rest
+    # (payload lo is always >= 0, so the mask never wins).
+    lo_masked = jnp.where(on_hi, lo, -1)
+    max_lo = lo_masked.max(axis=1, keepdims=True)
+    on_max = on_hi & (lo_masked == max_lo)
+    pick = jnp.argmax(on_max, axis=1).astype(jnp.int32)
+    any_bytes = ((hi > 0) | (lo > 0)).any(axis=1)
+    return jnp.where(any_bytes, pick, -1).astype(jnp.int32)
+
+
+def score_locality_host(input_bytes: np.ndarray) -> np.ndarray:
+    """Host entry for the jit'd locality pass: splits int64 byte counts
+    into hi/lo int32 planes, pads the task axis to a power of two so
+    placement ticks don't recompile per pending-set size (padding rows are
+    all-zero and score -1, sliced off), and short-circuits the degenerate
+    shapes (no tasks → empty; no nodes → all -1) where device buffers buy
+    nothing."""
+    b = np.asarray(input_bytes, dtype=np.int64)
+    if b.ndim != 2:
+        raise ValueError(f"input_bytes must be [T, N], got {b.shape}")
+    T, N = b.shape
+    if T == 0:
+        return np.zeros((0,), np.int32)
+    if N == 0:
+        return np.full(T, -1, np.int32)
+    b = np.clip(b, 0, None)
+    pad = (1 << max(T - 1, 1).bit_length()) - T
+    if pad:
+        b = np.concatenate([b, np.zeros((pad, N), np.int64)])
+    hi = (b >> 31).astype(np.int32)
+    lo = (b & 0x7FFFFFFF).astype(np.int32)
+    out = score_locality(jnp.asarray(hi), jnp.asarray(lo))
+    return np.asarray(out)[:T]
+
+
 def admit_gangs_host(demand: np.ndarray, group: np.ndarray,
                      strategy: np.ndarray, avail, key,
                      round_idx: int = 0) -> np.ndarray:

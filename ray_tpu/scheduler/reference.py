@@ -246,3 +246,34 @@ def admit_gangs_reference(demand, group, strategy, avail, key,
             for i in idxs:
                 placement[i] = cand[i]
     return placement.astype(np.int32)
+
+
+def score_locality_reference(input_bytes: np.ndarray) -> np.ndarray:
+    """Scalar reference for the data plane's locality pass.
+
+    ``input_bytes`` is ``[T, N]`` int64: bytes of task ``t``'s inputs
+    already resident on node ``n`` (the GCS directory's size+location
+    columns joined over the alive-node order). Returns ``[T]`` int32: the
+    preferred node index per task, or ``-1`` when no node holds any input
+    bytes (the placement pass then falls back to pure capacity order).
+
+    Semantics the kernel must match bit-for-bit: prefer the node holding
+    the LARGEST input bytes; ties keep the LOWEST node index (the existing
+    capacity order). Zero rows score -1 — "no preference" beats "prefer
+    node 0 for no reason".
+    """
+    b = np.asarray(input_bytes, dtype=np.int64)
+    if b.ndim != 2:
+        raise ValueError(f"input_bytes must be [T, N], got {b.shape}")
+    T, N = b.shape
+    out = np.full(T, -1, dtype=np.int32)
+    for t in range(T):
+        best_bytes = 0
+        best_node = -1
+        for n in range(N):
+            v = int(b[t, n])
+            if v > best_bytes:  # strictly greater: ties keep lowest index
+                best_bytes = v
+                best_node = n
+        out[t] = best_node
+    return out

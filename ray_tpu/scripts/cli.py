@@ -687,6 +687,64 @@ def cmd_doctor(args) -> None:
         gcs.close()
 
 
+def cmd_transfers(args) -> None:
+    """Data-plane view: per-node transfer counters (bytes in/out, inflight
+    streams, admission-queue depth, chunk retries, sender deaths) from the
+    latest heartbeat snapshot, plus every inflight/queued pull from the
+    controllers' audit inventories when ``--inventory`` is set."""
+    gcs = _gcs_client(args.address)
+    try:
+        stats = gcs.call({"type": "get_node_stats"})["stats"]
+        rows = [(nid, s.get("transfer")) for nid, s in sorted(stats.items())
+                if isinstance(s, dict)]
+        rows = [(nid, t) for nid, t in rows if t]
+        if not rows:
+            print("no transfer stats yet (no node heartbeat carried them)")
+            return
+        print(f"{'NODE':<18} {'BYTES_IN':>12} {'BYTES_OUT':>12} "
+              f"{'INFLIGHT':>8} {'QUEUED':>6} {'RETRIES':>7} "
+              f"{'DEATHS':>6} {'OK':>6} {'FAIL':>5}")
+        tot = dict.fromkeys(("bytes_in", "bytes_out", "inflight",
+                             "queue_depth", "chunk_retries",
+                             "sender_deaths", "pulls_ok", "pulls_failed"), 0)
+        for nid, t in rows:
+            for k in tot:
+                tot[k] += int(t.get(k, 0))
+            print(f"{nid[:16]:<18} {t.get('bytes_in', 0):>12} "
+                  f"{t.get('bytes_out', 0):>12} {t.get('inflight', 0):>8} "
+                  f"{t.get('queue_depth', 0):>6} "
+                  f"{t.get('chunk_retries', 0):>7} "
+                  f"{t.get('sender_deaths', 0):>6} "
+                  f"{t.get('pulls_ok', 0):>6} {t.get('pulls_failed', 0):>5}")
+        print(f"{'TOTAL':<18} {tot['bytes_in']:>12} {tot['bytes_out']:>12} "
+              f"{tot['inflight']:>8} {tot['queue_depth']:>6} "
+              f"{tot['chunk_retries']:>7} {tot['sender_deaths']:>6} "
+              f"{tot['pulls_ok']:>6} {tot['pulls_failed']:>5}")
+        caps = {t.get("max_inflight") for _, t in rows} - {None}
+        if caps:
+            sched = all(t.get("sched_enabled", True) for _, t in rows)
+            print(f"admission: max_inflight/source="
+                  f"{','.join(str(c) for c in sorted(caps))} "
+                  f"scheduler={'on' if sched else 'OFF'}")
+        if getattr(args, "inventory", False):
+            resp = gcs.call({"type": "run_audit", "verify": False},
+                            timeout=180.0)
+            invs = resp.get("transfer_inventories") or {}
+            shown = 0
+            for nid, tr in sorted(invs.items()):
+                for state in ("inflight", "queued"):
+                    for e in (tr or {}).get(state, []):
+                        print(f"  {state:<8} {e.get('object_id', '?')[:16]} "
+                              f"on {nid[:12]} <- {str(e.get('source'))[:12]} "
+                              f"age={e.get('age_s', 0):.1f}s "
+                              f"size={e.get('size', 0)}")
+                        shown += 1
+            if not shown:
+                print("no inflight or queued pulls")
+    finally:
+        gcs.close()
+
+
 def cmd_trace(args) -> None:
     """Per-task straggler report: top-k slowest sampled tasks with latency
     attributed to the 7 control-plane phases (needs tracing enabled —
@@ -1578,6 +1636,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="lift quarantine: --clear <fn_id prefix>, or "
                          "--clear with no value for all entries")
     sp.set_defaults(fn=cmd_quarantine)
+
+    sp = sub.add_parser("transfers", help="data-plane view: per-node "
+                        "transfer counters (bytes in/out, inflight, queue "
+                        "depth, retries) and optionally every live pull")
+    sp.add_argument("--address")
+    sp.add_argument("--inventory", action="store_true",
+                    help="also list every inflight/queued pull from the "
+                    "controllers' audit inventories")
+    sp.set_defaults(fn=cmd_transfers)
 
     sp = sub.add_parser("trace", help="per-task straggler report "
                                       "(sampled trace table)")

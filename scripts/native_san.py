@@ -40,7 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE_LIBS = ("shm_store", "channel", "transfer", "framepump")
 STRESS_SOURCES = ("stress_shm.cc", "stress_channel.cc",
-                  "stress_framepump.cc")
+                  "stress_framepump.cc", "stress_transfer.cc")
 
 _SAN_FLAGS = {
     "asan": ["-fsanitize=address,undefined"],

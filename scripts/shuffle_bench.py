@@ -1,0 +1,242 @@
+"""Multi-node shuffle benchmark for the data plane (bytes/s, not tasks/s).
+
+A classic M x P sort: M map tasks range-partition random uint64 keys into
+P partitions (``num_returns=P``); P reduce tasks each pull their partition
+from EVERY map — most of those pulls cross node boundaries and ride the
+chunked pull-based transfer manager — then sort and report boundaries.
+The driver validates zero lost rows and a globally consistent order, and
+reports shuffle throughput as bytes moved per second of shuffle wall.
+
+The workload is skewed on purpose (``--skew``): map m concentrates its
+rows in partition ``m % P``, so each reducer has one node holding most of
+its input. That is exactly the shape the locality placement pass
+(scheduler/kernel.py score_locality) is built for: ``--ab`` runs the same
+mix twice — locality on (default) vs ``RAY_TPU_LOCALITY_KERNEL=0`` — and
+reports how many fewer cross-node bytes the locality arm pulled.
+
+``--record`` appends the run (with the PR-18 environment fingerprint and
+quiet/noisy verdict) to BENCH_SHUFFLE.json at the repo root.
+
+    python scripts/shuffle_bench.py --mb 64 --nodes 3 --ab --record
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from cluster_lat import _EnvFingerprint, env_verdict  # noqa: E402
+
+KEY_BYTES = 8  # uint64 keys
+
+
+def _mk_tasks(ray_tpu, parts: int):
+    import numpy as np
+
+    @ray_tpu.remote
+    def gen_partitions(seed: int, rows: int, nparts: int, skew: float,
+                       home: int):
+        """Range-partition ``rows`` random uint64 keys; ``skew`` of them
+        drawn from partition ``home``'s key range (the hot shard)."""
+        rng = np.random.default_rng(seed)
+        span = (1 << 64) // nparts
+        hot = int(rows * skew)
+        lo = home * span
+        hi = (1 << 64) - 1 if home == nparts - 1 else lo + span
+        keys = np.concatenate([
+            rng.integers(lo, hi, size=hot, dtype=np.uint64),
+            rng.integers(0, 1 << 64, size=rows - hot, dtype=np.uint64),
+        ])
+        idx = np.minimum(keys // np.uint64(span), nparts - 1).astype(np.int64)
+        return tuple(np.ascontiguousarray(keys[idx == p])
+                     for p in range(nparts))
+
+    @ray_tpu.remote
+    def reduce_sort(*chunks):
+        merged = np.sort(np.concatenate(chunks)) if chunks else \
+            np.empty(0, dtype=np.uint64)
+        return {
+            "count": int(merged.size),
+            "lo": int(merged[0]) if merged.size else None,
+            "hi": int(merged[-1]) if merged.size else None,
+            "nbytes": int(merged.nbytes),
+        }
+
+    return gen_partitions.options(num_returns=parts), reduce_sort
+
+
+def _transfer_totals(ray_tpu) -> dict:
+    """Summed cumulative transfer counters across the fleet (monotonic —
+    deltas over a window are bytes pulled in that window)."""
+    from ray_tpu import state
+
+    out = {"bytes_in": 0, "bytes_out": 0, "chunk_retries": 0,
+           "sender_deaths": 0}
+    for stats in state.node_stats().values():
+        xfer = (stats or {}).get("transfer") or {}
+        for key in out:
+            out[key] += int(xfer.get(key, 0))
+    return out
+
+
+def run_shuffle(maps: int, parts: int, total_bytes: int, nodes: int,
+                skew: float, extra_env: dict, timeout: float = 600.0) -> dict:
+    """One full map/shuffle/reduce sort in a fresh ``nodes``-node cluster.
+    Returns the measured row; raises on any lost row or order violation."""
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+
+    rows_per_map = max(total_bytes // (maps * KEY_BYTES), parts)
+    cluster = Cluster(head_resources={"CPU": 2}, num_workers=1,
+                      extra_env=extra_env)
+    try:
+        for _ in range(nodes - 1):
+            cluster.add_node(resources={"CPU": 2}, num_workers=1)
+        cluster.wait_for_nodes(nodes)
+        ray_tpu.init(address=cluster.address)
+        try:
+            gen, reduce_sort = _mk_tasks(ray_tpu, parts)
+
+            t_map0 = time.monotonic()
+            # Home partition (m + 1) % P, NOT m % P: with M == P both the
+            # map wave and the reduce wave round-robin over the same node
+            # order, so an unshifted home would hand the no-locality arm
+            # perfect co-location by coincidence.
+            map_out = [gen.remote(1000 + m, rows_per_map, parts, skew,
+                                  (m + 1) % parts) for m in range(maps)]
+            flat = [ref for refs in map_out for ref in refs]
+            ray_tpu.wait(flat, num_returns=len(flat), timeout=timeout)
+            map_wall = time.monotonic() - t_map0
+
+            before = _transfer_totals(ray_tpu)
+            t0 = time.monotonic()
+            reducers = [
+                reduce_sort.remote(*[map_out[m][p] for m in range(maps)])
+                for p in range(parts)
+            ]
+            results = ray_tpu.get(reducers, timeout=timeout)
+            shuffle_wall = time.monotonic() - t0
+            # Transfer counters ride the heartbeat; give the last beats a
+            # moment to land before sampling the "after" edge.
+            time.sleep(3.0)
+            after = _transfer_totals(ray_tpu)
+
+            total_rows = maps * rows_per_map
+            got_rows = sum(r["count"] for r in results)
+            if got_rows != total_rows:
+                raise AssertionError(
+                    f"lost rows: expected {total_rows}, reduced {got_rows}")
+            prev_hi = None
+            for p, r in enumerate(results):
+                if r["count"] == 0:
+                    continue
+                if prev_hi is not None and r["lo"] < prev_hi:
+                    raise AssertionError(
+                        f"partition {p} overlaps its predecessor "
+                        f"({r['lo']} < {prev_hi})")
+                prev_hi = r["hi"]
+
+            shuffled = sum(r["nbytes"] for r in results)
+            return {
+                "maps": maps, "partitions": parts, "nodes": nodes,
+                "skew": skew,
+                "rows": total_rows,
+                "shuffled_bytes": shuffled,
+                "map_wall_s": round(map_wall, 3),
+                "shuffle_wall_s": round(shuffle_wall, 3),
+                "bytes_per_s": round(shuffled / max(shuffle_wall, 1e-9)),
+                "cross_node_bytes": after["bytes_in"] - before["bytes_in"],
+                "chunk_retries": (after["chunk_retries"]
+                                  - before["chunk_retries"]),
+                "sender_deaths": (after["sender_deaths"]
+                                  - before["sender_deaths"]),
+            }
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        cluster.shutdown()
+
+
+def record(row: dict) -> None:
+    path = os.path.join(REPO, "BENCH_SHUFFLE.json")
+    try:
+        with open(path) as f:
+            bench = json.load(f)
+    except (OSError, ValueError):
+        bench = []
+    bench.append(row)
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=2)
+        f.write("\n")
+    print(f"recorded -> {path} ({len(bench)} rows)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--maps", type=int, default=6)
+    ap.add_argument("--partitions", type=int, default=6)
+    ap.add_argument("--mb", type=float, default=64.0,
+                    help="total shuffled payload in MiB (across all maps)")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--skew", type=float, default=0.8,
+                    help="fraction of each map's rows in its home partition")
+    ap.add_argument("--ab", action="store_true",
+                    help="also run with RAY_TPU_LOCALITY_KERNEL=0 and "
+                         "report the cross-node byte reduction")
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--note", default="")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    total_bytes = int(args.mb * (1 << 20))
+    fp = _EnvFingerprint()
+
+    print(f"shuffle: {args.maps} maps x {args.partitions} partitions, "
+          f"{args.mb:.0f} MiB over {args.nodes} nodes (skew {args.skew})")
+    on = run_shuffle(args.maps, args.partitions, total_bytes, args.nodes,
+                     args.skew, extra_env={}, timeout=args.timeout)
+    print(f"  locality on : {on['bytes_per_s'] / 1e6:8.1f} MB/s   "
+          f"cross-node {on['cross_node_bytes'] / (1 << 20):7.1f} MiB   "
+          f"shuffle {on['shuffle_wall_s']:.2f}s")
+
+    off = None
+    if args.ab:
+        off = run_shuffle(args.maps, args.partitions, total_bytes,
+                          args.nodes, args.skew,
+                          extra_env={"RAY_TPU_LOCALITY_KERNEL": "0"},
+                          timeout=args.timeout)
+        print(f"  locality off: {off['bytes_per_s'] / 1e6:8.1f} MB/s   "
+              f"cross-node {off['cross_node_bytes'] / (1 << 20):7.1f} MiB   "
+              f"shuffle {off['shuffle_wall_s']:.2f}s")
+        if off["cross_node_bytes"] > 0:
+            saved = 1.0 - on["cross_node_bytes"] / off["cross_node_bytes"]
+            print(f"  locality saved {saved * 100.0:.1f}% of "
+                  f"cross-node bytes")
+
+    env = fp.finish()
+    row = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "kind": "shuffle_sort",
+        "run": on,
+        "ab_locality_off": off,
+        "env": env,
+        "env_verdict": env_verdict(env),
+    }
+    if args.note:
+        row["note"] = args.note
+    print(json.dumps(row))
+    if args.record:
+        record(row)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

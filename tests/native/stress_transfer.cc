@@ -1,0 +1,264 @@
+// ASAN/UBSAN/TSAN stress for the chunked transfer data plane
+// (transfer.cc GETR path): concurrent multi-chunk pulls with mixed chunk
+// sizes and interleaved size probes, protocol-garbage and truncated
+// requests against a live server, and SIGKILL of a sender process
+// mid-stream — the landed prefix must stay byte-exact and the pull must
+// resume from its offset against a second holder of the same arena.
+//
+// Built and run by scripts/native_san.py (tests/test_native_san.py).
+
+#include "../../ray_tpu/_native/src/transfer.cc"
+
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+constexpr int kObjects = 6;
+constexpr uint64_t kObjSize = 3 * (1 << 20) + 37;  // multi-chunk, odd tail
+
+void make_id(uint8_t* id, int i) {
+  std::memset(id, 0, kIdLen);
+  id[0] = static_cast<uint8_t>(0xA0 + i);
+  id[1] = 0x5C;
+}
+
+uint8_t expected_byte(int obj, uint64_t off) {
+  return static_cast<uint8_t>((obj * 131u + off * 7u + off / 4096u) & 0xFF);
+}
+
+void fill_object(std::vector<uint8_t>& buf, int obj) {
+  for (uint64_t p = 0; p < buf.size(); ++p) buf[p] = expected_byte(obj, p);
+}
+
+// Pulls id fully over one connection as a chunk pipeline; returns landed
+// bytes (verifying every chunk) or dies on protocol violation.
+uint64_t pull_all(int fd, const uint8_t* id, std::vector<uint8_t>& dst,
+                  uint64_t chunk, int obj) {
+  uint64_t total = 0;
+  int64_t n = tts_fetch_range_fd(fd, id, 0, 0, nullptr, &total);  // probe
+  CHECK(n == 0 && total == kObjSize);
+  dst.assign(total, 0);
+  uint64_t off = 0;
+  while (off < total) {
+    uint64_t want = std::min(chunk, total - off);
+    uint64_t remote_total = 0;
+    n = tts_fetch_range_fd(fd, id, off, want, dst.data() + off,
+                           &remote_total);
+    CHECK(n > 0 && remote_total == total);
+    off += static_cast<uint64_t>(n);
+  }
+  for (uint64_t p = 0; p < total; ++p) CHECK(dst[p] == expected_byte(obj, p));
+  return off;
+}
+
+// ---- 1. concurrent chunked pulls, mixed chunk sizes + probes ------------
+void concurrent_pulls(void* store, int port) {
+  constexpr int kThreads = 6;
+  std::atomic<uint64_t> landed{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([t, port, &landed] {
+      uint64_t chunk = 4096ull << t;  // 4 KiB .. 128 KiB
+      int fd = tts_connect("127.0.0.1", port);
+      CHECK(fd >= 0);
+      std::vector<uint8_t> dst;
+      for (int obj = 0; obj < kObjects; ++obj) {
+        uint8_t id[kIdLen];
+        make_id(id, obj);
+        landed += pull_all(fd, id, dst, chunk, obj);
+      }
+      tts_disconnect(fd);
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK(landed.load() == uint64_t(kThreads) * kObjects * kObjSize);
+  std::printf("concurrent chunked pulls: OK\n");
+}
+
+// ---- 2. garbage / truncated requests never wedge the server -------------
+void garbage_requests(int port) {
+  // unknown opcode
+  {
+    int fd = tts_connect("127.0.0.1", port);
+    CHECK(fd >= 0);
+    uint8_t junk[64];
+    std::memset(junk, 0x9E, sizeof(junk));
+    send_all(fd, junk, sizeof(junk));
+    tts_disconnect(fd);
+  }
+  // truncated GETR: opcode + half an id, then hang up
+  {
+    int fd = tts_connect("127.0.0.1", port);
+    CHECK(fd >= 0);
+    uint8_t part[1 + kIdLen / 2];
+    part[0] = kOpGetRange;
+    std::memset(part + 1, 0xAB, sizeof(part) - 1);
+    send_all(fd, part, sizeof(part));
+    tts_disconnect(fd);
+  }
+  // offset past end: protocol error to THIS client only
+  {
+    int fd = tts_connect("127.0.0.1", port);
+    CHECK(fd >= 0);
+    uint8_t id[kIdLen];
+    make_id(id, 0);
+    uint8_t dst[64];
+    uint64_t total = 0;
+    int64_t n = tts_fetch_range_fd(fd, id, kObjSize + 9, 64, dst, &total);
+    CHECK(n == -4);
+    tts_disconnect(fd);
+  }
+  // the server still serves correct bytes afterwards
+  {
+    int fd = tts_connect("127.0.0.1", port);
+    CHECK(fd >= 0);
+    uint8_t id[kIdLen];
+    make_id(id, 1);
+    std::vector<uint8_t> dst;
+    CHECK(pull_all(fd, id, dst, 1 << 16, 1) == kObjSize);
+    tts_disconnect(fd);
+  }
+  std::printf("garbage/truncated requests: OK\n");
+}
+
+// ---- 3. SIGKILL the sender mid-stream; resume against a second holder ---
+void sender_death_resume(const char* store_name, void* store, int port) {
+  int portpipe[2];
+  CHECK(pipe(portpipe) == 0);
+  pid_t child = fork();
+  CHECK(child >= 0);
+  if (child == 0) {
+    // child: an independent holder process serving the same arena
+    close(portpipe[0]);
+    void* h = tps_open(store_name);
+    if (h == nullptr) _exit(2);
+    void* srv = tts_serve_start(h, 0);
+    if (srv == nullptr) _exit(3);
+    int p = tts_serve_port(srv);
+    if (write(portpipe[1], &p, sizeof(p)) != sizeof(p)) _exit(4);
+    close(portpipe[1]);
+    for (;;) pause();
+  }
+  close(portpipe[1]);
+  int child_port = 0;
+  CHECK(read(portpipe[0], &child_port, sizeof(child_port))
+        == static_cast<ssize_t>(sizeof(child_port)));
+  close(portpipe[0]);
+
+  uint8_t id[kIdLen];
+  make_id(id, 2);
+  std::vector<uint8_t> dst(kObjSize, 0);
+  constexpr uint64_t kChunkSz = 1 << 16;
+
+  int fd = tts_connect("127.0.0.1", child_port);
+  CHECK(fd >= 0);
+  uint64_t off = 0;
+  while (off < kObjSize / 2) {  // land roughly half, then kill the sender
+    uint64_t want = std::min(kChunkSz, kObjSize - off);
+    uint64_t total = 0;
+    int64_t n = tts_fetch_range_fd(fd, id, off, want, dst.data() + off,
+                                   &total);
+    CHECK(n > 0 && total == kObjSize);
+    off += static_cast<uint64_t>(n);
+  }
+  CHECK(kill(child, SIGKILL) == 0);
+  CHECK(waitpid(child, nullptr, 0) == child);
+  // the stream breaks within a bounded number of buffered responses
+  uint64_t landed = off;
+  for (int spins = 0; spins < 1000; ++spins) {
+    uint64_t want = std::min(kChunkSz, kObjSize - landed);
+    if (want == 0) break;
+    uint64_t total = 0;
+    int64_t n = tts_fetch_range_fd(fd, id, landed, want,
+                                   dst.data() + landed, &total);
+    if (n < 0) break;  // broken — this is the expected exit
+    landed += static_cast<uint64_t>(n);
+  }
+  tts_disconnect(fd);
+  CHECK(landed < kObjSize);  // the kill interrupted the pull
+  // every landed byte must be exact — resume trusts the prefix
+  for (uint64_t p = 0; p < landed; ++p) CHECK(dst[p] == expected_byte(2, p));
+
+  // resume from the cursor against the surviving holder
+  fd = tts_connect("127.0.0.1", port);
+  CHECK(fd >= 0);
+  while (landed < kObjSize) {
+    uint64_t want = std::min(kChunkSz, kObjSize - landed);
+    uint64_t total = 0;
+    int64_t n = tts_fetch_range_fd(fd, id, landed, want,
+                                   dst.data() + landed, &total);
+    CHECK(n > 0 && total == kObjSize);
+    landed += static_cast<uint64_t>(n);
+  }
+  tts_disconnect(fd);
+  for (uint64_t p = 0; p < kObjSize; ++p) CHECK(dst[p] == expected_byte(2, p));
+  std::printf("sender death + resume: OK\n");
+}
+
+}  // namespace
+
+int main() {
+  const char* store_name = "rtts-stress-xfer";
+  shm_unlink(store_name);
+  void* store = tps_create(store_name, 256ull << 20);
+  CHECK(store != nullptr);
+  std::vector<uint8_t> payload(kObjSize);
+  for (int obj = 0; obj < kObjects; ++obj) {
+    uint8_t id[kIdLen];
+    make_id(id, obj);
+    fill_object(payload, obj);
+    CHECK(tps_put(store, id, payload.data(), payload.size()) == kOk);
+  }
+  void* server = tts_serve_start(store, 0);
+  CHECK(server != nullptr);
+  int port = tts_serve_port(server);
+
+  concurrent_pulls(store, port);
+  garbage_requests(port);
+#if defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define RTTS_TSAN 1
+#endif
+#endif
+#if defined(__SANITIZE_THREAD__)
+#define RTTS_TSAN 1
+#endif
+#if defined(RTTS_TSAN)
+  // TSAN refuses new threads after a multi-threaded fork; the fork-based
+  // sender-death drill runs under ASAN/UBSAN (and in the Python tests).
+  std::printf("sender death + resume: SKIPPED under tsan\n");
+#else
+  sender_death_resume(store_name, store, port);
+#endif
+
+  uint64_t bytes_out = 0, requests = 0;
+  tts_serve_stats(server, &bytes_out, &requests);
+  // this server alone served 6 threads x 6 objects + the garbage-test
+  // re-pull + the resume tail; its counter must cover at least that floor
+  CHECK(bytes_out >= 37ull * kObjSize / 2);
+  CHECK(requests > 0);
+
+  tts_serve_stop(server);
+  tps_close(store);
+  shm_unlink(store_name);
+  std::printf("ALL OK\n");
+  return 0;
+}

@@ -58,3 +58,10 @@ def test_lm_serving(local_ray):
 
     outs = main(smoke=True)
     assert len(outs) == 6
+
+
+def test_streaming_microbatch(local_ray):
+    from examples.streaming_microbatch import main
+
+    out = main(smoke=True)
+    assert out["batches"] == 8 and out["rows"] == 8 * 256

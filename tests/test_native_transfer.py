@@ -113,3 +113,224 @@ def test_cluster_large_objects_use_native_plane():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Chunked range path (PR-20 data plane): kOpGetRange framing, pipelined
+# chunk streams, resume-from-offset, and robustness against lying/dying
+# peers (model: reference object_buffer_pool chunk tests).
+
+
+def test_chunked_roundtrip_various_chunk_sizes(two_stores):
+    (name_a, name_b), (a, b) = two_stores
+    oid = b"c" * 24
+    payload = os.urandom(1_000_003)  # prime-ish: never chunk-aligned
+    assert a.put(oid, payload)
+    srv = TransferServer(name_a)
+    cli = TransferClient(name_b)
+    try:
+        assert cli.probe_size("127.0.0.1", srv.port, oid) == len(payload)
+        assert cli.probe_size("127.0.0.1", srv.port, b"n" * 24) is None
+        for i, chunk in enumerate((1 << 12, 1 << 16, 1 << 20, 1 << 24)):
+            dst_id = bytes([i + 1]) * 24
+            view = b.create(dst_id, len(payload))
+            got = cli.fetch_chunks("127.0.0.1", srv.port, oid, view,
+                                   0, chunk)
+            expect = -(-len(payload) // chunk)
+            assert got == expect
+            del view
+            b.seal(dst_id)
+            assert b.get_bytes(dst_id) == payload
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_chunked_resume_from_offset(two_stores):
+    (name_a, name_b), (a, b) = two_stores
+    oid = b"c" * 24
+    payload = os.urandom(700_000)
+    assert a.put(oid, payload)
+    srv = TransferServer(name_a)
+    cli = TransferClient(name_b)
+    try:
+        dst = b.create(b"d" * 24, len(payload))
+        # a previous attempt landed the first 123_457 bytes
+        dst[:123_457] = payload[:123_457]
+        cli.fetch_chunks("127.0.0.1", srv.port, oid, dst, 123_457, 1 << 14)
+        assert bytes(dst) == payload
+        del dst
+        b.seal(b"d" * 24)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_server_survives_garbage_and_truncated_requests(two_stores):
+    import socket
+    import struct
+
+    (name_a, name_b), (a, b) = two_stores
+    oid = b"g" * 24
+    payload = os.urandom(64 * 1024)
+    assert a.put(oid, payload)
+    srv = TransferServer(name_a)
+    cli = TransferClient(name_b)
+    rng = np.random.RandomState(7)
+    try:
+        # Garbage ops, truncated operands, random floods: each lands on
+        # its own connection; the server must drop the bad peer and keep
+        # serving good ones.
+        attacks = [
+            bytes([9]) + b"x" * 40,                   # unknown op
+            bytes([3]) + b"y" * 10,                   # truncated id
+            bytes([3]) + oid + struct.pack("<Q", 1 << 60),  # missing length
+            rng.bytes(41),
+            b"",
+        ]
+        for blob in attacks:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=2)
+            try:
+                if blob:
+                    s.sendall(blob)
+                s.shutdown(socket.SHUT_WR)
+                s.recv(64)  # whatever comes (likely EOF) must come fast
+            except OSError:
+                pass
+            finally:
+                s.close()
+        # offset past end -> protocol status, clean miss on the client
+        view = b.create(b"h" * 24, 10)
+        from ray_tpu._native.transfer import TransferBrokenError
+        broken = False
+        try:  # not pytest.raises: its ExceptionInfo would pin the frame
+            cli.fetch_chunks("127.0.0.1", srv.port, oid, view, 0, 1 << 12)
+        except TransferBrokenError:
+            broken = True
+        assert broken
+        del view
+        b.abort(b"h" * 24)
+        # and the server still serves the real thing
+        dst = b.create(b"i" * 24, len(payload))
+        cli.fetch_chunks("127.0.0.1", srv.port, oid, dst, 0, 1 << 12)
+        assert bytes(dst) == payload
+        del dst
+        b.seal(b"i" * 24)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+class _DyingSender:
+    """A GETR-speaking fake that serves ``die_after`` chunks then snaps the
+    connection — the deterministic stand-in for a sender crashing
+    mid-stream."""
+
+    def __init__(self, payload, die_after=2):
+        import socket
+        import struct
+        import threading
+
+        self.payload = payload
+        self.die_after = die_after
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._struct = struct
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                served = 0
+                while True:
+                    try:
+                        req = b""
+                        while len(req) < 41:
+                            part = conn.recv(41 - len(req))
+                            if not part:
+                                raise OSError
+                            req += part
+                        off, length = self._struct.unpack_from("<QQ", req, 25)
+                        if served >= self.die_after and length > 0:
+                            return  # snap mid-stream
+                        total = len(self.payload)
+                        n = min(length, max(total - off, 0))
+                        conn.sendall(
+                            self._struct.pack("<BQQ", 0, total, n)
+                            + self.payload[off:off + n])
+                        if length > 0:
+                            served += 1
+                    except OSError:
+                        break
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def test_resume_after_sender_death_lands_identical_bytes(two_stores):
+    from ray_tpu._native.transfer import TransferBrokenError
+
+    (name_a, name_b), (a, b) = two_stores
+    payload = os.urandom(256 * 1024)
+    oid = b"k" * 24
+    assert a.put(oid, payload)
+    dying = _DyingSender(payload, die_after=3)
+    srv = TransferServer(name_a)  # the healthy second holder
+    cli = TransferClient(name_b)
+    try:
+        view = b.create(oid, len(payload))
+        landed = -1
+        try:  # not pytest.raises: its ExceptionInfo would pin the frame
+            cli.fetch_chunks("127.0.0.1", dying.port, oid, view, 0, 1 << 14)
+        except TransferBrokenError as exc:
+            landed = exc.offset
+        assert 0 < landed < len(payload)
+        assert bytes(view[:landed]) == payload[:landed]
+        # resume against the healthy holder from exactly there
+        cli.fetch_chunks("127.0.0.1", srv.port, oid, view, landed, 1 << 14)
+        assert bytes(view) == payload
+        del view
+        b.seal(oid)
+        assert b.get_bytes(oid) == payload
+    finally:
+        dying.stop()
+        cli.close()
+        srv.stop()
+
+
+def test_lying_size_peer_is_a_broken_source(two_stores):
+    """A holder advertising a DIFFERENT total for the same id would corrupt
+    the destination slot — the client must refuse the stream."""
+    from ray_tpu._native.transfer import TransferBrokenError
+
+    (name_a, name_b), (a, b) = two_stores
+    payload = os.urandom(64 * 1024)
+    liar = _DyingSender(payload[: 32 * 1024], die_after=10**9)
+    cli = TransferClient(name_b)
+    try:
+        view = b.create(b"l" * 24, len(payload))
+        broken = False
+        try:  # not pytest.raises: its ExceptionInfo would pin the frame
+            cli.fetch_chunks("127.0.0.1", liar.port, b"l" * 24, view,
+                             0, 1 << 12)
+        except TransferBrokenError:
+            broken = True
+        assert broken
+        del view
+        b.abort(b"l" * 24)
+    finally:
+        liar.stop()
+        cli.close()

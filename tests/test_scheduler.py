@@ -738,3 +738,175 @@ class TestPendingReason:
         assert len(k.REASON_NAMES) == 6
         assert k.REASON_NAMES[k.REASON_INFEASIBLE] == "infeasible"
         assert k.REASON_NAMES[k.REASON_WAITING_PG] == "waiting-for-pg"
+
+
+class TestLocalityScore:
+    """Data-plane locality pass (PR-20): the jit pass (score_locality)
+    must reproduce the scalar reference (score_locality_reference)
+    bit-for-bit on any input-bytes matrix — random sizes/locations,
+    adversarial ties, >2^31 byte counts, empty fleets — and the semantics
+    must hold: largest input bytes wins, ties keep the lowest node index,
+    all-zero rows score -1."""
+
+    @staticmethod
+    def _both(input_bytes):
+        from ray_tpu.scheduler.kernel import score_locality_host
+        from ray_tpu.scheduler.reference import score_locality_reference
+
+        k = score_locality_host(input_bytes)
+        r = score_locality_reference(input_bytes)
+        return k, r
+
+    @pytest.mark.parametrize("seed", list(range(16)))
+    def test_random_sizes_and_locations_bit_identical(self, seed):
+        rng = np.random.default_rng(seed)
+        T = int(rng.integers(0, 32))
+        N = int(rng.integers(0, 8))
+        # Mix of small sizes, zero rows, and >int32 byte counts (the
+        # hi/lo split must carry 64-bit object sizes exactly).
+        b = rng.integers(0, 1 << 40, size=(T, N))
+        if T and N:
+            b[rng.random((T, N)) < 0.4] = 0
+        k, r = self._both(b)
+        np.testing.assert_array_equal(k, r)
+        assert k.dtype == np.int32
+
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_adversarial_ties_bit_identical(self, seed):
+        rng = np.random.default_rng(seed)
+        # Duplicate columns force exact ties; the winner must be the
+        # LOWEST node index (the capacity-order tie-break).
+        base = rng.integers(0, 1 << 36, size=(16, 1))
+        b = np.concatenate([base, base, base], axis=1)
+        k, r = self._both(b)
+        np.testing.assert_array_equal(k, r)
+        nz = np.asarray(b).sum(axis=1) > 0
+        assert (k[nz] == 0).all()
+
+    def test_empty_fleet_and_empty_batch(self):
+        for shape in ((0, 4), (5, 0), (0, 0)):
+            k, r = self._both(np.zeros(shape, np.int64))
+            np.testing.assert_array_equal(k, r)
+        k, r = self._both(np.zeros((3, 2), np.int64))
+        assert k.tolist() == [-1, -1, -1]  # no bytes anywhere: no hint
+
+    def test_semantics_largest_bytes_wins(self):
+        from ray_tpu.scheduler.reference import score_locality_reference
+
+        b = np.asarray([
+            [10, 200, 30],   # node 1 holds the most
+            [0, 0, 0],       # nothing anywhere -> -1
+            [5, 5, 5],       # exact tie -> lowest index
+            [0, 0, 1 << 35], # 64-bit sizes resolve exactly
+        ], np.int64)
+        assert score_locality_reference(b).tolist() == [1, -1, 0, 2]
+        k, _ = self._both(b)
+        assert k.tolist() == [1, -1, 0, 2]
+
+    def test_gcs_hint_routing_kernel_env(self, monkeypatch):
+        """RAY_TPU_LOCALITY_KERNEL routes the GCS hint pass: "1" through
+        the jit kernel, "" (default) through the reference, "0" disables
+        hinting entirely. Exercised against a stub directory — the pass
+        itself is pure (entries + objects in, entries out)."""
+        import types
+
+        from ray_tpu.cluster.gcs import GcsServer
+
+        oid_a, oid_b = b"A" * 24, b"B" * 24
+        stub = types.SimpleNamespace(
+            objects={
+                oid_a: {"locations": {"n2"}, "size": 1 << 20},
+                oid_b: {"locations": {"n1", "n3"}, "size": 4096},
+            },
+            timeseries=types.SimpleNamespace(add_delta=lambda *a, **k: None),
+        )
+        rec = {"payload": {"deps": [oid_a, oid_b]}}
+        entries = [(None, None, "sink", rec),
+                   (None, "n3", "sink", {"payload": {"deps": [oid_a]}}),
+                   (None, None, "sink", {"payload": {"deps": []}})]
+        alive = ["n1", "n2", "n3"]
+        for mode in ("", "1"):
+            monkeypatch.setenv("RAY_TPU_LOCALITY_KERNEL", mode)
+            out = GcsServer._locality_hints(stub, list(entries), alive)
+            # task 0: n2 holds 1 MiB of A vs 4 KiB of B on n1/n3 -> n2
+            assert out[0][1] == "n2", mode
+            # explicit hints and dep-less tasks are untouched
+            assert out[1][1] == "n3" and out[2][1] is None
+        monkeypatch.setenv("RAY_TPU_LOCALITY_KERNEL", "0")
+        out = GcsServer._locality_hints(stub, list(entries), alive)
+        assert out[0][1] is None  # pass disabled: no hint injected
+
+
+class TestQueueAtData:
+    """Greedy placement's queue-at-data branch (PR-20): a locality-pass
+    hint whose node is momentarily out of CPU queues AT the data node
+    (bounded over-commit) instead of shipping MiBs to a free node; a
+    plain explicit hint still spreads, and a saturated data node spills."""
+
+    @staticmethod
+    def _run_tick(entries, nodes):
+        import asyncio
+        import types
+
+        from ray_tpu.cluster.gcs import GcsServer
+
+        async def scenario():
+            stub = types.SimpleNamespace(
+                nodes=nodes,
+                _sink_stale=GcsServer._sink_stale,
+                _acquire=lambda nid, d: GcsServer._acquire(stub, nid, d),
+                _grant=lambda sink, nid: sink.set_result(nid),
+                _classify_unplaced=lambda deferred: None,
+            )
+            alive = [nid for nid, n in nodes.items() if n.alive]
+            loop = asyncio.get_event_loop()
+            sinks = [loop.create_future() for _ in entries]
+            full = [(d, loc, sinks[i], rec)
+                    for i, (d, loc, rec) in enumerate(entries)]
+            GcsServer._place_tick_greedy(stub, full, alive)
+            return [s.result() if s.done() else None for s in sinks]
+
+        return asyncio.run(scenario())
+
+    @staticmethod
+    def _node(avail, total):
+        import types
+
+        return types.SimpleNamespace(alive=True, draining=False,
+                                     available=dict(avail),
+                                     resources=dict(total))
+
+    def _demand(self):
+        from ray_tpu._private.resources import ResourceSet
+
+        return ResourceSet.from_dict({"CPU": 1.0})
+
+    def test_data_locality_hint_queues_at_busy_node(self):
+        nodes = {"n1": self._node({"CPU": 0.0}, {"CPU": 2.0}),
+                 "n2": self._node({"CPU": 2.0}, {"CPU": 2.0})}
+        picks = self._run_tick(
+            [(self._demand(), "n1", {"data_locality": True})], nodes)
+        assert picks == ["n1"]  # queued at the data, not shipped to n2
+
+    def test_plain_hint_spreads_off_busy_node(self):
+        nodes = {"n1": self._node({"CPU": 0.0}, {"CPU": 2.0}),
+                 "n2": self._node({"CPU": 2.0}, {"CPU": 2.0})}
+        picks = self._run_tick(
+            [(self._demand(), "n1", {})], nodes)
+        assert picks == ["n2"]  # explicit hint: best-effort, falls back
+
+    def test_saturated_data_node_spills(self):
+        # Over-commit already past one node-worth: -1.5 + 2.0 < 1 ->
+        # the bound trips and the task runs where there is capacity.
+        nodes = {"n1": self._node({"CPU": -1.5}, {"CPU": 2.0}),
+                 "n2": self._node({"CPU": 2.0}, {"CPU": 2.0})}
+        picks = self._run_tick(
+            [(self._demand(), "n1", {"data_locality": True})], nodes)
+        assert picks == ["n2"]
+
+    def test_free_data_node_takes_hint_directly(self):
+        nodes = {"n1": self._node({"CPU": 2.0}, {"CPU": 2.0}),
+                 "n2": self._node({"CPU": 2.0}, {"CPU": 2.0})}
+        picks = self._run_tick(
+            [(self._demand(), "n2", {"data_locality": True})], nodes)
+        assert picks == ["n2"]

@@ -1,0 +1,377 @@
+"""TransferManager unit tests (PR-20 data plane): admission capping,
+FIFO/largest-first queueing, failover-with-resume, and the accounting the
+head's auditor and Prometheus rollup consume. Socket-free — a fake client
+and store stand in for the native layer, so every scenario (thundering
+herd, sender death mid-stream, exhausted sources) is deterministic."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from ray_tpu.cluster.transfer_manager import (
+    PullFailedError,
+    TransferManager,
+    chunk_size,
+    max_inflight_per_source,
+    sched_enabled,
+)
+
+
+class FakeStore:
+    def __init__(self):
+        self.sealed = {}
+        self.open = {}
+        self.aborted = []
+
+    def create(self, oid, size):
+        if oid in self.sealed or oid in self.open:
+            return None
+        buf = bytearray(size)
+        self.open[oid] = buf
+        return memoryview(buf)
+
+    def seal(self, oid):
+        self.sealed[oid] = bytes(self.open.pop(oid))
+
+    def abort(self, oid):
+        self.open.pop(oid, None)
+        self.aborted.append(oid)
+
+
+class TransferBrokenError(Exception):
+    """Name-matched stand-in for the native client's exception (the
+    manager dispatches on ``type(exc).__name__``)."""
+
+    def __init__(self, offset):
+        super().__init__(f"broken at {offset}")
+        self.offset = offset
+
+
+class RemoteMissError(Exception):
+    pass
+
+
+class FakeClient:
+    """Serves objects from a dict of per-"node" holdings; optionally
+    blocks fetches on a gate (concurrency probes) or breaks streams after
+    a byte budget (sender-death scenarios)."""
+
+    def __init__(self, holdings):
+        self.holdings = holdings  # node host -> {oid: bytes}
+        self.gate = None          # threading.Event: fetches wait on it
+        self.break_after = {}     # host -> bytes served before snapping
+        self.lock = threading.Lock()
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self.started = []         # (host, oid) in fetch start order
+        self.probes = 0
+
+    def probe_size(self, host, port, oid):
+        self.probes += 1
+        held = self.holdings.get(host, {})
+        if oid not in held:
+            return None
+        return len(held[oid])
+
+    def fetch_chunks(self, host, port, oid, view, offset=0,
+                     chunk_size=1 << 20):
+        with self.lock:
+            self.concurrent += 1
+            self.max_concurrent = max(self.max_concurrent, self.concurrent)
+            self.started.append((host, bytes(oid)))
+        try:
+            if self.gate is not None:
+                assert self.gate.wait(5.0)
+            held = self.holdings.get(host, {})
+            if oid not in held:
+                raise RemoteMissError(oid.hex())
+            data = held[oid]
+            budget = self.break_after.get(host)
+            if budget is not None and len(data) - offset > budget:
+                landed = offset + budget
+                view[offset:landed] = data[offset:landed]
+                raise TransferBrokenError(landed)
+            view[offset:] = data[offset:]
+            return 1
+        finally:
+            with self.lock:
+                self.concurrent -= 1
+
+
+def _mk(holdings, **kw):
+    store = FakeStore()
+    client = FakeClient(holdings)
+    kw.setdefault("enabled", True)
+    mgr = TransferManager(store, client, server=None, **kw)
+    return store, client, mgr
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_TRANSFER_SCHED", raising=False)
+    assert sched_enabled()
+    monkeypatch.setenv("RAY_TPU_TRANSFER_SCHED", "0")
+    assert not sched_enabled()
+    monkeypatch.setenv("RAY_TPU_TRANSFER_MAX_INFLIGHT", "9")
+    assert max_inflight_per_source() == 9
+    monkeypatch.setenv("RAY_TPU_TRANSFER_MAX_INFLIGHT", "junk")
+    assert max_inflight_per_source() == 4
+    monkeypatch.setenv("RAY_TPU_TRANSFER_CHUNK", "1")
+    assert chunk_size() == 1 << 12  # floored
+    monkeypatch.delenv("RAY_TPU_TRANSFER_CHUNK", raising=False)
+    assert chunk_size() == 1 << 20
+
+
+def test_single_pull_lands_and_seals():
+    oid = b"a" * 24
+    store, client, mgr = _mk({"h1": {oid: b"x" * 1000}})
+
+    async def scenario():
+        return await mgr.pull(oid, [("n1", "h1", 1)])
+
+    assert asyncio.run(scenario())
+    assert store.sealed[oid] == b"x" * 1000
+    s = mgr.stats()
+    assert s["pulls_ok"] == 1 and s["bytes_in"] == 1000
+    assert s["inflight"] == 0 and s["queue_depth"] == 0
+
+
+def test_thundering_herd_cap_honored_and_fifo():
+    """16 simultaneous pulls against ONE source: never more than
+    max_inflight streams concurrently, and admission follows arrival
+    order (FIFO by seq) — the acceptance invariant."""
+    oids = [bytes([i]) * 24 for i in range(16)]
+    holdings = {"h1": {oid: bytes([i]) * 256 for i, oid in enumerate(oids)}}
+    store, client, mgr = _mk(holdings, max_inflight=4)
+    client.gate = threading.Event()
+
+    async def scenario():
+        tasks = []
+        for i, oid in enumerate(oids):
+            tasks.append(asyncio.create_task(
+                mgr.pull(oid, [("n1", "h1", 1)], timeout=30.0, seq=i)))
+            await asyncio.sleep(0)  # deterministic arrival order
+        # Let the first admission wave reach its (gated) fetch threads.
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if client.concurrent >= 4:
+                break
+        assert mgr.stats()["inflight"] <= 4
+        assert mgr.stats()["queue_depth"] == 16 - 4
+        client.gate.set()
+        return await asyncio.gather(*tasks)
+
+    results = asyncio.run(scenario())
+    assert all(results)
+    assert client.max_concurrent <= 4, (
+        f"inflight cap violated: {client.max_concurrent} concurrent")
+    # FIFO: fetches started in arrival order (same-size objects, distinct
+    # seqs — the heap orders purely by seq).
+    started = [oid for _, oid in client.started]
+    assert started == oids
+    assert len(store.sealed) == 16
+    assert mgr.stats()["queued_total"] == 12
+
+
+def test_largest_first_among_equal_seq():
+    """Pulls queued with the SAME seq (one submit wave) drain
+    largest-first — big objects hide more latency behind them."""
+    sizes = {b"s" * 24: 10, b"m" * 24: 1000, b"l" * 24: 100_000}
+    holdings = {"h1": {oid: b"z" * n for oid, n in sizes.items()}}
+    holdings["h1"][b"b" * 24] = b"z" * 8  # the slot-holding blocker
+    store, client, mgr = _mk(holdings, max_inflight=1)
+    client.gate = threading.Event()
+
+    async def scenario():
+        blocker = asyncio.create_task(
+            mgr.pull(b"b" * 24, [("n1", "h1", 1)], seq=0))
+        await asyncio.sleep(0.05)  # blocker occupies the single slot
+        tasks = [asyncio.create_task(
+            mgr.pull(oid, [("n1", "h1", 1)], size_hint=n, seq=1))
+            for oid, n in sizes.items()]
+        await asyncio.sleep(0.05)
+        client.gate.set()
+        await asyncio.gather(blocker, *tasks, return_exceptions=True)
+
+    asyncio.run(scenario())
+    order = [oid for _, oid in client.started
+             if oid != b"b" * 24]
+    assert order == [b"l" * 24, b"m" * 24, b"s" * 24]
+
+
+def test_sched_disabled_runs_everything_immediately():
+    oids = [bytes([i]) * 24 for i in range(8)]
+    holdings = {"h1": {oid: b"d" * 64 for oid in oids}}
+    store, client, mgr = _mk(holdings, max_inflight=1, enabled=False)
+    client.gate = threading.Event()
+
+    async def scenario():
+        tasks = [asyncio.create_task(mgr.pull(oid, [("n1", "h1", 1)]))
+                 for oid in oids]
+        # No admission: every pull is marked inflight immediately, none
+        # queue. (Thread-level concurrency is bounded by the to_thread
+        # pool on small boxes, so assert on the manager's own view.)
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if mgr.stats()["inflight"] == 8:
+                break
+        assert mgr.stats()["inflight"] == 8
+        assert mgr.stats()["queue_depth"] == 0
+        client.gate.set()
+        return await asyncio.gather(*tasks)
+
+    assert all(asyncio.run(scenario()))
+    assert mgr.stats()["queued_total"] == 0
+
+
+def test_sender_death_resumes_against_next_holder():
+    oid = b"r" * 24
+    data = bytes(range(256)) * 1000
+    holdings = {"h1": {oid: data}, "h2": {oid: data}}
+    store, client, mgr = _mk(holdings)
+    client.break_after["h1"] = 5_000  # h1 snaps after 5k bytes
+
+    async def scenario():
+        return await mgr.pull(
+            oid, [("n1", "h1", 1), ("n2", "h2", 2)], timeout=10.0)
+
+    assert asyncio.run(scenario())
+    assert store.sealed[oid] == data
+    s = mgr.stats()
+    assert s["sender_deaths"] >= 1 and s["chunk_retries"] >= 1
+    # bytes_in counts every landed byte exactly once (prefix + resume)
+    assert s["bytes_in"] == len(data)
+    kinds = [e["kind"] for e in mgr.drain_events()]
+    assert "transfer_sender_death" in kinds
+    # resumed from the landed prefix: h2's fetch started past 0
+    assert client.started == [("h1", oid), ("h2", oid)]
+
+
+def test_all_sources_dead_raises_and_aborts():
+    oid = b"x" * 24
+    data = b"q" * 10_000
+    holdings = {"h1": {oid: data}, "h2": {oid: data}}
+    store, client, mgr = _mk(holdings)
+    client.break_after["h1"] = 100
+    client.break_after["h2"] = 200
+
+    async def scenario():
+        await mgr.pull(oid, [("n1", "h1", 1), ("n2", "h2", 2)],
+                       timeout=5.0)
+
+    with pytest.raises(PullFailedError):
+        asyncio.run(scenario())
+    assert oid in store.aborted and oid not in store.sealed
+    s = mgr.stats()
+    assert s["pulls_failed"] == 1
+    assert s["inflight"] == 0 and s["queue_depth"] == 0
+    kinds = [e["kind"] for e in mgr.drain_events()]
+    assert "transfer_pull_failed" in kinds
+
+
+def test_stale_location_miss_skips_to_next_source():
+    oid = b"y" * 24
+    holdings = {"h1": {}, "h2": {oid: b"k" * 512}}
+    store, client, mgr = _mk(holdings)
+
+    async def scenario():
+        return await mgr.pull(oid, [("n1", "h1", 1), ("n2", "h2", 2)])
+
+    assert asyncio.run(scenario())
+    assert store.sealed[oid] == b"k" * 512
+    assert mgr.stats()["pulls_failed"] == 0
+
+
+def test_queue_timeout_raises_and_leaves_no_leak():
+    oid = b"t" * 24
+    holdings = {"h1": {oid: b"v" * 64, b"w" * 24: b"v" * 64}}
+    store, client, mgr = _mk(holdings, max_inflight=1)
+    client.gate = threading.Event()
+
+    async def scenario():
+        blocker = asyncio.create_task(
+            mgr.pull(b"w" * 24, [("n1", "h1", 1)], timeout=10.0))
+        await asyncio.sleep(0.05)
+        # queued behind the blocker with a tiny timeout: must time out
+        try:
+            await mgr.pull(oid, [("n1", "h1", 1)], timeout=0.1)
+            timed_out = False
+        except asyncio.TimeoutError:
+            timed_out = True
+        client.gate.set()
+        await blocker
+        return timed_out
+
+    assert asyncio.run(scenario())
+    s = mgr.stats()
+    assert s["inflight"] == 0 and s["queue_depth"] == 0
+    # the slot freed by the blocker is not leaked: a fresh pull succeeds
+    holdings["h1"][oid] = b"v" * 64
+
+    async def retry():
+        return await mgr.pull(oid, [("n1", "h1", 1)], timeout=5.0)
+
+    assert asyncio.run(retry())
+
+
+def test_inventory_reports_inflight_and_queued():
+    oids = [bytes([i]) * 24 for i in range(3)]
+    holdings = {"h1": {oid: b"p" * 128 for oid in oids}}
+    store, client, mgr = _mk(holdings, max_inflight=1)
+    client.gate = threading.Event()
+
+    async def scenario():
+        tasks = [asyncio.create_task(
+            mgr.pull(oid, [("n1", "h1", 1)], size_hint=128, seq=i))
+            for i, oid in enumerate(oids)]
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if client.concurrent == 1:
+                break
+        inv = mgr.inventory()
+        client.gate.set()
+        await asyncio.gather(*tasks)
+        return inv
+
+    inv = asyncio.run(scenario())
+    assert len(inv["inflight"]) == 1 and len(inv["queued"]) == 2
+    for e in inv["inflight"] + inv["queued"]:
+        assert set(e) >= {"object_id", "source", "age_s", "size"}
+        assert e["source"] == "n1" and e["age_s"] >= 0.0
+
+
+def test_raced_create_counts_ok_without_fetch():
+    """Another fetcher (or spill staging) already owns the slot: pull
+    reports success without moving bytes."""
+    oid = b"e" * 24
+    store, client, mgr = _mk({"h1": {oid: b"f" * 32}})
+    store.sealed[oid] = b"f" * 32  # already local
+
+    async def scenario():
+        return await mgr.pull(oid, [("n1", "h1", 1)])
+
+    assert asyncio.run(scenario())
+    assert client.started == []  # no stream ever opened
+
+
+def test_close_wakes_queued_waiters():
+    oid = b"c" * 24
+    holdings = {"h1": {oid: b"g" * 64, b"d" * 24: b"g" * 64}}
+    store, client, mgr = _mk(holdings, max_inflight=1)
+    client.gate = threading.Event()
+
+    async def scenario():
+        blocker = asyncio.create_task(
+            mgr.pull(b"d" * 24, [("n1", "h1", 1)], timeout=5.0))
+        await asyncio.sleep(0.05)
+        queued = asyncio.create_task(
+            mgr.pull(oid, [("n1", "h1", 1)], timeout=5.0))
+        await asyncio.sleep(0.05)
+        mgr.close()
+        client.gate.set()
+        res = await asyncio.gather(blocker, queued, return_exceptions=True)
+        return res
+
+    res = asyncio.run(scenario())
+    assert res[0] is True  # the admitted pull completes normally

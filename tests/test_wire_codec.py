@@ -1188,6 +1188,14 @@ _FRAME_CASES = {
         "rpc_id": 9}),
     wire.OWNER_PUBLISH_RESP: (("resp", "owner_publish"), lambda: {
         "ok": True, "count": 2, "rpc_id": 9}),
+    wire.GET_OBJ_LOCATIONS: ("req", lambda: {
+        "type": "get_object_locations", "object_id": b"R" * 24,
+        "wait": True, "timeout": 5.0, "rpc_id": 10}),
+    wire.GET_OBJ_LOCATIONS_RESP: (("resp", "get_object_locations"), lambda: {
+        "ok": True, "locations": ["n1", "n2"],
+        "addresses": [["h1", 1], ["h2", 2]],
+        "transfer_addresses": [["h1", 9], ["h2", 0]],
+        "size": 1 << 33, "rpc_id": 10}),
     wire.HA_STATUS: ("req", lambda: {"type": "ha_status", "rpc_id": 3}),
     wire.HA_STATUS_RESP: (("resp", "ha_status"), lambda: {
         "ok": True, "epoch": 4, "is_leader": True, "role": "leader",
